@@ -1,0 +1,63 @@
+"""slate_tpu — TPU-native distributed dense linear algebra.
+
+A from-scratch JAX/XLA/Pallas framework with the capabilities of SLATE
+(the ECP dense linear algebra library; reference at /root/reference,
+public umbrella header include/slate/slate.hh).  Tile-level compute runs as
+XLA/Pallas kernels on HBM-resident arrays; distribution is jax.sharding over
+a TPU mesh with XLA collectives over ICI replacing MPI.
+
+Public surface mirrors slate.hh: matrix types, level-3 BLAS, linear system
+solvers (Cholesky / LU with four pivoting strategies / mixed precision /
+symmetric-indefinite / band), QR/LQ least squares, two-stage eigensolvers and
+SVD, norms and condition estimators, plus a simplified verb API
+(simplified_api.hh analog) in ``slate_tpu.api``.
+"""
+
+from .types import (
+    Diag,
+    GridOrder,
+    Layout,
+    MethodEig,
+    MethodGels,
+    MethodGemm,
+    MethodHemm,
+    MethodLU,
+    MethodSVD,
+    MethodTrsm,
+    Norm,
+    NormScope,
+    Op,
+    Option,
+    Pivot,
+    Side,
+    SlateError,
+    Target,
+    Uplo,
+)
+from .core import (
+    BandMatrix,
+    BaseMatrix,
+    HermitianBandMatrix,
+    HermitianMatrix,
+    Matrix,
+    SymmetricMatrix,
+    TrapezoidMatrix,
+    TriangularBandMatrix,
+    TriangularMatrix,
+)
+from .blas3 import (
+    gbmm,
+    gemm,
+    hbmm,
+    hemm,
+    her2k,
+    herk,
+    symm,
+    syr2k,
+    syrk,
+    tbsm,
+    trmm,
+    trsm,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,15 @@
+from .matrix import (
+    BandMatrix,
+    BaseMatrix,
+    HermitianBandMatrix,
+    HermitianMatrix,
+    Matrix,
+    SymmetricMatrix,
+    TrapezoidMatrix,
+    TriangularBandMatrix,
+    TriangularMatrix,
+    band_project,
+    symmetrize,
+    tri_project,
+)
+from . import grid, tiling

@@ -1,0 +1,90 @@
+"""Tile-stack packing and block-cyclic layout transforms.
+
+The reference stores a distributed matrix as a ``std::map<(i,j), TileNode>``
+of mb x nb blocks with a tileRank lambda (BaseMatrix.hh:215-227,
+MatrixStorage.hh:158).  The TPU-native representation is the *tile stack*: a
+dense array of shape ``(mt, nt, nb, nb)`` (short edge tiles zero-padded) that
+XLA can shard over a device mesh and batch over with one fused kernel — the
+analogue of the reference's batched pointer arrays (MatrixStorage.hh:632-737)
+without any pointer bookkeeping.
+
+Block-cyclic distribution (reference func.hh:78, BaseMatrix.hh:4006-4056) is
+realised as a *permutation of tile indices*: tiles are reordered so tile row
+``i`` sits at position ``(i % p) * ceil(mt/p) + i // p``; a contiguous
+device-mesh sharding of the permuted stack then equals the reference's 2D
+block-cyclic layout, and any trailing submatrix window stays load-balanced
+across the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .grid import num_tiles
+
+
+def pad_to_tiles(a: jax.Array, nb: int) -> jax.Array:
+    """Zero-pad (m, n) up to multiples of nb."""
+    m, n = a.shape
+    mp = num_tiles(m, nb) * nb
+    np_ = num_tiles(n, nb) * nb
+    if mp == m and np_ == n:
+        return a
+    return jnp.pad(a, ((0, mp - m), (0, np_ - n)))
+
+
+def to_tiles(a: jax.Array, nb: int) -> jax.Array:
+    """Dense (m, n) -> tile stack (mt, nt, nb, nb); pads short edges."""
+    a = pad_to_tiles(a, nb)
+    m, n = a.shape
+    mt, nt = m // nb, n // nb
+    return a.reshape(mt, nb, nt, nb).transpose(0, 2, 1, 3)
+
+
+def from_tiles(t: jax.Array, m: int, n: int) -> jax.Array:
+    """Tile stack (mt, nt, nb, nb) -> dense (m, n), dropping pad."""
+    mt, nt, nb, _ = t.shape
+    a = t.transpose(0, 2, 1, 3).reshape(mt * nb, nt * nb)
+    return a[:m, :n]
+
+
+def cyclic_perm(mt: int, p: int) -> np.ndarray:
+    """Permutation sending logical tile index i to storage slot so that a
+    contiguous p-way split of storage = cyclic distribution of logical tiles.
+
+    storage order: all tiles with i % p == 0 (in i order), then i % p == 1, ...
+    Returns ``perm`` with ``storage[s] = logical[perm[s]]``.
+    """
+    i = np.arange(mt, dtype=np.int64)
+    return np.argsort((i % p) * mt + i // p, kind="stable").astype(np.int32)
+
+
+def inv_perm(perm: np.ndarray) -> np.ndarray:
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(len(perm), dtype=perm.dtype)
+    return inv
+
+
+def to_cyclic(t: jax.Array, p: int, q: int) -> jax.Array:
+    """Reorder a tile stack into 2D block-cyclic storage order for a (p, q)
+    mesh. Sharding the result with PartitionSpec('p', 'q') on dims (0, 1)
+    reproduces the reference's 2D block-cyclic layout (func.hh:154)."""
+    mt, nt = t.shape[0], t.shape[1]
+    rp = jnp.asarray(cyclic_perm(mt, p))
+    cp = jnp.asarray(cyclic_perm(nt, q))
+    return t[rp][:, cp]
+
+
+def from_cyclic(t: jax.Array, p: int, q: int) -> jax.Array:
+    mt, nt = t.shape[0], t.shape[1]
+    rp = jnp.asarray(inv_perm(cyclic_perm(mt, p)))
+    cp = jnp.asarray(inv_perm(cyclic_perm(nt, q)))
+    return t[rp][:, cp]
+
+
+def tile_shape(m: int, n: int, nb: int) -> Tuple[int, int]:
+    return num_tiles(m, nb), num_tiles(n, nb)

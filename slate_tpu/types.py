@@ -1,0 +1,219 @@
+"""Core enums, options, and types.
+
+TPU-native analogue of the reference's ``include/slate/enums.hh`` and
+``include/slate/types.hh`` (reference: enums.hh:33-143, types.hh:32-64).
+Enums that only exist to drive the reference's CPU/GPU runtime (MOSI states,
+TileKind, queue indices) are intentionally absent: under XLA/SPMD there is no
+coherency protocol and no stream scheduler to configure.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Any, Callable, Mapping, Optional, Union
+
+
+class Uplo(enum.Enum):
+    """Which triangle of a matrix is stored/referenced (enums.hh analog)."""
+
+    Upper = "U"
+    Lower = "L"
+    General = "G"
+
+
+class Op(enum.Enum):
+    """Logical transposition applied to a matrix view (Tile.hh op_)."""
+
+    NoTrans = "N"
+    Trans = "T"
+    ConjTrans = "C"
+
+
+class Diag(enum.Enum):
+    Unit = "U"
+    NonUnit = "N"
+
+
+class Side(enum.Enum):
+    Left = "L"
+    Right = "R"
+
+
+class Norm(enum.Enum):
+    """Matrix norms (lapack convention; reference enums.hh Norm)."""
+
+    One = "1"
+    Inf = "I"
+    Max = "M"
+    Fro = "F"
+
+
+class NormScope(enum.Enum):
+    """Whole-matrix norm vs per-row / per-column norms (enums.hh:120)."""
+
+    Matrix = "M"
+    Columns = "C"
+    Rows = "R"
+
+
+class Target(enum.Enum):
+    """Execution target.
+
+    The reference dispatches HostTask/HostNest/HostBatch/Devices
+    (enums.hh:33).  Here the only compute substrate is XLA, so targets
+    select *where XLA runs*, not a hand-written scheduler:
+
+    - ``TPU``: jit on the default accelerator backend.
+    - ``Host``: jit on the CPU backend (reference Host* targets collapse to
+      one — XLA:CPU already does the task/nest/batch scheduling internally).
+    """
+
+    TPU = "tpu"
+    Host = "host"
+
+
+class GridOrder(enum.Enum):
+    """Process-grid ordering for 2D block-cyclic distributions (enums.hh:130)."""
+
+    Col = "C"
+    Row = "R"
+
+
+class Layout(enum.Enum):
+    """Tile storage layout. XLA manages physical layout; kept for API parity."""
+
+    ColMajor = "C"
+    RowMajor = "R"
+
+
+# ---------------------------------------------------------------------------
+# Method selection (reference include/slate/method.hh:25-319)
+# ---------------------------------------------------------------------------
+
+
+class MethodGemm(enum.Enum):
+    Auto = "auto"
+    GemmA = "A"  # stationary-A
+    GemmC = "C"  # stationary-C (SUMMA-like)
+
+
+class MethodTrsm(enum.Enum):
+    Auto = "auto"
+    TrsmA = "A"
+    TrsmB = "B"
+
+
+class MethodHemm(enum.Enum):
+    Auto = "auto"
+    HemmA = "A"
+    HemmC = "C"
+
+
+class MethodLU(enum.Enum):
+    PartialPiv = "PPLU"
+    CALU = "CALU"  # tournament pivoting (getrf_tntpiv analog)
+    NoPiv = "NoPiv"
+    RBT = "RBT"  # random butterfly transform + no-pivot LU
+
+
+class MethodGels(enum.Enum):
+    QR = "QR"
+    CholQR = "CholQR"
+
+
+class MethodEig(enum.Enum):
+    QR = "QR"  # steqr: tridiagonal QR iteration
+    DC = "DC"  # stedc: divide and conquer
+
+
+class MethodSVD(enum.Enum):
+    QR = "QR"  # bdsqr
+    DC = "DC"
+
+
+def select_gemm_method(m: int, n: int, k: int) -> MethodGemm:
+    """Heuristic from method.hh:35-45: tiny output panel -> stationary-A."""
+    if n <= max(m, k) // 4:
+        return MethodGemm.GemmA
+    return MethodGemm.GemmC
+
+
+def select_trsm_method(side: Side, m: int, n: int) -> MethodTrsm:
+    """method.hh:88-99: solve-side-dominant shapes favour TrsmA."""
+    if (side == Side.Left and n <= m // 4) or (side == Side.Right and m <= n // 4):
+        return MethodTrsm.TrsmA
+    return MethodTrsm.TrsmB
+
+
+# ---------------------------------------------------------------------------
+# Options (reference types.hh:60 Options = map<Option, OptionValue>)
+# ---------------------------------------------------------------------------
+
+
+class Option(enum.Enum):
+    ChunkSize = "chunk_size"
+    Lookahead = "lookahead"
+    BlockSize = "block_size"  # nb (reference Option::TileSize analog)
+    InnerBlocking = "inner_blocking"  # ib
+    MaxPanelThreads = "max_panel_threads"  # kept for API parity; unused
+    Tolerance = "tolerance"
+    Target = "target"
+    MaxIterations = "max_iterations"
+    UseFallbackSolver = "use_fallback_solver"
+    PivotThreshold = "pivot_threshold"
+    MethodCholQR = "method_cholqr"
+    MethodEig = "method_eig"
+    MethodGels = "method_gels"
+    MethodGemm = "method_gemm"
+    MethodHemm = "method_hemm"
+    MethodLU = "method_lu"
+    MethodTrsm = "method_trsm"
+    MethodSVD = "method_svd"
+    PrintVerbose = "print_verbose"
+    PrintPrecision = "print_precision"
+    Depth = "depth"  # RBT butterfly depth
+
+
+Options = Mapping[Union[Option, str], Any]
+
+_DEFAULTS = {
+    Option.Lookahead: 1,
+    Option.BlockSize: 256,
+    Option.InnerBlocking: 32,
+    Option.Tolerance: None,
+    Option.Target: Target.TPU,
+    Option.MaxIterations: 30,
+    Option.UseFallbackSolver: True,
+    Option.PivotThreshold: 1.0,
+    Option.Depth: 2,
+}
+
+
+def get_option(opts: Optional[Options], key: Option, default: Any = None) -> Any:
+    """Typed option lookup (types.hh get_option analog)."""
+    if opts:
+        if key in opts:
+            return opts[key]
+        if key.value in opts:
+            return opts[key.value]
+    if default is not None:
+        return default
+    return _DEFAULTS.get(key)
+
+
+@dataclass(frozen=True)
+class Pivot:
+    """One pivot entry: which tile row / element within it (types.hh:64)."""
+
+    tile_index: int
+    element_offset: int
+
+
+class SlateError(Exception):
+    """slate::Exception analog (include/slate/Exception.hh)."""
+
+
+def slate_assert(cond: bool, msg: str) -> None:
+    if not cond:
+        raise SlateError(msg)

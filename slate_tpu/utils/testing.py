@@ -1,0 +1,75 @@
+"""Matrix generators for tests and benchmarks.
+
+Analogue of the reference's ``test/matrix_generator.cc`` + ``matrix_params.cc``:
+named matrix kinds with seeded, distribution-independent values (reference
+CHANGELOG.md:25-26 — "random matrices are the same regardless of MPI
+distribution"; here the same holds trivially since generation is a pure
+function of the seed).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def generate(
+    kind: str,
+    m: int,
+    n: Optional[int] = None,
+    dtype=np.float64,
+    seed: int = 0,
+    cond: float = 1e3,
+) -> np.ndarray:
+    """Named matrix kinds (matrix_generator.cc): rand, rands, randn, diag,
+    identity, svd (geometric singular-value spectrum with condition
+    ``cond``), spd (random SPD), hermitian, triangular-friendly `dominant`
+    (row-diagonally dominant, safe for no-pivot LU)."""
+    n = m if n is None else n
+    rng = np.random.default_rng(seed)
+    cplx = np.issubdtype(dtype, np.complexfloating)
+
+    def rnd(shape):
+        a = rng.standard_normal(shape)
+        if cplx:
+            a = a + 1j * rng.standard_normal(shape)
+        return a.astype(dtype)
+
+    if kind == "rand":  # uniform [0, 1)
+        a = rng.random((m, n))
+        if cplx:
+            a = a + 1j * rng.random((m, n))
+        return a.astype(dtype)
+    if kind == "rands":  # uniform [-1, 1)
+        a = 2 * rng.random((m, n)) - 1
+        if cplx:
+            a = a + 1j * (2 * rng.random((m, n)) - 1)
+        return a.astype(dtype)
+    if kind == "randn":
+        return rnd((m, n))
+    if kind == "identity":
+        return np.eye(m, n, dtype=dtype)
+    if kind == "diag":
+        a = np.zeros((m, n), dtype=dtype)
+        np.fill_diagonal(a, rng.random(min(m, n)))
+        return a
+    if kind == "svd":  # controlled condition number via geometric spectrum
+        k = min(m, n)
+        u, _ = np.linalg.qr(rnd((m, k)))
+        v, _ = np.linalg.qr(rnd((n, k)))
+        s = cond ** (-np.arange(k) / max(k - 1, 1))
+        return (u * s) @ v.conj().T
+    if kind == "spd":
+        a = rnd((m, m))
+        a = a @ a.conj().T / m + np.eye(m, dtype=dtype)
+        return a.astype(dtype)
+    if kind == "hermitian":
+        a = rnd((m, m))
+        return ((a + a.conj().T) / 2).astype(dtype)
+    if kind == "dominant":
+        a = rnd((m, n))
+        k = min(m, n)
+        a[np.arange(k), np.arange(k)] += np.abs(a).sum(axis=1)[:k].astype(dtype)
+        return a
+    raise ValueError(f"unknown matrix kind: {kind}")

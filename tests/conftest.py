@@ -1,0 +1,37 @@
+"""Test configuration.
+
+Mirrors the reference's test strategy (SURVEY.md §4): run everything on
+XLA:CPU with a forced 8-device host platform — the "multi-node without a
+cluster" fake backend (analogue of the reference's MPI-stub serial builds and
+oversubscribed single-node MPI CI, Jenkinsfile-mpi) — with float64 enabled so
+numerical checks use the same 3-eps style gates as the reference tester.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+# The axon TPU plugin registers itself as default backend even under
+# JAX_PLATFORMS=cpu; pin default placement to CPU explicitly so tests are
+# hermetic and fast (the real chip is exercised by bench.py, not pytest).
+try:
+    _cpu0 = jax.devices("cpu")[0]
+    jax.config.update("jax_default_device", _cpu0)
+except RuntimeError:
+    pass
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+def cpu_devices(n=8):
+    return jax.devices("cpu")[:n]

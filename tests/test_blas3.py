@@ -1,0 +1,147 @@
+"""BLAS-3 driver tests — residual-style checks mirroring test/test_gemm.cc,
+test_trsm.cc, test_herk.cc etc. (reference test strategy SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.blas3 import trsm_array, trmm_array
+from slate_tpu.types import Diag, Op, Side, Uplo
+from slate_tpu.utils.testing import generate
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_gemm(dtype):
+    a = generate("rands", 37, 23, dtype, seed=1)
+    b = generate("rands", 23, 41, dtype, seed=2)
+    c = generate("rands", 37, 41, dtype, seed=3)
+    out = st.gemm(2.0, st.Matrix.from_array(a), st.Matrix.from_array(b), 0.5, st.Matrix.from_array(c))
+    np.testing.assert_allclose(np.asarray(out.array), 2 * a @ b + 0.5 * c, rtol=1e-12, atol=1e-12)
+
+
+def test_gemm_transposed_views():
+    a = generate("rands", 23, 37, np.float64, seed=1)
+    b = generate("rands", 41, 23, np.float64, seed=2)
+    c = np.zeros((37, 41))
+    at = st.Matrix.from_array(a).transposed()
+    bt = st.Matrix.from_array(b).transposed()
+    out = st.gemm(1.0, at, bt, 0.0, st.Matrix.from_array(c))
+    np.testing.assert_allclose(np.asarray(out.array), a.T @ b.T, rtol=1e-12, atol=1e-12)
+
+
+@pytest.mark.parametrize("uplo", [Uplo.Lower, Uplo.Upper])
+def test_hemm(uplo):
+    a = generate("hermitian", 20, dtype=np.complex128, seed=4)
+    astore = np.tril(a) if uplo == Uplo.Lower else np.triu(a)
+    b = generate("rands", 20, 15, np.complex128, seed=5)
+    c = generate("rands", 20, 15, np.complex128, seed=6)
+    am = st.HermitianMatrix.from_array(astore, uplo)
+    out = st.hemm(Side.Left, 1.5, am, st.Matrix.from_array(b), 0.5, st.Matrix.from_array(c))
+    np.testing.assert_allclose(np.asarray(out.array), 1.5 * a @ b + 0.5 * c, rtol=1e-12, atol=1e-12)
+
+
+@pytest.mark.parametrize("uplo", [Uplo.Lower, Uplo.Upper])
+def test_herk(uplo):
+    a = generate("rands", 18, 9, np.complex128, seed=7)
+    c0 = generate("hermitian", 18, dtype=np.complex128, seed=8)
+    cstore = np.tril(c0) if uplo == Uplo.Lower else np.triu(c0)
+    cm = st.HermitianMatrix.from_array(cstore, uplo)
+    out = st.herk(2.0, st.Matrix.from_array(a), 3.0, cm)
+    expect = 2 * a @ a.conj().T + 3 * c0
+    got = np.asarray(out.full)
+    np.testing.assert_allclose(got, expect, rtol=1e-12, atol=1e-12)
+
+
+def test_syrk_syr2k():
+    a = generate("rands", 12, 7, np.float64, seed=9)
+    b = generate("rands", 12, 7, np.float64, seed=10)
+    c0 = generate("rands", 12, 12, np.float64, seed=11)
+    c0 = (c0 + c0.T) / 2
+    cm = st.SymmetricMatrix.from_array(np.tril(c0), Uplo.Lower)
+    out = st.syrk(1.0, st.Matrix.from_array(a), 2.0, cm)
+    np.testing.assert_allclose(np.asarray(out.full), a @ a.T + 2 * c0, rtol=1e-12, atol=1e-12)
+    out2 = st.syr2k(1.0, st.Matrix.from_array(a), st.Matrix.from_array(b), 0.0, cm)
+    np.testing.assert_allclose(np.asarray(out2.full), a @ b.T + b @ a.T, rtol=1e-12, atol=1e-12)
+
+
+def test_her2k():
+    a = generate("rands", 10, 6, np.complex128, seed=12)
+    b = generate("rands", 10, 6, np.complex128, seed=13)
+    c0 = generate("hermitian", 10, dtype=np.complex128, seed=14)
+    cm = st.HermitianMatrix.from_array(np.tril(c0), Uplo.Lower)
+    alpha = 1.0 + 2.0j
+    out = st.her2k(alpha, st.Matrix.from_array(a), st.Matrix.from_array(b), 1.0, cm)
+    expect = alpha * a @ b.conj().T + np.conj(alpha) * b @ a.conj().T + c0
+    np.testing.assert_allclose(np.asarray(out.full), expect, rtol=1e-12, atol=1e-12)
+
+
+@pytest.mark.parametrize("side", [Side.Left, Side.Right])
+@pytest.mark.parametrize("uplo", [Uplo.Lower, Uplo.Upper])
+@pytest.mark.parametrize("op", [Op.NoTrans, Op.Trans, Op.ConjTrans])
+@pytest.mark.parametrize("diag", [Diag.NonUnit, Diag.Unit])
+def test_trsm_all_variants(side, uplo, op, diag):
+    n = 35
+    rng = np.random.default_rng(15)
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    bshape = (n, 13) if side == Side.Left else (13, n)
+    b = rng.standard_normal(bshape)
+    x = np.asarray(trsm_array(side, uplo, op, diag, 2.0, jnp.asarray(a), jnp.asarray(b)))
+    t = np.tril(a) if uplo == Uplo.Lower else np.triu(a)
+    if diag == Diag.Unit:
+        np.fill_diagonal(t, 1.0)
+    opa = {Op.NoTrans: t, Op.Trans: t.T, Op.ConjTrans: t.conj().T}[op]
+    resid = opa @ x - 2 * b if side == Side.Left else x @ opa - 2 * b
+    denom = np.abs(opa).sum() * np.abs(x).sum() + np.abs(b).sum()
+    assert np.abs(resid).max() / denom < 1e-13
+
+
+def test_trsm_large_recursive():
+    # exercise the recursive path (n > _NB) with well-conditioned triangle
+    n = 700
+    rng = np.random.default_rng(16)
+    a = np.tril(rng.standard_normal((n, n)) / np.sqrt(n)) + 2 * np.eye(n)
+    b = rng.standard_normal((n, 3))
+    x = np.asarray(trsm_array(Side.Left, Uplo.Lower, Op.NoTrans, Diag.NonUnit, 1.0, jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(np.tril(a) @ x, b, atol=1e-10)
+
+
+@pytest.mark.parametrize("side", [Side.Left, Side.Right])
+def test_trmm(side):
+    n, k = 21, 9
+    rng = np.random.default_rng(17)
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal((n, k) if side == Side.Left else (k, n))
+    out = np.asarray(trmm_array(side, Uplo.Upper, Op.NoTrans, Diag.NonUnit, 3.0, jnp.asarray(a), jnp.asarray(b)))
+    expect = 3 * np.triu(a) @ b if side == Side.Left else 3 * b @ np.triu(a)
+    np.testing.assert_allclose(out, expect, rtol=1e-12, atol=1e-12)
+
+
+def test_gbmm():
+    m, k, n = 16, 16, 10
+    rng = np.random.default_rng(18)
+    a = rng.standard_normal((m, k))
+    kl, ku = 2, 3
+    band = np.zeros_like(a)
+    for i in range(m):
+        for j in range(k):
+            if -kl <= j - i <= ku:
+                band[i, j] = a[i, j]
+    b = rng.standard_normal((k, n))
+    am = st.BandMatrix.from_array(a, kl, ku)
+    out = st.gbmm(1.0, am, st.Matrix.from_array(b), 0.0, st.Matrix.from_array(np.zeros((m, n))))
+    np.testing.assert_allclose(np.asarray(out.array), band @ b, rtol=1e-12, atol=1e-12)
+
+
+def test_tbsm_with_pivots():
+    n = 12
+    rng = np.random.default_rng(19)
+    a = np.tril(rng.standard_normal((n, n))) + 4 * np.eye(n)
+    b = rng.standard_normal((n, 4))
+    piv = np.arange(n)
+    piv[0], piv[5] = 5, 5  # swap rows 0<->5 at step 0
+    am = st.TriangularMatrix.from_array(a, Uplo.Lower)
+    out = st.tbsm(Side.Left, 1.0, am, st.Matrix.from_array(b), pivots=jnp.asarray(piv))
+    bp = b.copy()
+    bp[[0, 5]] = bp[[5, 0]]
+    np.testing.assert_allclose(np.asarray(np.tril(a) @ out.array), bp, atol=1e-12)

@@ -1,0 +1,99 @@
+"""Unit tests for the matrix core (analog of unit_test/test_Matrix.cc,
+test_Tile.cc, test_func.cc)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.core import grid, tiling
+from slate_tpu.core.matrix import symmetrize, tri_project
+from slate_tpu.types import Diag, GridOrder, Op, Uplo
+
+
+def test_matrix_views(rng):
+    a = rng.standard_normal((6, 4))
+    m = st.Matrix.from_array(a)
+    assert m.shape == (6, 4)
+    t = m.transposed()
+    assert t.shape == (4, 6)
+    np.testing.assert_allclose(np.asarray(t.array), a.T)
+    h = m.conj_transposed()
+    np.testing.assert_allclose(np.asarray(h.array), a.T)  # real: H == T
+    # double transpose round-trips
+    np.testing.assert_allclose(np.asarray(t.transposed().array), a)
+
+
+def test_complex_conj_transpose(rng):
+    a = rng.standard_normal((3, 5)) + 1j * rng.standard_normal((3, 5))
+    m = st.Matrix.from_array(a)
+    np.testing.assert_allclose(np.asarray(m.conj_transposed().array), a.conj().T)
+    np.testing.assert_allclose(np.asarray(m.conj_transposed().conj_transposed().array), a)
+    np.testing.assert_allclose(np.asarray(m.transposed().conj_transposed().array), a.conj())
+
+
+def test_slice(rng):
+    a = rng.standard_normal((8, 8))
+    m = st.Matrix.from_array(a)
+    s = m.slice(2, 6, 1, 5)
+    np.testing.assert_allclose(np.asarray(s.array), a[2:6, 1:5])
+    # slicing a transposed view works in logical coordinates
+    st_ = m.transposed().slice(1, 3, 2, 4)
+    np.testing.assert_allclose(np.asarray(st_.array), a.T[1:3, 2:4])
+
+
+def test_symmetrize(rng):
+    a = rng.standard_normal((5, 5)) + 1j * rng.standard_normal((5, 5))
+    full = np.asarray(symmetrize(jnp.asarray(a), Uplo.Lower, conj=True))
+    np.testing.assert_allclose(full, full.conj().T)
+    np.testing.assert_allclose(np.tril(full, -1), np.tril(a, -1))
+    assert np.allclose(np.imag(np.diag(full)), 0)
+
+
+def test_tri_project(rng):
+    a = rng.standard_normal((4, 4))
+    lo = np.asarray(tri_project(jnp.asarray(a), Uplo.Lower))
+    np.testing.assert_allclose(lo, np.tril(a))
+    un = np.asarray(tri_project(jnp.asarray(a), Uplo.Upper, Diag.Unit))
+    np.testing.assert_allclose(un, np.triu(a, 1) + np.eye(4))
+
+
+def test_band_matrix(rng):
+    a = rng.standard_normal((6, 6))
+    b = st.BandMatrix.from_array(a, kl=1, ku=2)
+    d = np.asarray(b.data)
+    assert d[3, 0] == 0 and d[0, 3] == 0
+    assert d[2, 1] != 0 and d[1, 3] != 0
+
+
+def test_grid_maps():
+    f = grid.process_2d_grid(GridOrder.Col, 2, 3)
+    assert f((0, 0)) == 0
+    assert f((1, 0)) == 1
+    assert f((0, 1)) == 2
+    assert f((2, 3)) == f((0, 0))  # cyclic wrap
+    bs = grid.uniform_blocksize(10, 4)
+    assert [bs(i) for i in range(3)] == [4, 4, 2]
+    assert grid.grid_2d_factor(8) == (2, 4)
+
+
+def test_tiling_roundtrip(rng):
+    a = jnp.asarray(rng.standard_normal((10, 7)))
+    t = tiling.to_tiles(a, 4)
+    assert t.shape == (3, 2, 4, 4)
+    back = tiling.from_tiles(t, 10, 7)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(a))
+
+
+def test_cyclic_roundtrip(rng):
+    a = jnp.asarray(rng.standard_normal((16, 16)))
+    t = tiling.to_tiles(a, 2)  # 8x8 tiles
+    c = tiling.to_cyclic(t, 2, 4)
+    back = tiling.from_cyclic(c, 2, 4)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(t))
+    # row permutation alone: first half of storage rows are even logical rows
+    c2 = tiling.to_cyclic(t, 2, 1)
+    np.testing.assert_allclose(np.asarray(c2[0]), np.asarray(t[0]))
+    np.testing.assert_allclose(np.asarray(c2[1]), np.asarray(t[2]))
+    np.testing.assert_allclose(np.asarray(c2[4]), np.asarray(t[1]))
+    assert list(tiling.cyclic_perm(8, 2)) == [0, 2, 4, 6, 1, 3, 5, 7]

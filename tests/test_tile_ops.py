@@ -1,0 +1,83 @@
+"""Tile kernel tests (analog of unit_test/test_Tile_kernels.cc) — each TPU
+kernel vs the numpy semantics of the reference CUDA kernel."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from slate_tpu import ops
+from slate_tpu.types import Diag, Norm, NormScope, Uplo
+
+
+def test_geadd(rng):
+    a, b = rng.standard_normal((5, 4)), rng.standard_normal((5, 4))
+    out = ops.geadd(2.0, jnp.asarray(a), 3.0, jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(out), 2 * a + 3 * b)
+
+
+def test_tzadd(rng):
+    a, b = rng.standard_normal((4, 4)), rng.standard_normal((4, 4))
+    out = np.asarray(ops.tzadd(Uplo.Lower, 2.0, jnp.asarray(a), 1.0, jnp.asarray(b)))
+    exp = np.where(np.tril(np.ones((4, 4), bool)), 2 * a + b, b)
+    np.testing.assert_allclose(out, exp)
+
+
+def test_gecopy_convert(rng):
+    a = rng.standard_normal((3, 3))
+    out = ops.gecopy(jnp.asarray(a), jnp.float32)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out), a.astype(np.float32))
+
+
+def test_gescale_row_col(rng):
+    a = rng.standard_normal((3, 4))
+    r, c = rng.random(3), rng.random(4)
+    out = ops.gescale_row_col(jnp.asarray(r), jnp.asarray(c), jnp.asarray(a))
+    np.testing.assert_allclose(np.asarray(out), np.diag(r) @ a @ np.diag(c))
+
+
+def test_geset_tzset():
+    out = np.asarray(ops.geset(1.0, 5.0, (3, 4), jnp.float64))
+    assert out[0, 0] == 5 and out[0, 1] == 1
+    a = jnp.zeros((3, 3))
+    out2 = np.asarray(ops.tzset(Uplo.Upper, 2.0, 7.0, a))
+    assert out2[0, 0] == 7 and out2[0, 2] == 2 and out2[2, 0] == 0
+
+
+def test_genorm(rng):
+    a = rng.standard_normal((6, 4))
+    aj = jnp.asarray(a)
+    assert np.isclose(float(ops.genorm(Norm.Max, aj)), np.abs(a).max())
+    assert np.isclose(float(ops.genorm(Norm.One, aj)), np.abs(a).sum(0).max())
+    assert np.isclose(float(ops.genorm(Norm.Inf, aj)), np.abs(a).sum(1).max())
+    assert np.isclose(float(ops.genorm(Norm.Fro, aj)), np.linalg.norm(a))
+    np.testing.assert_allclose(
+        np.asarray(ops.genorm(Norm.One, aj, NormScope.Columns)), np.abs(a).sum(0)
+    )
+
+
+def test_henorm(rng):
+    a = rng.standard_normal((5, 5)) + 1j * rng.standard_normal((5, 5))
+    full = np.tril(a) + np.tril(a, -1).conj().T
+    got = float(ops.henorm(Norm.One, jnp.asarray(a), Uplo.Lower))
+    assert np.isclose(got, np.abs(full).sum(0).max())
+    got_f = float(ops.henorm(Norm.Fro, jnp.asarray(a), Uplo.Lower))
+    assert np.isclose(got_f, np.linalg.norm(full))
+
+
+def test_trnorm(rng):
+    a = rng.standard_normal((4, 4))
+    got = float(ops.trnorm(Norm.Inf, jnp.asarray(a), Uplo.Upper))
+    assert np.isclose(got, np.abs(np.triu(a)).sum(1).max())
+
+
+def test_transpose(rng):
+    a = rng.standard_normal((3, 5)) + 1j * rng.standard_normal((3, 5))
+    np.testing.assert_allclose(np.asarray(ops.transpose(jnp.asarray(a), conj=True)), a.conj().T)
+
+
+def test_matmul_fallback(rng):
+    # CPU path goes through dot_general with HIGHEST precision
+    a, b = rng.standard_normal((64, 32)), rng.standard_normal((32, 48))
+    out = ops.matmul(jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(out), a @ b, rtol=1e-12)
