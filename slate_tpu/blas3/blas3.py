@@ -192,13 +192,18 @@ def _trsm_left_lower_notrans(a: jax.Array, b: jax.Array, diag: Diag) -> jax.Arra
     return jnp.concatenate([x1, x2], axis=0)
 
 
-def _split(n: int) -> int:
-    """Largest power-of-two multiple of _NB below n (keeps the set of
-    distinct recursive shapes O(log n) for XLA compile caching)."""
-    h = _NB
+def split_pow2(n: int, base: int) -> int:
+    """Largest power-of-two multiple of ``base`` below n — the shared split
+    policy for all recursive blocked algorithms (keeps the set of distinct
+    recursive shapes O(log n) for XLA compile caching)."""
+    h = base
     while h * 2 < n:
         h *= 2
     return h
+
+
+def _split(n: int) -> int:
+    return split_pow2(n, _NB)
 
 
 def trsm_array(

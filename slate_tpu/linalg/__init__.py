@@ -1,0 +1,36 @@
+from .chol import (
+    pbsv,
+    pbsv_array,
+    pbtrf_array,
+    pbtrs_array,
+    posv,
+    posv_array,
+    potrf,
+    potrf_array,
+    potri,
+    potri_array,
+    potrs,
+    potrs_array,
+)
+from .lu import (
+    LUFactors,
+    gbsv_array,
+    gbtrf_array,
+    gbtrs_array,
+    gesv,
+    gesv_array,
+    getrf,
+    getrf_array,
+    getrf_nopiv_array,
+    getrf_tntpiv_array,
+    getri_array,
+    getrs_array,
+)
+from .refine import (
+    gesv_mixed_array,
+    gesv_mixed_gmres_array,
+    posv_mixed_array,
+    posv_mixed_gmres_array,
+)
+from .rbt import apply_butterfly, gerbt_array, gesv_rbt_array
+from .tri import trtri_array, trtrm_array

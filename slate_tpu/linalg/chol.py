@@ -1,0 +1,171 @@
+"""Cholesky family: potrf / potrs / posv / potri + band pbtrf / pbtrs / pbsv.
+
+Analogue of reference drivers ``src/{potrf,potrs,posv,potri,pbtrf,pbtrs,
+pbsv}.cc`` and ``src/internal/internal_potrf.cc``.
+
+Design inversion: the reference potrf is an OpenMP task DAG — per-k panel
+factor of the diagonal tile, column trsm, listBcastMT of the panel, herk
+trailing update with lookahead queues (src/potrf.cc:91-196).  The TPU-native
+form is a *recursive blocked* factorization: split at a power-of-two
+boundary, factor the leading block, one big trsm, one big herk, recurse on
+the trailing block.  Same flops (n^3/3), O(log n) distinct subproblem shapes
+(static shapes for XLA), and the lookahead/broadcast pipeline is recovered by
+XLA's scheduler + GSPMD collectives instead of a runtime.  The nb x nb base
+case delegates to XLA's Cholesky op exactly as the reference delegates the
+diagonal-tile factor to vendor LAPACK (internal_potrf.cc -> lapack::potrf).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..blas3.blas3 import _NB, _split, trsm_array
+from ..core.matrix import (
+    BaseMatrix,
+    HermitianBandMatrix,
+    HermitianMatrix,
+    TriangularMatrix,
+    band_project,
+    symmetrize,
+    tri_project,
+)
+from ..ops.matmul import matmul
+from ..types import Diag, Op, Options, Side, Uplo
+
+ArrayLike = Union[jax.Array, BaseMatrix]
+
+
+def _potrf_lower(a: jax.Array) -> jax.Array:
+    """Recursive lower Cholesky of a full Hermitian array; NaN-poisons on
+    non-SPD input (converted to an info code by the driver)."""
+    n = a.shape[0]
+    if n <= _NB:
+        return jax.lax.linalg.cholesky(a)
+    h = _split(n)
+    a11, a21, a22 = a[:h, :h], a[h:, :h], a[h:, h:]
+    l11 = _potrf_lower(a11)
+    # L21 = A21 * L11^-H  (solve X L11^H = A21)
+    l21 = trsm_array(Side.Right, Uplo.Lower, Op.ConjTrans, Diag.NonUnit, 1.0, l11, a21)
+    # trailing update: A22 - L21 L21^H (herk)
+    upd = matmul(l21, jnp.conj(l21).T)
+    l22 = _potrf_lower(a22 - upd.astype(a.dtype))
+    z = jnp.zeros((h, n - h), a.dtype)
+    return jnp.block([[l11, z], [l21, l22]])
+
+
+def potrf_array(a: jax.Array, uplo: Uplo = Uplo.Lower) -> Tuple[jax.Array, jax.Array]:
+    """Factor A = L L^H (or U^H U). ``a`` holds the uplo triangle (other
+    triangle ignored). Returns (factor triangle, info); info = 0 on success
+    else 1 + index of first non-positive pivot (src/potrf.cc:253-256)."""
+    full = symmetrize(a, uplo, conj=jnp.issubdtype(a.dtype, jnp.complexfloating))
+    l = _potrf_lower(full)
+    d = jnp.real(jnp.diagonal(l))
+    bad = ~(jnp.isfinite(d) & (d > 0))
+    info = jnp.where(jnp.any(bad), jnp.argmax(bad) + 1, 0).astype(jnp.int32)
+    l = tri_project(l, Uplo.Lower)
+    if uplo == Uplo.Upper:
+        return jnp.conj(l).T, info
+    return l, info
+
+
+def potrf(a: ArrayLike, opts: Optional[Options] = None):
+    """slate::potrf driver (src/potrf.cc:261)."""
+    if isinstance(a, BaseMatrix):
+        f, info = potrf_array(a.data, a.uplo)
+        return TriangularMatrix(data=f, uplo=a.uplo), info
+    f, info = potrf_array(jnp.asarray(a), Uplo.Lower)
+    return TriangularMatrix(data=f, uplo=Uplo.Lower), info
+
+
+def potrs_array(l: jax.Array, b: jax.Array, uplo: Uplo = Uplo.Lower) -> jax.Array:
+    """Solve A X = B given the Cholesky factor (src/potrs.cc)."""
+    if uplo == Uplo.Lower:
+        y = trsm_array(Side.Left, Uplo.Lower, Op.NoTrans, Diag.NonUnit, 1.0, l, b)
+        return trsm_array(Side.Left, Uplo.Lower, Op.ConjTrans, Diag.NonUnit, 1.0, l, y)
+    y = trsm_array(Side.Left, Uplo.Upper, Op.ConjTrans, Diag.NonUnit, 1.0, l, b)
+    return trsm_array(Side.Left, Uplo.Upper, Op.NoTrans, Diag.NonUnit, 1.0, l, y)
+
+
+def potrs(factor: TriangularMatrix, b: ArrayLike):
+    out = potrs_array(factor.data, b.array if isinstance(b, BaseMatrix) else jnp.asarray(b), factor.uplo)
+    if isinstance(b, BaseMatrix):
+        return replace(b, data=out)
+    return out
+
+
+def posv_array(a: jax.Array, b: jax.Array, uplo: Uplo = Uplo.Lower):
+    """Factor + solve (src/posv.cc). Returns (x, factor, info)."""
+    f, info = potrf_array(a, uplo)
+    x = potrs_array(f, b, uplo)
+    return x, f, info
+
+
+def posv(a: ArrayLike, b: ArrayLike, opts: Optional[Options] = None):
+    uplo = a.uplo if isinstance(a, BaseMatrix) else Uplo.Lower
+    ad = a.data if isinstance(a, BaseMatrix) else jnp.asarray(a)
+    bd = b.array if isinstance(b, BaseMatrix) else jnp.asarray(b)
+    x, f, info = posv_array(ad, bd, uplo)
+    if isinstance(b, BaseMatrix):
+        x = replace(b, data=x)
+    return x, TriangularMatrix(data=f, uplo=uplo), info
+
+
+def potri_array(l: jax.Array, uplo: Uplo = Uplo.Lower) -> jax.Array:
+    """A^-1 from the Cholesky factor (src/potri.cc): trtri then trtrm
+    (lauum-style triangle product)."""
+    from .tri import trtri_array, trtrm_array
+
+    linv = trtri_array(l, uplo, Diag.NonUnit)
+    if uplo == Uplo.Lower:
+        # A^-1 = L^-H L^-1: lower-stored result
+        return trtrm_array(linv, Uplo.Lower)
+    return trtrm_array(linv, Uplo.Upper)
+
+
+def potri(factor: TriangularMatrix):
+    inv = potri_array(factor.data, factor.uplo)
+    return HermitianMatrix(data=inv, uplo=factor.uplo)
+
+
+# ---------------------------------------------------------------------------
+# Band Cholesky (src/pbtrf.cc, pbtrs.cc, pbsv.cc)
+# ---------------------------------------------------------------------------
+
+
+def pbtrf_array(a: jax.Array, kd: int, uplo: Uplo = Uplo.Lower) -> Tuple[jax.Array, jax.Array]:
+    """Band Cholesky. The factor of a kd-band SPD matrix is kd-banded, so the
+    dense recursive factorization followed by a band projection is exact; the
+    band structure is exploited for storage/solves while the factorization
+    itself rides the dense MXU path (reference pbtrf works tile-band-wise,
+    src/pbtrf.cc)."""
+    kl, ku = (kd, 0) if uplo == Uplo.Lower else (0, kd)
+    f, info = potrf_array(band_project(a, kl, ku), uplo)
+    return band_project(f, kl, ku), info
+
+
+def pbtrs_array(f: jax.Array, b: jax.Array, kd: int, uplo: Uplo = Uplo.Lower) -> jax.Array:
+    return potrs_array(f, b, uplo)
+
+
+def pbsv_array(a: jax.Array, b: jax.Array, kd: int, uplo: Uplo = Uplo.Lower):
+    f, info = pbtrf_array(a, kd, uplo)
+    return pbtrs_array(f, b, kd, uplo), f, info
+
+
+def pbsv(a: HermitianBandMatrix, b: ArrayLike, opts: Optional[Options] = None):
+    bd = b.array if isinstance(b, BaseMatrix) else jnp.asarray(b)
+    x, f, info = pbsv_array(a.data, bd, a.kd, a.uplo)
+    if isinstance(b, BaseMatrix):
+        x = replace(b, data=x)
+    kl, ku = (a.kd, 0) if a.uplo == Uplo.Lower else (0, a.kd)
+    return x, TriangularBandMatrixFactory(f, a.uplo, a.kd), info
+
+
+def TriangularBandMatrixFactory(f, uplo, kd):
+    from ..core.matrix import TriangularBandMatrix
+
+    return TriangularBandMatrix.from_array(f, uplo, kd)

@@ -1,0 +1,333 @@
+"""LU family: getrf (partial pivot / no-pivot / tournament), getrs, gesv,
+getri, band gbtrf/gbtrs/gbsv.
+
+Analogues of reference drivers ``src/{getrf,getrf_nopiv,getrf_tntpiv,getrs,
+gesv,getri,gbtrf,gbtrs,gbsv}.cc`` and the panel kernels
+``src/internal/internal_getrf.cc`` + ``Tile_getrf.hh:169-417``.
+
+Design inversion (the hardest piece per SURVEY.md §7): the reference panel is
+a multithreaded pipeline — per column: thread-local max, cross-thread
+reduction, cross-rank MPI exchange, row swap, scale (Tile_getrf.hh) — and row
+swaps move single rows between ranks over MPI (internal_swap.cc).  On TPU:
+
+- the *panel* is an unblocked ``lax.fori_loop`` over columns with masked
+  argmax pivot search and full-row dynamic swaps — one traced program, no
+  latency-bound per-element dispatches;
+- the *outer* factorization is recursive (Toledo-style): factor the left
+  half, permute, triangular-solve for U12, one big gemm on the trailing
+  block, recurse — exact 2n^3/3 flops with O(log n) distinct shapes;
+- row swaps become gather/scatter permutations of whole row blocks (XLA
+  lowers these to efficient collective permutes when sharded), replacing
+  per-row MPI sends;
+- tournament pivoting (getrf_tntpiv, CALU) reduces pivot candidates through
+  a binary tree of small LUs — the communication-avoiding default for wide
+  meshes, mirroring internal_getrf_tntpiv.cc.
+
+Pivots are carried as a row-permutation vector ``perm`` (logical row i of
+PA = LU is original row perm[i]) — the functional equivalent of the
+reference's Pivots = vector<vector<Pivot>> (types.hh:64).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import NamedTuple, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..blas3.blas3 import _NB, _split, split_pow2, trsm_array
+from ..core.matrix import BaseMatrix, Matrix, band_project, tri_project
+from ..ops.matmul import matmul
+from ..types import Diag, MethodLU, Op, Option, Options, Side, Uplo, get_option
+
+ArrayLike = Union[jax.Array, BaseMatrix]
+
+_PANEL_W = 64  # unblocked panel width (reference ib, enums InnerBlocking)
+
+
+class LUFactors(NamedTuple):
+    """Packed LU: unit-lower L below diagonal, U on/above; perm applied to
+    rows (PA = LU); info = 1 + first zero pivot index, or 0."""
+
+    lu: jax.Array
+    perm: jax.Array
+    info: jax.Array
+
+
+# ---------------------------------------------------------------------------
+# Unblocked panel (Tile_getrf.hh analogue)
+# ---------------------------------------------------------------------------
+
+
+def _panel_lu(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Partial-pivot LU of an (m, w) panel, w small. Returns (lu, perm)."""
+    m, w = a.shape
+    rows = jnp.arange(m)
+
+    def step(j, carry):
+        a, perm = carry
+        col = jnp.abs(a[:, j])
+        col = jnp.where(rows >= j, col, -jnp.inf)
+        p = jnp.argmax(col)
+        rj, rp = a[j], a[p]
+        a = a.at[j].set(rp).at[p].set(rj)
+        pj, pp = perm[j], perm[p]
+        perm = perm.at[j].set(pp).at[p].set(pj)
+        piv = a[j, j]
+        denom = jnp.where(piv == 0, jnp.ones_like(piv), piv)
+        below = (rows > j).astype(a.dtype)
+        lcol = a[:, j] / denom * below
+        a = a.at[:, j].set(a[:, j] * (1 - below) + lcol)
+        cmask = (jnp.arange(w) > j).astype(a.dtype)
+        a = a - jnp.outer(lcol, a[j] * cmask)
+        return a, perm
+
+    a, perm = jax.lax.fori_loop(0, w, step, (a, jnp.arange(m)))
+    return a, perm
+
+
+# ---------------------------------------------------------------------------
+# Recursive blocked LU (partial pivoting)
+# ---------------------------------------------------------------------------
+
+
+def _getrf_rec(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Recursive LU of (m, n), m >= n. Returns (lu, perm)."""
+    m, n = a.shape
+    if n <= _PANEL_W:
+        return _panel_lu(a)
+    h = _split_panel(n)
+    lu1, p1 = _getrf_rec(a[:, :h])
+    a2 = a[:, h:][p1]
+    l11 = lu1[:h, :h]
+    u12 = trsm_array(Side.Left, Uplo.Lower, Op.NoTrans, Diag.Unit, 1.0, l11, a2[:h])
+    s = a2[h:] - matmul(lu1[h:, :h], u12).astype(a.dtype)
+    lu2, p2 = _getrf_rec(s)
+    l21 = lu1[h:, :h][p2]
+    top = jnp.concatenate([lu1[:h], u12.reshape(h, n - h)], axis=1)
+    bot = jnp.concatenate([l21, lu2], axis=1)
+    perm = jnp.concatenate([p1[:h], p1[h:][p2]])
+    return jnp.concatenate([top, bot], axis=0), perm
+
+
+def _split_panel(n: int) -> int:
+    return split_pow2(n, _PANEL_W)
+
+
+def _lu_info(lu: jax.Array) -> jax.Array:
+    d = jnp.diagonal(lu)
+    bad = (d == 0) | ~jnp.isfinite(d)
+    return jnp.where(jnp.any(bad), jnp.argmax(bad) + 1, 0).astype(jnp.int32)
+
+
+def getrf_array(a: jax.Array) -> LUFactors:
+    """Partial-pivot LU, PA = LU (src/getrf.cc)."""
+    lu, perm = _getrf_rec(a)
+    return LUFactors(lu, perm, _lu_info(lu))
+
+
+# ---------------------------------------------------------------------------
+# No-pivot LU (src/getrf_nopiv.cc) — structurally potrf-like
+# ---------------------------------------------------------------------------
+
+
+def _getrf_nopiv_rec(a: jax.Array) -> jax.Array:
+    n = min(a.shape)
+    if n <= _NB:
+        return _nopiv_base(a)
+    h = _split(n)
+    a11, a12, a21, a22 = a[:h, :h], a[:h, h:], a[h:, :h], a[h:, h:]
+    lu11 = _nopiv_base(a11) if h <= _NB else _getrf_nopiv_rec(a11)
+    u12 = trsm_array(Side.Left, Uplo.Lower, Op.NoTrans, Diag.Unit, 1.0, lu11, a12)
+    l21 = trsm_array(Side.Right, Uplo.Upper, Op.NoTrans, Diag.NonUnit, 1.0, lu11, a21)
+    s = a22 - matmul(l21, u12).astype(a.dtype)
+    lu22 = _getrf_nopiv_rec(s)
+    return jnp.block([[lu11, u12], [l21, lu22]])
+
+
+def _nopiv_base(a: jax.Array) -> jax.Array:
+    m, n = a.shape
+    rows = jnp.arange(m)
+
+    def step(j, a):
+        piv = a[j, j]
+        denom = jnp.where(piv == 0, jnp.ones_like(piv), piv)
+        below = (rows > j).astype(a.dtype)
+        lcol = a[:, j] / denom * below
+        a = a.at[:, j].set(a[:, j] * (1 - below) + lcol)
+        cmask = (jnp.arange(n) > j).astype(a.dtype)
+        return a - jnp.outer(lcol, a[j] * cmask)
+
+    return jax.lax.fori_loop(0, min(m, n), step, a)
+
+
+def getrf_nopiv_array(a: jax.Array) -> LUFactors:
+    lu = _getrf_nopiv_rec(a)
+    return LUFactors(lu, jnp.arange(a.shape[0]), _lu_info(lu))
+
+
+# ---------------------------------------------------------------------------
+# Tournament pivoting (CALU, src/getrf_tntpiv.cc + internal_getrf_tntpiv.cc)
+# ---------------------------------------------------------------------------
+
+
+def _tournament_pivots(panel: jax.Array, w: int) -> jax.Array:
+    """Select w pivot row indices via a binary reduction tree of small LUs
+    (communication-avoiding: one tree round replaces per-column exchanges).
+    Returns indices into panel rows, best rows first."""
+    m = panel.shape[0]
+    block = max(2 * w, _PANEL_W)
+    nblk = -(-m // block)
+    pad = nblk * block - m
+    ap = jnp.pad(panel, ((0, pad), (0, 0)))
+    idx = jnp.pad(jnp.arange(m), (0, pad), constant_values=m)  # pad rows sort last
+    cand_a = ap.reshape(nblk, block, w)
+    cand_i = idx.reshape(nblk, block)
+
+    def local_top(a_blk, i_blk):
+        lu, p = _panel_lu(a_blk)
+        return a_blk[p][:w], i_blk[p][:w]
+
+    tops_a, tops_i = jax.vmap(local_top)(cand_a, cand_i)
+    while tops_a.shape[0] > 1:
+        k = tops_a.shape[0]
+        if k % 2 == 1:  # odd: carry last block through
+            tops_a = jnp.concatenate([tops_a, tops_a[-1:] * 0], axis=0)
+            tops_i = jnp.concatenate([tops_i, jnp.full_like(tops_i[-1:], m)], axis=0)
+            k += 1
+        pa = tops_a.reshape(k // 2, 2 * w, w)
+        pi = tops_i.reshape(k // 2, 2 * w)
+        tops_a, tops_i = jax.vmap(local_top)(pa, pi)
+    return tops_i[0]
+
+
+def getrf_tntpiv_array(a: jax.Array, nb: int = _NB) -> LUFactors:
+    """Blocked LU with tournament pivoting per panel.  Within a panel, the
+    tournament tree picks w pivot rows which are swapped to the top, then the
+    panel factors without further pivoting (getrf_tntpiv.cc:18-169)."""
+    m, n = a.shape
+    perm = jnp.arange(m)
+    nb = min(nb, _PANEL_W)
+    out = a
+    # Python loop over panels: shapes shrink but repeat across calls of same
+    # (m, n, nb); masked single-program form is the round-2 optimization.
+    for k in range(0, min(m, n), nb):
+        w = min(nb, n - k, m - k)
+        panel = out[k:, k : k + w]
+        piv = _tournament_pivots(panel, w)
+        # build full row order for the trailing block: selected rows first
+        rest_mask = jnp.ones(panel.shape[0], dtype=bool).at[piv].set(False)
+        order = jnp.concatenate([piv, jnp.where(rest_mask, size=panel.shape[0] - w)[0]])
+        out = out.at[k:].set(out[k:][order])
+        perm = perm.at[k:].set(perm[k:][order])
+        # no-pivot factor of the pivoted panel + trailing update
+        blk = _nopiv_base(out[k:, k : k + w])
+        out = out.at[k:, k : k + w].set(blk)
+        if k + w < n:
+            l11 = blk[:w, :w]
+            u12 = trsm_array(Side.Left, Uplo.Lower, Op.NoTrans, Diag.Unit, 1.0, l11, out[k : k + w, k + w :])
+            out = out.at[k : k + w, k + w :].set(u12)
+            upd = matmul(blk[w:, :w], u12).astype(a.dtype)
+            out = out.at[k + w :, k + w :].add(-upd)
+    return LUFactors(out, perm, _lu_info(out))
+
+
+# ---------------------------------------------------------------------------
+# Solves / drivers
+# ---------------------------------------------------------------------------
+
+
+def getrs_array(f: LUFactors, b: jax.Array, op: Op = Op.NoTrans) -> jax.Array:
+    """Solve op(A) X = B from factors (src/getrs.cc)."""
+    lu, perm = f.lu, f.perm
+    n = lu.shape[0]
+    if op == Op.NoTrans:
+        pb = b[perm]
+        y = trsm_array(Side.Left, Uplo.Lower, Op.NoTrans, Diag.Unit, 1.0, lu, pb)
+        return trsm_array(Side.Left, Uplo.Upper, Op.NoTrans, Diag.NonUnit, 1.0, lu, y)
+    # op(A) = A^T or A^H: solve U^op y = b; L^op z = y; x = P^T z
+    y = trsm_array(Side.Left, Uplo.Upper, op, Diag.NonUnit, 1.0, lu, b)
+    z = trsm_array(Side.Left, Uplo.Lower, op, Diag.Unit, 1.0, lu, y)
+    inv = jnp.argsort(perm)
+    return z[inv]
+
+
+def gesv_array(a: jax.Array, b: jax.Array, method: MethodLU = MethodLU.PartialPiv):
+    """Factor + solve (src/gesv.cc). Returns (x, factors)."""
+    if method == MethodLU.PartialPiv:
+        f = getrf_array(a)
+    elif method == MethodLU.CALU:
+        f = getrf_tntpiv_array(a)
+    elif method == MethodLU.NoPiv:
+        f = getrf_nopiv_array(a)
+    elif method == MethodLU.RBT:
+        from .rbt import gesv_rbt_array
+
+        return gesv_rbt_array(a, b)
+    else:
+        raise ValueError(method)
+    return getrs_array(f, b), f
+
+
+def getri_array(f: LUFactors) -> jax.Array:
+    """Matrix inverse from factors (src/getri.cc): A^-1 = U^-1 L^-1 P."""
+    from .tri import trtri_array
+
+    uinv = trtri_array(tri_project(f.lu, Uplo.Upper), Uplo.Upper, Diag.NonUnit)
+    linv = trtri_array(tri_project(f.lu, Uplo.Lower, Diag.Unit), Uplo.Lower, Diag.Unit)
+    x = matmul(uinv, linv).astype(f.lu.dtype)
+    # A^-1 = (U^-1 L^-1) P; right-multiplying by P permutes columns by
+    # perm^-1 since (X P)[i, j] = X[i, perm^-1(j)]
+    return x[:, jnp.argsort(f.perm)]
+
+
+# object-level drivers -------------------------------------------------------
+
+
+def getrf(a: ArrayLike, opts: Optional[Options] = None) -> Tuple[Matrix, LUFactors]:
+    ad = a.array if isinstance(a, BaseMatrix) else jnp.asarray(a)
+    method = get_option(opts, Option.MethodLU, MethodLU.PartialPiv)
+    if method == MethodLU.CALU:
+        f = getrf_tntpiv_array(ad)
+    elif method == MethodLU.NoPiv:
+        f = getrf_nopiv_array(ad)
+    else:
+        f = getrf_array(ad)
+    return Matrix(data=f.lu), f
+
+
+def gesv(a: ArrayLike, b: ArrayLike, opts: Optional[Options] = None):
+    ad = a.array if isinstance(a, BaseMatrix) else jnp.asarray(a)
+    bd = b.array if isinstance(b, BaseMatrix) else jnp.asarray(b)
+    method = get_option(opts, Option.MethodLU, MethodLU.PartialPiv)
+    x, f = gesv_array(ad, bd, method)
+    if isinstance(b, BaseMatrix):
+        x = replace(b, data=x)
+    return x, f
+
+
+# ---------------------------------------------------------------------------
+# Band LU (src/gbtrf.cc, gbtrs.cc, gbsv.cc)
+# ---------------------------------------------------------------------------
+
+
+def gbtrf_array(a: jax.Array, kl: int, ku: int) -> LUFactors:
+    """Band LU with partial pivoting. Pivoting widens U's band to kl + ku
+    (LAPACK gbtrf semantics), so U is projected to that band; L's multiplier
+    columns have at most kl nonzeros each but pivoting scatters them to
+    arbitrary rows (Golub & Van Loan band-LU), so the strictly-lower part is
+    kept dense — projecting it would corrupt the factorization."""
+    f = getrf_array(band_project(a, kl, ku))
+    l_part = tri_project(f.lu, Uplo.Lower, Diag.Unit) - jnp.eye(*f.lu.shape, dtype=f.lu.dtype)
+    u_part = band_project(tri_project(f.lu, Uplo.Upper), 0, kl + ku)
+    return LUFactors(l_part + u_part, f.perm, f.info)
+
+
+def gbtrs_array(f: LUFactors, b: jax.Array, kl: int, ku: int, op: Op = Op.NoTrans) -> jax.Array:
+    return getrs_array(f, b, op)
+
+
+def gbsv_array(a: jax.Array, b: jax.Array, kl: int, ku: int):
+    f = gbtrf_array(a, kl, ku)
+    return gbtrs_array(f, b, kl, ku), f

@@ -1,0 +1,226 @@
+"""Mixed-precision iterative refinement: classic IR and GMRES-IR.
+
+Analogues of ``src/{gesv_mixed,gesv_mixed_gmres,posv_mixed,
+posv_mixed_gmres}.cc``.  The reference factors in FP32 and refines in FP64
+(gesv_mixed.cc:16-44); that maps *natively* onto TPU where f32 (and bf16)
+matmuls ride the MXU at full rate while f64 is emulated — mixed precision is
+the performance path, not an option, so these drivers are first-class here.
+
+Generic over a (factor, solve) pair so LU and Cholesky share the loop; the
+convergence gate mirrors the reference: stop when the residual satisfies
+``||r|| <= ||x|| * ||A|| * eps * sqrt(n) * stesp`` and fall back to the full
+high-precision solver after max_iter failures when UseFallbackSolver is set.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.matrix import symmetrize
+from ..ops.matmul import matmul
+from ..ops.tile_ops import genorm
+from ..types import Norm, Option, Options, Uplo, get_option
+
+Array = jax.Array
+
+
+def _refine_loop(
+    a_hi: Array,
+    b: Array,
+    lo_solve: Callable[[Array], Array],
+    max_iter: int,
+    tol_factor: float = 1.0,
+) -> Tuple[Array, Array, Array]:
+    """Classic iterative refinement. Returns (x, iters, converged)."""
+    n = a_hi.shape[0]
+    eps = jnp.finfo(a_hi.dtype).eps
+    anorm = genorm(Norm.Inf, a_hi)
+    cte = anorm * eps * jnp.sqrt(jnp.asarray(float(n), a_hi.dtype)) * tol_factor
+
+    x = lo_solve(b).astype(a_hi.dtype)
+
+    def cond(state):
+        x, r, it, done = state
+        return (~done) & (it < max_iter)
+
+    def body(state):
+        x, r, it, _ = state
+        d = lo_solve(r).astype(a_hi.dtype)
+        x = x + d
+        r = b - matmul(a_hi, x).astype(b.dtype)
+        xnorm = genorm(Norm.Inf, x)
+        rnorm = genorm(Norm.Inf, r)
+        done = rnorm <= xnorm * cte
+        return x, r, it + 1, done
+
+    r0 = b - matmul(a_hi, x).astype(b.dtype)
+    done0 = genorm(Norm.Inf, r0) <= genorm(Norm.Inf, x) * cte
+    x, r, iters, done = jax.lax.while_loop(cond, body, (x, r0, jnp.int32(0), done0))
+    return x, iters, done
+
+
+def _fallback(done, x, iters, full_solve):
+    """Run the full high-precision solver only on non-convergence.  Eagerly,
+    ``bool(done)`` is concrete and the expensive path is skipped entirely;
+    under jit it falls back to lax.cond (one branch *executes*)."""
+    try:
+        if bool(done):
+            return x, iters
+        return full_solve(), jnp.asarray(-1, iters.dtype)
+    except jax.errors.TracerBoolConversionError:
+        return jax.lax.cond(
+            done,
+            lambda: (x, iters),
+            lambda: (full_solve(), jnp.asarray(-1, iters.dtype)),
+        )
+
+
+def gesv_mixed_array(
+    a: Array, b: Array, opts: Optional[Options] = None
+) -> Tuple[Array, Array, Array]:
+    """FP32-factor + high-precision-refine LU solve (src/gesv_mixed.cc).
+    Returns (x, iters, converged); on non-convergence with fallback enabled
+    the result is the full-precision solve and iters = -1."""
+    from .lu import gesv_array, getrf_array, getrs_array
+
+    lo_dtype = jnp.complex64 if jnp.issubdtype(a.dtype, jnp.complexfloating) else jnp.float32
+    max_iter = get_option(opts, Option.MaxIterations, 30)
+    f32 = getrf_array(a.astype(lo_dtype))
+    solve = lambda rhs: getrs_array(f32, rhs.astype(lo_dtype))
+    x, iters, done = _refine_loop(a, b, solve, max_iter)
+    if get_option(opts, Option.UseFallbackSolver, True):
+        x, iters = _fallback(done, x, iters, lambda: gesv_array(a, b)[0])
+    return x, iters, done
+
+
+def posv_mixed_array(
+    a: Array, b: Array, uplo: Uplo = Uplo.Lower, opts: Optional[Options] = None
+) -> Tuple[Array, Array, Array]:
+    """src/posv_mixed.cc analogue."""
+    from .chol import posv_array, potrf_array, potrs_array
+
+    lo_dtype = jnp.complex64 if jnp.issubdtype(a.dtype, jnp.complexfloating) else jnp.float32
+    max_iter = get_option(opts, Option.MaxIterations, 30)
+    f32, _ = potrf_array(a.astype(lo_dtype), uplo)
+    solve = lambda rhs: potrs_array(f32, rhs.astype(lo_dtype), uplo)
+    conj = jnp.issubdtype(a.dtype, jnp.complexfloating)
+    a_full = symmetrize(a, uplo, conj=conj)
+    x, iters, done = _refine_loop(a_full, b, solve, max_iter)
+    if get_option(opts, Option.UseFallbackSolver, True):
+        x, iters = _fallback(done, x, iters, lambda: posv_array(a, b, uplo)[0])
+    return x, iters, done
+
+
+# ---------------------------------------------------------------------------
+# GMRES-IR (src/gesv_mixed_gmres.cc, 409 LoC; posv_mixed_gmres.cc)
+# ---------------------------------------------------------------------------
+
+
+def _gmres(
+    matvec: Callable[[Array], Array],
+    precond: Callable[[Array], Array],
+    b: Array,
+    x0: Array,
+    restart: int,
+    tol: Array,
+    max_restarts: int,
+) -> Tuple[Array, Array]:
+    """Left-preconditioned restarted GMRES on a single RHS vector.
+
+    Static-shape Arnoldi: the Krylov basis lives in a fixed (restart+1, n)
+    buffer inside ``lax.fori_loop`` — the XLA-friendly form of the
+    reference's dynamic rotation loop (gesv_mixed_gmres.cc)."""
+    n = b.shape[0]
+    dtype = b.dtype
+    m = restart
+
+    def restart_body(rs, carry):
+        x, _ = carry
+        r = precond(b - matvec(x))
+        beta = jnp.linalg.norm(r)
+        v0 = r / jnp.where(beta == 0, 1, beta)
+        V = jnp.zeros((m + 1, n), dtype).at[0].set(v0)
+        H = jnp.zeros((m + 1, m), dtype)
+
+        def arnoldi(j, vh):
+            V, H = vh
+            w = precond(matvec(V[j]))
+            # modified Gram-Schmidt against all m+1 rows (rows > j are zero)
+            h = matmul(jnp.conj(V), w[:, None])[:, 0]
+            mask = (jnp.arange(m + 1) <= j).astype(dtype)
+            h = h * mask
+            w = w - matmul(h[None, :], V)[0]
+            hn = jnp.linalg.norm(w)
+            H = H.at[:, j].set(h + 0).at[j + 1, j].set(hn.astype(dtype))
+            V = V.at[j + 1].set(w / jnp.where(hn == 0, 1, hn))
+            return V, H
+
+        V, H = jax.lax.fori_loop(0, m, arnoldi, (V, H))
+        # solve least squares min || beta e1 - H y ||
+        e1 = jnp.zeros(m + 1, dtype).at[0].set(beta.astype(dtype))
+        y = jnp.linalg.lstsq(H, e1)[0]
+        x = x + matmul(y[None, :], V[:m])[0]
+        rnorm = jnp.linalg.norm(precond(b - matvec(x)))
+        return x, rnorm
+
+    x, rnorm = x0, jnp.asarray(jnp.inf, jnp.real(b).dtype)
+    x, rnorm = jax.lax.fori_loop(
+        0, max_restarts, lambda i, c: jax.lax.cond(c[1] > tol, lambda cc: restart_body(i, cc), lambda cc: cc, c),
+        (x, rnorm),
+    )
+    return x, rnorm
+
+
+def gesv_mixed_gmres_array(
+    a: Array, b: Array, opts: Optional[Options] = None, restart: int = 30
+) -> Tuple[Array, Array]:
+    """GMRES-IR: low-precision LU as preconditioner for high-precision GMRES
+    (src/gesv_mixed_gmres.cc). b may be (n,) or (n, 1). Returns (x, resid)."""
+    from .lu import getrf_array, getrs_array
+
+    lo_dtype = jnp.complex64 if jnp.issubdtype(a.dtype, jnp.complexfloating) else jnp.float32
+    f = getrf_array(a.astype(lo_dtype))
+    precond = lambda v: getrs_array(f, v.astype(lo_dtype)[:, None])[:, 0].astype(a.dtype)
+    matvec = lambda v: matmul(a, v[:, None])[:, 0].astype(a.dtype)
+    return _gmres_multi_rhs(
+        a, b, matvec, precond, restart, get_option(opts, Option.MaxIterations, 30)
+    )
+
+
+def posv_mixed_gmres_array(
+    a: Array, b: Array, uplo: Uplo = Uplo.Lower, opts: Optional[Options] = None, restart: int = 30
+) -> Tuple[Array, Array]:
+    """src/posv_mixed_gmres.cc analogue."""
+    from .chol import potrf_array, potrs_array
+
+    lo_dtype = jnp.complex64 if jnp.issubdtype(a.dtype, jnp.complexfloating) else jnp.float32
+    conj = jnp.issubdtype(a.dtype, jnp.complexfloating)
+    a_full = symmetrize(a, uplo, conj=conj)
+    f, _ = potrf_array(a.astype(lo_dtype), uplo)
+    precond = lambda v: potrs_array(f, v.astype(lo_dtype)[:, None], uplo)[:, 0].astype(a.dtype)
+    matvec = lambda v: matmul(a_full, v[:, None])[:, 0].astype(a.dtype)
+    return _gmres_multi_rhs(
+        a, b, matvec, precond, restart, get_option(opts, Option.MaxIterations, 30)
+    )
+
+
+def _gmres_multi_rhs(a, b, matvec, precond, restart, max_restarts):
+    """Solve each RHS column with _gmres; returns (x like b, worst resid)."""
+    eps = jnp.finfo(a.dtype).eps
+    rdtype = jnp.real(a).dtype
+    scale = jnp.sqrt(jnp.asarray(float(a.shape[0]), rdtype)) * eps
+
+    def one(bv):
+        tol = (scale * jnp.linalg.norm(bv)).astype(rdtype)
+        return _gmres(matvec, precond, bv, jnp.zeros_like(bv), restart, tol, max_restarts)
+
+    if b.ndim == 1:
+        return one(b)
+    cols = [one(b[:, j]) for j in range(b.shape[1])]
+    x = jnp.stack([c[0] for c in cols], axis=1)
+    rnorm = jnp.max(jnp.stack([c[1] for c in cols]))
+    return x, rnorm

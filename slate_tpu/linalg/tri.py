@@ -1,0 +1,53 @@
+"""Triangular inverse (trtri) and triangle-triangle multiply (trtrm).
+
+Analogues of ``src/trtri.cc`` / ``src/internal/internal_trtri.cc`` and
+``src/trtrm.cc`` / ``internal_trtrm.cc`` (LAPACK lauum-style).  Recursive
+blocked, exact flops, O(log n) shapes — same scheme as chol.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..blas3.blas3 import _NB, _split, trsm_array
+from ..core.matrix import tri_project
+from ..ops.matmul import matmul
+from ..types import Diag, Op, Side, Uplo
+
+
+def _trtri_lower(a: jax.Array, diag: Diag) -> jax.Array:
+    """Invert lower triangle recursively:
+    inv([[A11, 0], [A21, A22]]) = [[A11^-1, 0], [-A22^-1 A21 A11^-1, A22^-1]]."""
+    n = a.shape[0]
+    if n <= _NB:
+        eye = jnp.eye(n, dtype=a.dtype)
+        return jax.lax.linalg.triangular_solve(
+            a, eye, left_side=True, lower=True, unit_diagonal=(diag == Diag.Unit)
+        )
+    h = _split(n)
+    a11, a21, a22 = a[:h, :h], a[h:, :h], a[h:, h:]
+    i11 = _trtri_lower(a11, diag)
+    i22 = _trtri_lower(a22, diag)
+    i21 = -matmul(matmul(i22, a21), i11).astype(a.dtype)
+    z = jnp.zeros((h, n - h), a.dtype)
+    return jnp.block([[i11, z], [i21, i22]])
+
+
+def trtri_array(a: jax.Array, uplo: Uplo = Uplo.Lower, diag: Diag = Diag.NonUnit) -> jax.Array:
+    """slate::trtri (src/trtri.cc)."""
+    if uplo == Uplo.Upper:
+        return _trtri_lower(a.T, diag).T
+    return _trtri_lower(a, diag)
+
+
+def trtrm_array(t: jax.Array, uplo: Uplo = Uplo.Lower) -> jax.Array:
+    """slate::trtrm (src/trtrm.cc): compute T^H T (lower) or T T^H (upper)
+    where T is the uplo triangle — the lauum step of potri. Result is
+    Hermitian; the uplo triangle of the product is returned."""
+    tt = tri_project(t, uplo)
+    if uplo == Uplo.Lower:
+        prod = matmul(jnp.conj(tt).T, tt)
+    else:
+        prod = matmul(tt, jnp.conj(tt).T)
+    return tri_project(prod.astype(t.dtype), uplo)

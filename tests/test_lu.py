@@ -1,0 +1,163 @@
+"""LU family tests — backward-error gates mirroring test/test_gesv.cc,
+test_getri.cc, test_gbsv.cc; pivot-growth checks for tntpiv/nopiv/rbt."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from slate_tpu.linalg import (
+    gbsv_array,
+    gesv_array,
+    gesv_mixed_array,
+    gesv_mixed_gmres_array,
+    gesv_rbt_array,
+    getrf_array,
+    getrf_nopiv_array,
+    getrf_tntpiv_array,
+    getri_array,
+    getrs_array,
+)
+from slate_tpu.types import MethodLU, Op
+from slate_tpu.utils.testing import generate
+
+
+def _check_lu(a, f, rtol=1e-13):
+    lu, perm = np.asarray(f.lu), np.asarray(f.perm)
+    n = min(a.shape)
+    l = np.tril(lu, -1)[:, :n] + np.eye(a.shape[0], n)
+    u = np.triu(lu)[:n]
+    pa = a[perm]
+    resid = np.abs(l @ u - pa).max()
+    assert resid / (np.abs(a).max() * n) < rtol, resid
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_getrf(dtype):
+    a = generate("rands", 90, 90, dtype, seed=1)
+    f = getrf_array(jnp.asarray(a))
+    assert int(f.info) == 0
+    _check_lu(a, f)
+    # partial pivoting: |L| <= 1
+    assert np.abs(np.tril(np.asarray(f.lu), -1)).max() <= 1 + 1e-12
+
+
+def test_getrf_rectangular():
+    a = generate("rands", 120, 70, np.float64, seed=2)
+    f = getrf_array(jnp.asarray(a))
+    _check_lu(a, f)
+
+
+def test_getrf_large():
+    a = generate("rands", 500, 500, np.float64, seed=3)
+    f = getrf_array(jnp.asarray(a))
+    _check_lu(a, f)
+
+
+def test_gesv():
+    n, nrhs = 100, 4
+    a = generate("rands", n, n, np.float64, seed=4)
+    b = generate("rands", n, nrhs, np.float64, seed=5)
+    x, f = gesv_array(jnp.asarray(a), jnp.asarray(b))
+    resid = np.abs(a @ np.asarray(x) - b).max()
+    assert resid / (np.abs(a).sum(1).max() * np.abs(x).max()) < 1e-13
+
+
+def test_getrs_trans():
+    n = 50
+    a = generate("rands", n, n, np.complex128, seed=6)
+    b = generate("rands", n, 2, np.complex128, seed=7)
+    f = getrf_array(jnp.asarray(a))
+    xt = getrs_array(f, jnp.asarray(b), Op.Trans)
+    np.testing.assert_allclose(a.T @ np.asarray(xt), b, atol=1e-10)
+    xh = getrs_array(f, jnp.asarray(b), Op.ConjTrans)
+    np.testing.assert_allclose(a.conj().T @ np.asarray(xh), b, atol=1e-10)
+
+
+def test_getrf_nopiv():
+    a = generate("dominant", 80, 80, np.float64, seed=8)
+    f = getrf_nopiv_array(jnp.asarray(a))
+    lu = np.asarray(f.lu)
+    l = np.tril(lu, -1) + np.eye(80)
+    u = np.triu(lu)
+    assert np.abs(l @ u - a).max() / np.abs(a).max() < 1e-12
+
+
+def test_getrf_tntpiv():
+    a = generate("rands", 200, 200, np.float64, seed=9)
+    f = getrf_tntpiv_array(jnp.asarray(a))
+    assert int(f.info) == 0
+    _check_lu(a, f, rtol=1e-11)  # tournament: bounded but larger growth
+    x = getrs_array(f, jnp.asarray(generate("rands", 200, 1, np.float64, seed=10)))
+    assert np.isfinite(np.asarray(x)).all()
+
+
+def test_getri():
+    n = 60
+    a = generate("rands", n, n, np.float64, seed=11)
+    f = getrf_array(jnp.asarray(a))
+    inv = np.asarray(getri_array(f))
+    np.testing.assert_allclose(inv @ a, np.eye(n), atol=1e-10)
+
+
+def test_gesv_rbt():
+    n = 64
+    a = generate("rands", n, n, np.float64, seed=12) + 2 * np.eye(n)
+    b = generate("rands", n, 1, np.float64, seed=13)
+    x, f = gesv_rbt_array(jnp.asarray(a), jnp.asarray(b))
+    resid = np.abs(a @ np.asarray(x) - b).max()
+    assert resid / np.abs(b).max() < 1e-10
+
+
+def test_gesv_rbt_nonpow2():
+    n = 50  # padding path
+    a = generate("rands", n, n, np.float64, seed=14) + 2 * np.eye(n)
+    b = generate("rands", n, 2, np.float64, seed=15)
+    x, f = gesv_rbt_array(jnp.asarray(a), jnp.asarray(b))
+    assert np.abs(a @ np.asarray(x) - b).max() / np.abs(b).max() < 1e-9
+
+
+def test_gesv_mixed():
+    n = 100
+    a = generate("rands", n, n, np.float64, seed=16) + n * np.eye(n)
+    b = generate("rands", n, 1, np.float64, seed=17)
+    x, iters, done = gesv_mixed_array(jnp.asarray(a), jnp.asarray(b))
+    assert bool(done)
+    assert int(iters) >= 0
+    assert np.abs(a @ np.asarray(x) - b).max() / np.abs(b).max() < 1e-12
+
+
+def test_gesv_mixed_gmres():
+    n = 80
+    a = generate("rands", n, n, np.float64, seed=18) + n * np.eye(n)
+    b = generate("rands", n, 1, np.float64, seed=19)[:, 0]
+    x, rnorm = gesv_mixed_gmres_array(jnp.asarray(a), jnp.asarray(b))
+    assert np.abs(a @ np.asarray(x) - b).max() / np.abs(b).max() < 1e-10
+
+
+@pytest.mark.parametrize("dominant", [True, False])
+def test_gbsv(dominant):
+    # non-dominant case forces real pivoting: L multipliers scatter outside
+    # the kl band and must NOT be projected away (review-found bug)
+    n, kl, ku = 70, 3, 2
+    rng = np.random.default_rng(20)
+    ab = np.zeros((n, n))
+    for d in range(-kl, ku + 1):
+        ab += np.diag(rng.standard_normal(n - abs(d)), d)
+    if dominant:
+        ab += 10 * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    x, f = gbsv_array(jnp.asarray(ab), jnp.asarray(b), kl, ku)
+    resid = np.abs(ab @ np.asarray(x) - b).max()
+    assert resid / (np.abs(ab).sum(1).max() * max(np.abs(x).max(), 1)) < 1e-12
+    # U band stays within kl+ku
+    u = np.triu(np.asarray(f.lu))
+    assert np.abs(np.triu(u, kl + ku + 1)).max() == 0
+
+
+def test_gesv_mixed_gmres_multirhs():
+    n = 40
+    a = generate("rands", n, n, np.float64, seed=21) + n * np.eye(n)
+    b = generate("rands", n, 3, np.float64, seed=22)
+    x, rnorm = gesv_mixed_gmres_array(jnp.asarray(a), jnp.asarray(b))
+    assert np.asarray(x).shape == (n, 3)
+    assert np.abs(a @ np.asarray(x) - b).max() / np.abs(b).max() < 1e-10

@@ -1,0 +1,99 @@
+"""QR/LQ/least-squares tests — orthogonality + residual gates mirroring
+test/test_geqrf.cc, test_gelqf.cc, test_unmqr.cc, test_gels.cc."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from slate_tpu.linalg.qr import (
+    cholqr_array,
+    gelqf_array,
+    gelqf_l,
+    gels_array,
+    gels_cholqr_array,
+    gels_qr_array,
+    geqrf_array,
+    geqrf_q,
+    geqrf_r,
+    unmlq_array,
+    unmqr_array,
+)
+from slate_tpu.types import Op, Side
+from slate_tpu.utils.testing import generate
+
+
+def _check_qr(a, f, tol=1e-12):
+    m, n = a.shape
+    q = np.asarray(geqrf_q(f))
+    r = np.asarray(geqrf_r(f))
+    k = min(m, n)
+    assert np.abs(q.conj().T @ q - np.eye(k)).max() < tol * m
+    assert np.abs(q @ r - a).max() / max(np.abs(a).max(), 1) < tol * m
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("shape", [(60, 40), (40, 40), (200, 90)])
+def test_geqrf(dtype, shape):
+    a = generate("rands", *shape, dtype=dtype, seed=1)
+    _check_qr(a, geqrf_array(jnp.asarray(a)))
+
+
+def test_geqrf_large_recursive():
+    a = generate("rands", 300, 150, dtype=np.float64, seed=2)
+    _check_qr(a, geqrf_array(jnp.asarray(a)))
+
+
+def test_unmqr_right_side():
+    m, n, k = 50, 30, 20
+    a = generate("rands", m, n, np.complex128, seed=3)
+    c = generate("rands", k, m, np.complex128, seed=4)
+    f = geqrf_array(jnp.asarray(a))
+    q = np.asarray(geqrf_q(f, full=True))
+    out = np.asarray(unmqr_array(Side.Right, Op.NoTrans, f, jnp.asarray(c)))
+    np.testing.assert_allclose(out, c @ q, atol=1e-10)
+    outh = np.asarray(unmqr_array(Side.Right, Op.ConjTrans, f, jnp.asarray(c)))
+    np.testing.assert_allclose(outh, c @ q.conj().T, atol=1e-10)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_gelqf(dtype):
+    m, n = 40, 70
+    a = generate("rands", m, n, dtype, seed=5)
+    f = gelqf_array(jnp.asarray(a))
+    l = np.asarray(gelqf_l(f))
+    # Q rows orthonormal: reconstruct via applying Q^H to [L 0] padded
+    eye = jnp.eye(n, dtype=f.lv.dtype)
+    q = np.asarray(unmlq_array(Side.Left, Op.NoTrans, f, eye))[:n]
+    lq = np.zeros((m, n), dtype=np.asarray(f.lv).dtype)
+    lq[:, :m] = l
+    np.testing.assert_allclose(lq @ q, a, atol=1e-10)
+    np.testing.assert_allclose(q @ q.conj().T, np.eye(n), atol=1e-10)
+
+
+def test_cholqr():
+    a = generate("rands", 120, 30, np.float64, seed=6)
+    q, r = cholqr_array(jnp.asarray(a))
+    qn, rn = np.asarray(q), np.asarray(r)
+    assert np.abs(qn.T @ qn - np.eye(30)).max() < 1e-9
+    np.testing.assert_allclose(qn @ rn, a, atol=1e-10)
+
+
+def test_gels_overdetermined():
+    m, n = 100, 40
+    a = generate("rands", m, n, np.float64, seed=7)
+    b = generate("rands", m, 3, np.float64, seed=8)
+    x = np.asarray(gels_qr_array(jnp.asarray(a), jnp.asarray(b)))
+    xref = np.linalg.lstsq(a, b, rcond=None)[0]
+    np.testing.assert_allclose(x, xref, atol=1e-9)
+    x2 = np.asarray(gels_cholqr_array(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(x2, xref, atol=1e-8)
+
+
+def test_gels_underdetermined():
+    m, n = 30, 80
+    a = generate("rands", m, n, np.float64, seed=9)
+    b = generate("rands", m, 2, np.float64, seed=10)
+    x = np.asarray(gels_array(jnp.asarray(a), jnp.asarray(b)))
+    xref = np.linalg.lstsq(a, b, rcond=None)[0]  # minimum-norm solution
+    np.testing.assert_allclose(a @ x, b, atol=1e-10)
+    np.testing.assert_allclose(x, xref, atol=1e-9)
