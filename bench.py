@@ -1,0 +1,86 @@
+"""Headline benchmark: DGEMM (f64) GFLOP/s per chip.
+
+Mirrors the reference tester's gemm benchmark (test/test_gemm.cc:217-245,
+gflop formula blas::Gflop<double>::gemm = 2mnk / time) on the driver's
+north-star config (BASELINE.json: DGEMM FP64 GFLOPS/chip).  Residual-checked
+before timing, like the tester's `check` mode (test_gemm.cc:248-260).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline: ratio to 19,500 GFLOP/s — the FP64 tensor-core peak of the
+A100 GPUs SLATE-CUDA runs on (its large-n DGEMM approaches peak), since the
+reference repo publishes no numbers (BASELINE.md).  TPU f64 is software-
+emulated (no native f64 MXU path), so this ratio is the honest cross-ISA
+comparison the driver asks for.
+
+Timing notes: iterations run inside one jitted lax.fori_loop with per-iter
+input perturbation — the execution tunnel caches identical dispatches and
+per-call host round-trips cost ~0.5 s, so naive per-call timing is wrong.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+BASELINE_GFLOPS = 19500.0  # A100 FP64 TC peak ~ SLATE-CUDA DGEMM/device
+N = 8192  # v5e: 16G HBM; f64 emulation temporaries cap the size
+ITERS = 3
+
+
+def main():
+    from slate_tpu.ops.matmul import matmul
+
+    dtype = jnp.float64
+    metric = f"dgemm_f64_gflops_n{N}"
+    try:
+        jnp.zeros((2, 2), dtype) @ jnp.zeros((2, 2), dtype)
+    except Exception:
+        dtype = jnp.float32  # platform without x64: report f32 instead
+        metric = f"gemm_f32_gflops_n{N}"
+
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (N, N), jnp.float32).astype(dtype)
+    b = jax.random.normal(jax.random.PRNGKey(1), (N, N), jnp.float32).astype(dtype)
+
+    # correctness gate (small block residual vs numpy, 3-eps style)
+    m = 256
+    chk = np.asarray(matmul(a[:m, :m], b[:m, :m]))
+    ref = np.asarray(a[:m, :m], np.float64) @ np.asarray(b[:m, :m], np.float64)
+    rel = np.abs(chk - ref).max() / max(np.abs(ref).max(), 1e-30)
+    eps = np.finfo(np.asarray(chk).dtype).eps
+    assert rel < 50 * m * eps, f"gemm residual {rel} too large"
+
+    @jax.jit
+    def run(a, b):
+        def body(i, acc):
+            # perturb input per iteration so no two dots share operands
+            c = matmul(a + i * 1e-6, b)
+            return acc + jnp.sum(c)  # consume ALL of C so nothing is DCE'd
+
+        return jax.lax.fori_loop(0, ITERS, body, jnp.zeros((), dtype))
+
+    run(a, b).block_until_ready()  # compile + warm
+    t0 = time.perf_counter()
+    np.asarray(run(a + 0.5, b))  # distinct input: tunnel caches executions
+    t1 = time.perf_counter()
+    gflops = 2.0 * N**3 * ITERS / (t1 - t0) / 1e9
+
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(gflops, 1),
+                "unit": "GFLOP/s",
+                "vs_baseline": round(gflops / BASELINE_GFLOPS, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..blas3.blas3 import _NB, _split, trsm_array
 from ..core.matrix import (
+    TriangularBandMatrix,
     BaseMatrix,
     HermitianBandMatrix,
     HermitianMatrix,
@@ -161,11 +162,4 @@ def pbsv(a: HermitianBandMatrix, b: ArrayLike, opts: Optional[Options] = None):
     x, f, info = pbsv_array(a.data, bd, a.kd, a.uplo)
     if isinstance(b, BaseMatrix):
         x = replace(b, data=x)
-    kl, ku = (a.kd, 0) if a.uplo == Uplo.Lower else (0, a.kd)
-    return x, TriangularBandMatrixFactory(f, a.uplo, a.kd), info
-
-
-def TriangularBandMatrixFactory(f, uplo, kd):
-    from ..core.matrix import TriangularBandMatrix
-
-    return TriangularBandMatrix.from_array(f, uplo, kd)
+    return x, TriangularBandMatrix.from_array(f, a.uplo, a.kd), info

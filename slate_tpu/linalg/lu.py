@@ -83,7 +83,9 @@ def _panel_lu(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
         a = a - jnp.outer(lcol, a[j] * cmask)
         return a, perm
 
-    a, perm = jax.lax.fori_loop(0, w, step, (a, jnp.arange(m)))
+    # wide panels (m < w): only min(m, w) elimination steps exist; looping
+    # past m would argmax an all -inf column and corrupt row m-1
+    a, perm = jax.lax.fori_loop(0, min(m, w), step, (a, jnp.arange(m)))
     return a, perm
 
 

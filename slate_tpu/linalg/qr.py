@@ -170,7 +170,11 @@ def geqrf_array(a: Array) -> QRFactors:
 
 
 def unmqr_array(side: Side, op: Op, f: QRFactors, c: Array) -> Array:
-    """Apply Q / Q^H from geqrf factors (src/unmqr.cc): 3 matmuls."""
+    """Apply Q / Q^H from geqrf factors (src/unmqr.cc): 3 matmuls.  Op.Trans
+    on complex factors is undefined for compact-WY (LAPACK unmqr allows only
+    'N'/'C' for complex) — rejected rather than silently computing Q^H."""
+    if op == Op.Trans and jnp.issubdtype(f.vr.dtype, jnp.complexfloating):
+        raise SlateError("unmqr: Op.Trans unsupported for complex; use ConjTrans")
     v = _v_of(f.vr, f.t.shape[0])
     t = f.t if op == Op.NoTrans else jnp.conj(f.t).T
     if side == Side.Left:

@@ -16,7 +16,7 @@ x = V (U^T A V)^-1 U^T b.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -114,28 +114,55 @@ def gerbt_array(a: Array, key=None, depth: int = 2) -> Tuple[Array, Array, Array
     return uav, ud, vd, np_
 
 
+class RBTFactors(NamedTuple):
+    """Reusable gesv_rbt factorization: LU of the *transformed* matrix plus
+    the butterflies needed to solve against the ORIGINAL A.  Returned
+    instead of bare LUFactors because lu_factors.lu factors U^T A V, not A —
+    reusing it through getrs_array would be silently wrong (the reference's
+    gesv_rbt likewise keeps the butterflies with the factors,
+    src/gesv_rbt.cc)."""
+
+    lu_factors: object  # LUFactors of U^T A V
+    ud: Array
+    vd: Array
+    n: int
+    npad: int
+
+    @property
+    def info(self):
+        return self.lu_factors.info
+
+    def solve(self, b: Array) -> Array:
+        """x = V (U^T A V)^-1 U^T b for the original A (src/gesv_rbt.cc
+        solve path)."""
+        from .lu import getrs_array
+
+        squeeze = b.ndim == 1
+        rhs = b[:, None] if squeeze else b
+        rp = jnp.pad(rhs, ((0, self.npad - self.n), (0, 0)))
+        y = apply_butterfly(rp, self.ud, trans=True)  # U^T b
+        z = getrs_array(self.lu_factors, y)
+        x = apply_butterfly(z, self.vd, trans=False)  # V z
+        x = x[: self.n]
+        return x[:, 0] if squeeze else x
+
+
 def gesv_rbt_array(a: Array, b: Array, opts: Optional[Options] = None, key=None):
     """slate::gesv_rbt (src/gesv_rbt.cc): transform, no-pivot LU, solve,
-    one step of iterative refinement in working precision."""
-    from .lu import LUFactors, getrf_nopiv_array, getrs_array
+    one step of iterative refinement in working precision.  Returns
+    (x, RBTFactors); reuse factors via RBTFactors.solve, NOT getrs_array."""
+    from .lu import getrf_nopiv_array
 
     depth = get_option(opts, Option.Depth, 2)
     n = a.shape[0]
     squeeze = b.ndim == 1
     bd = b[:, None] if squeeze else b
     uav, ud, vd, np_ = gerbt_array(a, key=key, depth=depth)
-    f = getrf_nopiv_array(uav)
+    rf = RBTFactors(getrf_nopiv_array(uav), ud, vd, n, np_)
 
-    def solve(rhs: Array) -> Array:
-        rp = jnp.pad(rhs, ((0, np_ - n), (0, 0)))
-        y = apply_butterfly(rp, ud, trans=True)  # U^T b
-        z = getrs_array(f, y)
-        x = apply_butterfly(z, vd, trans=False)  # V z
-        return x[:n]
-
-    x = solve(bd)
+    x = rf.solve(bd)
     # one refinement step guards the no-pivot growth (gesv_rbt refines via
     # gesv_mixed-style loop; a single correction suffices at working prec)
     r = bd - matmul(a, x).astype(bd.dtype)
-    x = x + solve(r)
-    return (x[:, 0] if squeeze else x), f
+    x = x + rf.solve(r)
+    return (x[:, 0] if squeeze else x), rf

@@ -1,0 +1,41 @@
+"""Distributed (multi-chip) layer: mesh, block-cyclic DistMatrix, SUMMA
+gemm, distributed Cholesky/LU/trsm — XLA collectives over ICI replacing the
+reference's MPI backend (SURVEY §2.6)."""
+
+from .mesh import COL_AXIS, ROW_AXIS, make_mesh, mesh_shape, replicated, tile_sharding
+from .dist import DistMatrix, empty_like, from_dense, padded_tiles, redistribute, to_dense
+from .summa import gemm_summa
+from .dist_chol import potrf_dist
+from .dist_lu import getrf_nopiv_dist
+from .dist_trsm import trsm_dist
+from .drivers import (
+    gemm_mesh,
+    gesv_nopiv_mesh,
+    getrf_nopiv_mesh,
+    posv_mesh,
+    potrf_mesh,
+)
+
+__all__ = [
+    "COL_AXIS",
+    "ROW_AXIS",
+    "make_mesh",
+    "mesh_shape",
+    "replicated",
+    "tile_sharding",
+    "DistMatrix",
+    "empty_like",
+    "from_dense",
+    "padded_tiles",
+    "redistribute",
+    "to_dense",
+    "gemm_summa",
+    "potrf_dist",
+    "getrf_nopiv_dist",
+    "trsm_dist",
+    "gemm_mesh",
+    "gesv_nopiv_mesh",
+    "getrf_nopiv_mesh",
+    "posv_mesh",
+    "potrf_mesh",
+]

@@ -1,0 +1,127 @@
+"""Distributed right-looking LU (no-pivot and tournament-pivot entry) over
+the block-cyclic mesh.
+
+TPU-native analogue of ``src/getrf_nopiv.cc`` (same task structure as potrf:
+panel, bcast, trailing gemm) and the scaffolding of ``src/getrf_tntpiv.cc``.
+
+Per k inside one ``lax.fori_loop`` (see dist_chol.py for the pattern):
+- diagonal tile -> everyone (masked psums), factored redundantly with the
+  recursive no-pivot tile LU (linalg.lu._getrf_nopiv_rec — the analogue of
+  the reference delegating the diag tile to lapack::getrf).
+- owning column solves L[i,k] U_kk^{-1} (trsm right-upper), owning row
+  solves L_kk^{-1} A[k,j] (trsm left-unit-lower) — internal::trsm specials.
+- panel column bcast along 'q', panel row bcast along 'p'
+  (listBcast right + down, getrf_nopiv.cc), then one masked batched einsum
+  subtracts L[i,k] U[k,j] from the trailing tiles.
+
+Partial pivoting across ranks (getrf.cc row swaps, internal_swap.cc) is
+deliberately NOT done at the mesh level: the TPU-friendly default is
+tournament pivoting confined to tile panels (getrf_tntpiv.cc) or the RBT
+preconditioner (gesv_rbt) + no-pivot mesh LU, both of which keep row motion
+local.  Single-chip partial pivoting lives in linalg.lu.getrf_array.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..linalg.lu import _getrf_nopiv_rec
+from .dist import DistMatrix
+from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
+from .comm import (
+    PRECISE,
+    bcast_diag_tile,
+    bcast_from_col,
+    bcast_from_row,
+    local_indices,
+    shard_map,
+)
+
+def getrf_nopiv_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array]:
+    """Factor A = L U in place (packed LU tiles). Returns (LU, info)."""
+    p, q = mesh_shape(a.mesh)
+    if a.mt != a.nt:
+        raise ValueError("getrf_nopiv_dist needs a square tile grid")
+    a.require_diag_pad("getrf_nopiv_dist")
+    lut, info = _lu_jit(a.tiles, a.mesh, p, q, a.nt)
+    return DistMatrix(
+        tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True
+    ), info
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def _lu_jit(at, mesh, p, q, nt):
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(t_loc):
+        mtl, ntl, nb, _ = t_loc.shape
+        dtype = t_loc.dtype
+        r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
+        eye = jnp.eye(nb, dtype=dtype)
+
+        def step(k, t_loc):
+            kr, kc = k // p, k // q
+            dtile = bcast_diag_tile(t_loc, k, p, q, nb)
+            luk = _getrf_nopiv_rec(dtile)  # packed L\U, unit L diag implicit
+            ukk = jnp.triu(luk)
+
+            # panel column: L[i,k] = A[i,k] U_kk^{-1}  (i > k)
+            pcol = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)[:, 0]
+            lsolved = lax.linalg.triangular_solve(
+                jnp.broadcast_to(ukk, pcol.shape), pcol,
+                left_side=False, lower=False, transpose_a=False,
+            )
+            below = (i_log > k)[:, None, None]
+            on_d = (i_log == k)[:, None, None]
+            newcol = jnp.where(below, lsolved, jnp.where(on_d, luk, pcol))
+            mine_c = (c == k % q)
+            t_loc = lax.dynamic_update_slice_in_dim(
+                t_loc, jnp.where(mine_c, newcol, pcol)[:, None], kc, axis=1
+            )
+
+            # panel row: U[k,j] = L_kk^{-1} A[k,j]  (j > k)
+            prow = lax.dynamic_slice_in_dim(t_loc, kr, 1, axis=0)[0]
+            usolved = lax.linalg.triangular_solve(
+                jnp.broadcast_to(jnp.tril(luk, -1) + eye, prow.shape), prow,
+                left_side=True, lower=True, transpose_a=False,
+                unit_diagonal=True,
+            )
+            right = (j_log > k)[:, None, None]
+            newrow = jnp.where(right, usolved, prow)
+            mine_r = (r == k % p)
+            t_loc = lax.dynamic_update_slice_in_dim(
+                t_loc, jnp.where(mine_r, newrow, prow)[None], kr, axis=0
+            )
+
+            # broadcasts + trailing update (masked by the zeros in pan/prow)
+            pan = bcast_from_col(jnp.where(below & mine_c, newcol, 0), k % q)
+            urow = bcast_from_row(jnp.where(right & mine_r, newrow, 0), k % p)
+            upd = jnp.einsum("iab,jbc->ijac", pan, urow, precision=PRECISE)
+            return t_loc - upd.astype(dtype)
+
+        t_loc = lax.fori_loop(0, nt, step, t_loc)
+        # info: 1 + first zero/non-finite U diagonal (getrf.cc:102-104)
+        diag_tiles = (i_log[:, None] == j_log[None, :])[:, :, None]
+        dvals = jnp.einsum("ijaa->ija", t_loc)
+        bad = (~jnp.isfinite(jnp.abs(dvals)) | (dvals == 0)) & diag_tiles
+        gidx = i_log[:, None, None] * nb + jnp.arange(nb)[None, None, :] + 1
+        big = nt * nb + 1
+        local_info = jnp.min(jnp.where(bad, gidx, big))
+        info = lax.pmin(lax.pmin(local_info, ROW_AXIS), COL_AXIS)
+        info = jnp.where(info >= big, 0, info).astype(jnp.int32)
+        return t_loc, info[None, None]
+
+    lut, info = shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=(spec, P(ROW_AXIS, COL_AXIS)),
+        check_vma=False,
+    )(at)
+    return lut, jnp.max(info)

@@ -1,0 +1,116 @@
+"""Distributed triangular solve over the block-cyclic mesh.
+
+TPU-native analogue of ``src/trsm.cc`` / ``src/internal/internal_trsm.cc``
+run on a distributed B: block forward/backward substitution where per tile
+row k — diag-tile solve on the owning mesh row, broadcast of the solved RHS
+row along axis 'p', broadcast of the A panel along axis 'q' (or the
+transpose-gather for op != NoTrans, cf. dist_chol.py), one masked batched
+einsum update.  All four (uplo, op) combinations share one kernel body with
+trace-time flags.  Left-side solves only: right-side callers transpose
+their equation (X op(A) = B  <=>  op(A)^T X^T = B^T) before distributing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..types import Diag, Op, Uplo
+from .dist import DistMatrix
+from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
+from .comm import (
+    PRECISE,
+    bcast_diag_tile,
+    bcast_from_col,
+    bcast_from_row,
+    local_indices,
+    shard_map,
+)
+
+def trsm_dist(
+    a: DistMatrix,
+    b: DistMatrix,
+    uplo: Uplo = Uplo.Lower,
+    op: Op = Op.NoTrans,
+    diag: Diag = Diag.NonUnit,
+) -> DistMatrix:
+    """Solve op(A) X = B; A triangular-distributed, B distributed. X
+    overwrites B's layout (left side; alpha folded by callers)."""
+    p, q = mesh_shape(a.mesh)
+    a.require_diag_pad("trsm_dist")
+    xt = _trsm_jit(
+        a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag
+    )
+    return DistMatrix(tiles=xt, m=b.m, n=b.n, nb=b.nb, mesh=b.mesh)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8))
+def _trsm_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
+    spec = P(ROW_AXIS, COL_AXIS)
+    trans = op != Op.NoTrans
+    conj = op == Op.ConjTrans
+    # effective triangle of op(A)
+    eff_lower = (uplo == Uplo.Lower) != trans
+    forward = eff_lower  # forward substitution iff op(A) is lower
+    unit = diag == Diag.Unit
+
+    def kernel(a_loc, b_loc):
+        mtl, ntl, nb, _ = a_loc.shape
+        r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
+
+        def opt(t):  # apply op to one tile (or a stack of tiles)
+            t = jnp.swapaxes(t, -1, -2)
+            return jnp.conj(t) if conj else t
+
+        def step(s, b_loc):
+            k = s if forward else nt - 1 - s
+            kr, kc = k // p, k // q
+
+            # diag tile of A to everyone
+            dtile = bcast_diag_tile(a_loc, k, p, q, nb)
+            if trans:
+                dtile = opt(dtile)
+
+            # solve X[k,:] on the owning mesh row, write back, bcast down 'p'
+            brow = lax.dynamic_slice_in_dim(b_loc, kr, 1, axis=0)[0]  # (nbt,nb,nb)
+            xrow = lax.linalg.triangular_solve(
+                jnp.broadcast_to(dtile, brow.shape), brow,
+                left_side=True, lower=eff_lower, transpose_a=False,
+                unit_diagonal=unit,
+            )
+            mine_r = (r == k % p)
+            b_loc = lax.dynamic_update_slice_in_dim(
+                b_loc, jnp.where(mine_r, xrow, brow)[None], kr, axis=0
+            )
+            xrow = bcast_from_row(jnp.where(mine_r, xrow, 0), k % p)
+
+            # panel of op(A)[:, k] by my local row indices, remaining side only
+            remaining = (i_log > k) if forward else (i_log < k)
+            if not trans:
+                acol = lax.dynamic_slice_in_dim(a_loc, kc, 1, axis=1)[:, 0]
+                mine_c = (c == k % q)
+                pan = bcast_from_col(
+                    jnp.where(remaining[:, None, None] & mine_c, acol, 0), k % q
+                )
+            else:
+                # op(A)[i,k] = op(A[k,i]): transpose-gather of A row k
+                arow = lax.dynamic_slice_in_dim(a_loc, kr, 1, axis=0)[0]
+                mine_r2 = (r == k % p)
+                arow = bcast_from_row(jnp.where(mine_r2, arow, 0), k % p)
+                allrow = lax.all_gather(arow, COL_AXIS, axis=0)  # (q,ntl,nb,nb)
+                pan = opt(allrow[i_log % q, i_log // q])
+                pan = jnp.where(remaining[:, None, None], pan, 0)
+
+            upd = jnp.einsum("iab,jbc->ijac", pan, xrow, precision=PRECISE)
+            return b_loc - upd.astype(b_loc.dtype)
+
+        return lax.fori_loop(0, nt, step, b_loc)
+
+    return shard_map(
+        kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
+    )(at, bt)

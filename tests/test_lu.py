@@ -161,3 +161,29 @@ def test_gesv_mixed_gmres_multirhs():
     x, rnorm = gesv_mixed_gmres_array(jnp.asarray(a), jnp.asarray(b))
     assert np.asarray(x).shape == (n, 3)
     assert np.abs(a @ np.asarray(x) - b).max() / np.abs(b).max() < 1e-10
+
+
+def test_getrf_wide():
+    # m < n: only m elimination steps (review-found bug: looping w steps
+    # corrupted row m-1 through clamped out-of-bounds swaps)
+    m, n = 4, 8
+    a = generate("rands", m, n, np.float64, seed=30)
+    f = getrf_array(jnp.asarray(a))
+    lu, perm = np.asarray(f.lu), np.asarray(f.perm)
+    assert sorted(perm.tolist()) == list(range(m))  # a real permutation
+    l = np.tril(lu[:, :m], -1) + np.eye(m)
+    u = np.triu(lu)
+    np.testing.assert_allclose(l @ u, a[perm], atol=1e-12)
+
+
+def test_rbt_factors_reusable():
+    # RBTFactors.solve must solve against the ORIGINAL A for fresh RHS
+    n = 48
+    a = generate("rands", n, n, np.float64, seed=31) + 2 * np.eye(n)
+    b1 = generate("rands", n, 1, np.float64, seed=32)
+    b2 = generate("rands", n, 2, np.float64, seed=33)
+    x1, f = gesv_rbt_array(jnp.asarray(a), jnp.asarray(b1))
+    assert int(f.info) == 0
+    x2 = f.solve(jnp.asarray(b2))
+    resid = np.abs(a @ np.asarray(x2) - b2).max() / np.abs(b2).max()
+    assert resid < 1e-8

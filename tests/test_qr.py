@@ -97,3 +97,15 @@ def test_gels_underdetermined():
     xref = np.linalg.lstsq(a, b, rcond=None)[0]  # minimum-norm solution
     np.testing.assert_allclose(a @ x, b, atol=1e-10)
     np.testing.assert_allclose(x, xref, atol=1e-9)
+
+
+def test_unmqr_complex_trans_rejected():
+    # complex Op.Trans is undefined for compact-WY (LAPACK 'N'/'C' only):
+    # must raise, not silently apply Q^H (review-found bug)
+    import pytest
+    from slate_tpu.types import SlateError
+    a = generate("randn", 24, 16, np.complex128, seed=40)
+    f = geqrf_array(jnp.asarray(a))
+    c = generate("randn", 24, 4, np.complex128, seed=41)
+    with pytest.raises(SlateError):
+        unmqr_array(Side.Left, Op.Trans, f, jnp.asarray(c))
