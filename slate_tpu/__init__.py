@@ -60,4 +60,26 @@ from .blas3 import (
     trsm,
 )
 
+from . import api, linalg, ops, parallel
+from .linalg import (
+    bdsqr,
+    gecondest,
+    gels_array,
+    geqrf_array,
+    gesv_array,
+    getrf_array,
+    heev_array,
+    hegv_array,
+    hesv_array,
+    norm,
+    pocondest,
+    posv_array,
+    potrf_array,
+    stedc,
+    steqr,
+    sterf,
+    svd_array,
+    trcondest,
+)
+
 __version__ = "0.1.0"

@@ -34,3 +34,53 @@ from .refine import (
 )
 from .rbt import apply_butterfly, gerbt_array, gesv_rbt_array
 from .tri import trtri_array, trtrm_array
+from .qr import (
+    LQFactors,
+    QRFactors,
+    cholqr_array,
+    gelqf_array,
+    gels_array,
+    gels_cholqr_array,
+    gels_qr_array,
+    geqrf_array,
+    geqrf_q,
+    geqrf_r,
+    unmlq_array,
+    unmqr_array,
+)
+from .norms import (
+    col_norms,
+    gecondest,
+    norm,
+    norm1est,
+    pocondest,
+    trcondest,
+)
+from .tridiag import stedc, steqr, sterf
+from .eig import (
+    He2hbFactors,
+    he2hb,
+    heev_array,
+    hegst_array,
+    hegv_array,
+    hb2st,
+    unmtr_hb2st,
+    unmtr_he2hb,
+)
+from .svd import (
+    Ge2tbFactors,
+    bdsqr,
+    ge2tb,
+    svd_array,
+    tb2bd,
+    unmbr_ge2tb_u,
+    unmbr_ge2tb_v,
+)
+from .indefinite import (
+    HetrfFactors,
+    gtsv_array,
+    hesv_array,
+    hetrf_array,
+    hetrs_array,
+    sysv_array,
+)

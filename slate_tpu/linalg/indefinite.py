@@ -1,0 +1,198 @@
+"""Hermitian/symmetric indefinite solvers: hetrf / hetrs / hesv (+ sysv).
+
+Analogue of the reference's Aasen tier: ``src/hetrf.cc`` (633 LoC, Aasen's
+LTL^H with a banded T and panel pivoting), ``src/hetrs.cc``, ``src/hesv.cc``.
+
+Design inversion for TPU: Aasen's column-recurrence (H = T L^H bookkeeping,
+per-column pivot exchanges) is latency-bound and pivot-heavy — a poor map to
+the MXU.  This build factors the indefinite matrix by *unitary congruence*
+instead: A = Q T Q^H via the same two-stage band reduction used by the
+eigensolver (he2hb -> hb2st, all BLAS-3 + a fixed bulge chase), with T real
+symmetric tridiagonal.  The solve is then Q (T^-1 (Q^H b)) with a
+partial-pivot tridiagonal LU (gtsv).  Same capability and stability class
+(unitary transforms are unconditionally stable; gtsv pivots), ~4x the
+flops of Aasen but MXU-resident — the classic TPU trade (SURVEY §7).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.matmul import matmul
+from .eig import He2hbFactors, Hb2stFactors, he2hb, hb2st, _EIG_NB
+from .qr import _v_of
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# Tridiagonal solve with partial pivoting (LAPACK gtsv)
+# ---------------------------------------------------------------------------
+
+
+def gtsv_array(dl: Array, d: Array, du: Array, b: Array) -> Tuple[Array, Array]:
+    """Solve tridiag(dl, d, du) X = B with partial pivoting (row swaps
+    between adjacent rows only — gtsv's structure).  Returns (X, info)."""
+    n = d.shape[0]
+    if b.ndim == 1:
+        x, info = gtsv_array(dl, d, du, b[:, None])
+        return x[:, 0], info
+    dtype = b.dtype
+    # working diagonals: d (main), du1 (first super), du2 (second super,
+    # created by swaps)
+    du1 = jnp.concatenate([du, jnp.zeros((1,), du.dtype)])
+    du2 = jnp.zeros((n,), d.dtype)
+    dl_w = jnp.concatenate([dl, jnp.zeros((1,), dl.dtype)])
+
+    def fwd(k, carry):
+        d_, du1_, du2_, b_ = carry
+        lk = dl_w[k]  # subdiagonal element A[k+1, k]
+        swap = jnp.abs(lk) > jnp.abs(d_[k])
+        k1 = jnp.minimum(k + 1, n - 1)
+        # rows k and k+1 of the active 3-wide band
+        r0 = jnp.stack([d_[k], du1_[k], du2_[k]])
+        r1 = jnp.stack([lk, d_[k1], du1_[k1]])
+        top = jnp.where(swap, r1, r0)
+        bot = jnp.where(swap, r0, r1)
+        piv = jnp.where(top[0] == 0, 1, top[0])
+        m = bot[0] / piv
+        bot = bot - m * top
+        d_ = d_.at[k].set(top[0]).at[k1].set(jnp.where(k1 > k, bot[1], d_[k1]))
+        du1_ = du1_.at[k].set(top[1]).at[k1].set(jnp.where(k1 > k, bot[2], du1_[k1]))
+        du2_ = du2_.at[k].set(top[2])
+        bk = b_[k]
+        bk1 = b_[k1]
+        btop = jnp.where(swap, bk1, bk)
+        bbot = jnp.where(swap, bk, bk1) - m * btop
+        b_ = b_.at[k].set(btop)
+        b_ = b_.at[k1].set(jnp.where(k1 > k, bbot, b_[k1]))
+        return d_, du1_, du2_, b_
+
+    d_, du1_, du2_, b_ = lax.fori_loop(0, n - 1, fwd, (d.astype(dtype), du1.astype(dtype), du2, b))
+
+    # back substitution with the 3-wide upper band
+    def bwd(t, x):
+        k = n - 1 - t
+        k1 = jnp.minimum(k + 1, n - 1)
+        k2 = jnp.minimum(k + 2, n - 1)
+        upper = du1_[k] * jnp.where(k1 > k, x[k1], 0) + du2_[k] * jnp.where(k2 > k + 1, x[k2], 0)
+        piv = jnp.where(d_[k] == 0, 1, d_[k])
+        return x.at[k].set((b_[k] - upper) / piv)
+
+    x = lax.fori_loop(0, n, bwd, jnp.zeros_like(b_))
+    dd = jnp.abs(d_)
+    bad = (dd == 0) | ~jnp.isfinite(dd)
+    info = jnp.where(jnp.any(bad), jnp.argmax(bad) + 1, 0).astype(jnp.int32)
+    return x, info
+
+
+# ---------------------------------------------------------------------------
+# hetrf / hetrs / hesv
+# ---------------------------------------------------------------------------
+
+
+class HetrfFactors(NamedTuple):
+    """A = Q T Q^H: stage-1/2 transforms + real tridiagonal T."""
+
+    stage1: He2hbFactors
+    stage2: Hb2stFactors
+    phases: Array
+    d: Array  # T main diagonal (real)
+    e: Array  # T off-diagonal (real)
+
+
+def hetrf_array(a: Array, nb: int = _EIG_NB) -> Tuple[HetrfFactors, Array]:
+    """Factor the Hermitian indefinite A = Q T Q^H (src/hetrf.cc capability;
+    see module docstring for the design inversion).  info = 0 unless T is
+    exactly singular (reported by the solve)."""
+    f1 = he2hb(a, nb)
+    d, e, f2, phases = hb2st(f1.band, nb)
+    return HetrfFactors(f1, f2, phases, d, e), jnp.zeros((), jnp.int32)
+
+
+def _apply_q(f: HetrfFactors, c: Array, adjoint: bool) -> Array:
+    """c <- Q c (or Q^H c): Q = Q_he2hb * U_hb2st * P_phases."""
+    from .eig import unmtr_hb2st, unmtr_he2hb
+
+    cplx = jnp.issubdtype(c.dtype, jnp.complexfloating)
+    if not adjoint:
+        z = c
+        if cplx:
+            z = f.phases[:, None] * z
+        z = unmtr_hb2st(f.stage2, z)
+        return unmtr_he2hb(f.stage1, z)
+    # Q^H c: reverse each factor, conj-transposed, in opposite order
+    z = _unmtr_he2hb_adj(f.stage1, c)
+    z = _unmtr_hb2st_adj(f.stage2, z)
+    if cplx:
+        z = jnp.conj(f.phases)[:, None] * z
+    return z
+
+
+def _unmtr_he2hb_adj(f1: He2hbFactors, c: Array) -> Array:
+    """C <- Q^H C for the stage-1 Q (forward order, T^H)."""
+    nb = f1.nb
+    for k in range(len(f1.panels)):
+        fk = f1.panels[k]
+        c0 = (k + 1) * nb
+        v = _v_of(fk.vr, fk.t.shape[0])
+        tail = c[c0:]
+        upd = matmul(v, matmul(jnp.conj(fk.t).T, matmul(jnp.conj(v).T, tail))).astype(c.dtype)
+        c = c.at[c0:].set(tail - upd)
+    return c
+
+
+def _unmtr_hb2st_adj(f2: Hb2stFactors, z: Array) -> Array:
+    """Z <- U^H Z with U = H_1^H ... H_N^H: apply H_i chronologically."""
+    n, w = f2.n, f2.w
+    nsweeps, max_hops = f2.vs.shape[0], f2.vs.shape[1]
+    nrhs = z.shape[1]
+    pad = 2 * w
+    zp = jnp.zeros((n + 2 * pad, nrhs), z.dtype)
+    zp = zp.at[pad : pad + n].set(z)
+
+    def hop_body(t, carry):
+        j, zp = carry
+        r0 = j + 1 + t * w
+        v = lax.dynamic_slice(f2.vs, (j, t, 0), (1, 1, w))[0, 0].astype(z.dtype)
+        tau = lax.dynamic_slice(f2.taus, (j, t), (1, 1))[0, 0].astype(z.dtype)
+        rows = lax.dynamic_slice(zp, (pad + r0, 0), (w, nrhs))
+        rows = rows - tau * jnp.outer(v, matmul(jnp.conj(v)[None, :], rows)[0])
+        zp = lax.dynamic_update_slice(zp, rows, (pad + r0, 0))
+        return j, zp
+
+    def sweep_body(j, zp):
+        _, zp = lax.fori_loop(0, max_hops, hop_body, (j, zp))
+        return zp
+
+    if n > 2:
+        zp = lax.fori_loop(0, nsweeps, sweep_body, zp)
+    return zp[pad : pad + n]
+
+
+def hetrs_array(f: HetrfFactors, b: Array) -> Tuple[Array, Array]:
+    """Solve A X = B from hetrf factors (src/hetrs.cc)."""
+    squeeze = b.ndim == 1
+    bd = b[:, None] if squeeze else b
+    y = _apply_q(f, bd, adjoint=True)
+    e = f.e.astype(bd.dtype)
+    t, info = gtsv_array(e, f.d.astype(bd.dtype), e, y)
+    x = _apply_q(f, t, adjoint=False)
+    return (x[:, 0] if squeeze else x), info
+
+
+def hesv_array(a: Array, b: Array, nb: int = _EIG_NB):
+    """Factor + solve (src/hesv.cc). Returns (x, factors, info)."""
+    f, _ = hetrf_array(a, nb)
+    x, info = hetrs_array(f, b)
+    return x, f, info
+
+
+# symmetric aliases (src/sysv exposure; real symmetric == Hermitian path)
+sytrf_array = hetrf_array
+sytrs_array = hetrs_array
+sysv_array = hesv_array

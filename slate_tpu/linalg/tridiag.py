@@ -1,0 +1,389 @@
+"""Symmetric tridiagonal eigensolvers: sterf (values), steqr (QR iteration
+with vectors), stedc (divide & conquer).
+
+Analogues of the reference's tridiag tier (SURVEY §2.4): ``src/sterf.cc``
+(LAPACK dsterf passthrough), ``src/steqr2.cc`` + ``src/{s,d,c,z}steqr2.f``
+(modified LAPACK QR iteration updating a distributed Z), and ``src/stedc*.cc``
+(divide & conquer: split / solve / merge via secular equation, ~1,700 LoC).
+
+TPU design notes:
+- sterf/steqr are inherently sequential Givens recurrences; they run as
+  ``lax.while_loop``s with masked fixed-shape updates (the reference runs
+  them single-node on the host, heev.cc:115-148 — same locality story).
+- steqr's Z update applies each rotation to two length-n columns — the
+  vectorizable part, exactly what SLATE_DSTEQR2 distributes over ranks.
+- stedc is the TPU-native fast path for vectors: the merge's eigenvector
+  assembly is one big matmul per level (MXU), and the secular-equation Newton
+  iteration vectorizes over all roots at once.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.matmul import matmul
+
+
+def _wilkinson_shift(a, b, c):
+    """Eigenvalue of [[a, b], [b, c]] closest to c (LAPACK convention)."""
+    d = (a - c) / 2
+    sgn = jnp.where(d >= 0, 1.0, -1.0)
+    denom = d + sgn * jnp.sqrt(d * d + b * b)
+    denom = jnp.where(denom == 0, jnp.finfo(a.dtype).tiny, denom)
+    return c - b * b / denom
+
+
+def _steqr_impl(d, e, z: Optional[jax.Array], max_sweeps: int):
+    """Shared implicit-shift QR iteration on (d, e); rotates z's columns if
+    given.  Fixed-shape masked formulation: each outer iteration finds the
+    active unreduced window [lo, hi] (smallest split containing the first
+    unconverged off-diagonal) and runs one bulge-chase sweep across it."""
+    n = d.shape[0]
+    dtype = d.dtype
+    eps = jnp.finfo(dtype).eps
+    idx = jnp.arange(n - 1) if n > 1 else jnp.arange(0)
+    has_z = z is not None
+    zz = z if has_z else jnp.zeros((1, 1), dtype)
+
+    def negligible(d, e):
+        # |e_i| <= eps * sqrt(|d_i| |d_i+1|) -> treat as zero (dsteqr test)
+        thresh = eps * jnp.sqrt(jnp.abs(d[:-1]) * jnp.abs(d[1:])) + jnp.finfo(dtype).tiny
+        return jnp.abs(e) <= thresh
+
+    def cond(state):
+        d, e, zz, it = state
+        return (it < max_sweeps) & ~jnp.all(negligible(d, e))
+
+    def sweep(state):
+        d, e, zz, it = state
+        negl = negligible(d, e)
+        e = jnp.where(negl, 0.0, e)
+        # active window: first non-negligible off-diagonal lo, extend to the
+        # next negligible one after it
+        active = ~negl
+        lo = jnp.argmax(active)  # first True (there is one, else cond ended)
+        after = negl & (idx > lo)
+        hi = jnp.where(jnp.any(after), jnp.argmax(after), n - 1)
+        # hi = last index of window (inclusive, in d-space)
+
+        shift = _wilkinson_shift(d[hi - 1], e[hi - 1], d[hi])
+
+        # one implicit QR sweep lo..hi: sequential Givens recurrence
+        def rot_body(k, carry):
+            d, e, zz, x, zbulge = carry
+            inside = (k >= lo) & (k < hi)
+            # rotation annihilating zbulge against x at position k
+            r = jnp.hypot(x, zbulge)
+            r = jnp.where(r == 0, jnp.finfo(dtype).tiny, r)
+            cs = jnp.where(inside, x / r, 1.0)
+            sn = jnp.where(inside, zbulge / r, 0.0)
+
+            dk = d[k]
+            dk1 = d[jnp.minimum(k + 1, n - 1)]
+            ek = e[k]
+            # apply G^T [ [dk, ek], [ek, dk1] ] G
+            new_dk = cs * cs * dk + 2 * cs * sn * ek + sn * sn * dk1
+            new_dk1 = sn * sn * dk - 2 * cs * sn * ek + cs * cs * dk1
+            new_ek = cs * sn * (dk1 - dk) + (cs * cs - sn * sn) * ek
+            # previous off-diagonal e[k-1] gets length r
+            ekm1 = jnp.where((k > lo) & inside, r, e[jnp.maximum(k - 1, 0)])
+
+            d = d.at[k].set(jnp.where(inside, new_dk, dk))
+            d = d.at[jnp.minimum(k + 1, n - 1)].set(
+                jnp.where(inside, new_dk1, dk1)
+            )
+            e = e.at[jnp.maximum(k - 1, 0)].set(ekm1)
+            e = e.at[k].set(jnp.where(inside, new_ek, e[k]))
+            # bulge for next step: G rotates (e[k+1]) into position
+            ek1 = e[jnp.minimum(k + 1, n - 2)]
+            new_ek1 = jnp.where(inside & (k + 1 < hi), cs * ek1, ek1)
+            # preserve the seeded bulge while k < lo (outside the window the
+            # carry must pass through untouched, else the lo-th rotation
+            # sees zbulge = 0 and the sweep silently does nothing)
+            zb_next = jnp.where(
+                inside, jnp.where(k + 1 < hi, sn * ek1, 0.0), zbulge
+            )
+            e = e.at[jnp.minimum(k + 1, n - 2)].set(new_ek1)
+
+            if has_z:
+                c0 = lax.dynamic_slice_in_dim(zz, k, 1, axis=1)[:, 0]
+                c1 = lax.dynamic_slice_in_dim(zz, jnp.minimum(k + 1, n - 1), 1, axis=1)[:, 0]
+                nc0 = jnp.where(inside, cs * c0 + sn * c1, c0)
+                nc1 = jnp.where(inside, -sn * c0 + cs * c1, c1)
+                zz = lax.dynamic_update_slice_in_dim(zz, nc0[:, None], k, axis=1)
+                zz = lax.dynamic_update_slice_in_dim(
+                    zz, nc1[:, None], jnp.minimum(k + 1, n - 1), axis=1
+                )
+
+            # first-step seeding handled by initial x, zbulge
+            x_next = jnp.where(inside, e[k], x)
+            return d, e, zz, x_next, zb_next
+
+        x0 = d[lo] - shift
+        zb0 = e[lo]
+        d, e, zz, _, _ = lax.fori_loop(0, n - 1, rot_body, (d, e, zz, x0, zb0))
+        return d, e, zz, it + 1
+
+    if n == 1:
+        return d, zz, jnp.zeros((), jnp.int32)
+    d, e, zz, iters = lax.while_loop(cond, sweep, (d, e, zz, jnp.zeros((), jnp.int32)))
+    return d, zz, iters
+
+def sterf(d: jax.Array, e: jax.Array, max_sweeps: Optional[int] = None) -> jax.Array:
+    """Eigenvalues of the symmetric tridiagonal (d, e) — slate::sterf
+    (QR iteration, no vectors). Returns ascending eigenvalues."""
+    n = d.shape[0]
+    ms = max_sweeps if max_sweeps is not None else 30 * n
+    w, _, _ = _steqr_impl(d, e, None, ms)
+    return jnp.sort(w)
+
+
+def steqr(
+    d: jax.Array,
+    e: jax.Array,
+    z: Optional[jax.Array] = None,
+    max_sweeps: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Eigen-decomposition of tridiagonal (d, e) by implicit QR with
+    accumulation into ``z`` (defaults to I): slate::steqr2 (steqr2.cc:74,
+    the Fortran SLATE_DSTEQR2 core).  Returns (w ascending, z columns)."""
+    n = d.shape[0]
+    if z is None:
+        z = jnp.eye(n, dtype=d.dtype)
+    ms = max_sweeps if max_sweeps is not None else 30 * n
+    w, zz, _ = _steqr_impl(d, e, z, ms)
+    order = jnp.argsort(w)
+    return w[order], zz[:, order]
+
+
+# ---------------------------------------------------------------------------
+# Divide & conquer (src/stedc.cc + stedc_{deflate,merge,secular,solve,...}.cc)
+# ---------------------------------------------------------------------------
+
+
+def _suffix_next(vals: jax.Array, active: jax.Array, fill) -> jax.Array:
+    """nxt[i] = vals[j] of the nearest active j > i (else ``fill``)."""
+    masked = jnp.where(active, vals, fill)
+    rev = jnp.flip(masked)
+    m = lax.associative_scan(jnp.minimum, rev)
+    nxt_incl = jnp.flip(m)  # min over j >= i
+    return jnp.concatenate([nxt_incl[1:], jnp.full((1,), fill, vals.dtype)])
+
+
+def _prefix_prev(vals: jax.Array, active: jax.Array, fill) -> jax.Array:
+    """prv[i] = vals[j] of the nearest active j < i (else ``fill``)."""
+    masked = jnp.where(active, vals, fill)
+    m = lax.associative_scan(jnp.maximum, masked)
+    return jnp.concatenate([jnp.full((1,), fill, vals.dtype), m[:-1]])
+
+
+def _secular_merge(d: jax.Array, z: jax.Array, rho, bisect_iters: int = 70):
+    """Eigen-decomposition of diag(d) + rho z z^T, d ascending (stedc merge:
+    stedc_secular.cc + stedc_deflate.cc).
+
+    Vectorized and cancellation-safe: every root is bisected in its own gap
+    variable mu_k = lambda_k - d_k (the LAPACK laed4 anchoring), so the
+    eigenvector denominators (d_i - lambda_k) = (d_i - d_k) - mu_k never
+    cancel; z is recomputed from the converged roots by the Gu-Eisenstat
+    inverse-eigenvalue formula so eigenvectors stay numerically orthogonal.
+
+    Deflation (stedc_deflate.cc): (a) negligible rho*z_k^2 -> eigenpair
+    (d_k, e_k) passes through; (b) near-equal poles d_i ~ d_i+1 are merged by
+    a Givens rotation that zeroes z_i+1 (applied to the returned V so the
+    caller's single assembly matmul still works)."""
+    n = d.shape[0]
+    dtype = d.dtype
+    eps = jnp.finfo(dtype).eps
+    tiny = jnp.finfo(dtype).tiny
+    absrho = jnp.abs(rho)
+    znorm2 = jnp.sum(z * z)
+    scale = absrho * znorm2 + jnp.max(jnp.abs(d)) + tiny
+    tol = 8.0 * eps * scale
+
+    # --- (b) Givens-deflate near-equal poles, descending so groups chain ---
+    def defl_body(t, carry):
+        z, cs_arr, sn_arr = carry
+        i = n - 2 - t
+        close = jnp.abs(d[i + 1] - d[i]) <= tol
+        zi, zi1 = z[i], z[i + 1]
+        both = (jnp.abs(zi1) > 0) & close
+        r = jnp.hypot(zi, zi1)
+        rs = jnp.where(r == 0, 1.0, r)
+        c = jnp.where(both, zi / rs, 1.0)
+        s = jnp.where(both, zi1 / rs, 0.0)
+        z = z.at[i].set(jnp.where(both, r, zi))
+        z = z.at[i + 1].set(jnp.where(both, 0.0, zi1))
+        cs_arr = cs_arr.at[i].set(c)
+        sn_arr = sn_arr.at[i].set(s)
+        return z, cs_arr, sn_arr
+
+    if n > 1:
+        z, cs_arr, sn_arr = lax.fori_loop(
+            0, n - 1, defl_body,
+            (z, jnp.ones((n - 1,), dtype), jnp.zeros((n - 1,), dtype)),
+        )
+    else:
+        cs_arr = jnp.ones((0,), dtype)
+        sn_arr = jnp.zeros((0,), dtype)
+
+    # --- (a) negligible-z deflation mask: |rho z_k| <= tol (dlaed2's
+    # LINEAR criterion; a squared test would deflate z up to sqrt(eps) and
+    # leave O(sqrt(eps)) residuals) ---
+    active = absrho * jnp.abs(z) > tol
+    pos = rho >= 0
+    big = jnp.asarray(jnp.finfo(dtype).max / 4, dtype)
+
+    # pairwise pole differences D[k, j] = d_j - d_k (exact in each entry)
+    D = d[None, :] - d[:, None]
+    zz2 = jnp.where(active, z * z, 0.0)
+    idxs = jnp.arange(n)
+
+    # interval of root k: (d_k, next active d) for rho>0, (prev, d_k) rho<0;
+    # outermost root capped by the |rho|*||z||^2 bound
+    if pos:
+        nxt_i = jnp.int32(
+            _suffix_next(idxs.astype(dtype), active, jnp.asarray(n - 1, dtype))
+        )
+        has_nbr = _suffix_next(d, active, big) < big
+        gap = jnp.where(has_nbr, d[nxt_i] - d, absrho * znorm2 + tol)
+    else:
+        prv_i = jnp.int32(
+            _prefix_prev(idxs.astype(dtype), active, jnp.asarray(0, dtype))
+        )
+        has_nbr = _prefix_prev(d, active, -big) > -big
+        gap = jnp.where(has_nbr, d[prv_i] - d, -(absrho * znorm2 + tol))
+    nbr_i = nxt_i if pos else prv_i
+
+    # --- nearest-pole anchoring (laed4): decide the root's half-interval by
+    # the secular sign at the midpoint, anchor mu at the closer pole so the
+    # eigenvector denominators (d_i - lambda_k) never cancel ---
+    def f_at(anchor_idx, mu):
+        dan = d[None, :] - d[anchor_idx][:, None]  # d_j - anchor_k
+        den = dan - mu[:, None]
+        den = jnp.where(den == 0, tiny, den)
+        return 1.0 + rho * jnp.sum(zz2[None, :] / den, axis=1)
+
+    self_i = idxs
+    fmid = f_at(self_i, gap * 0.5)
+    # root in far half (toward the neighbor pole): for rho>0, f increasing,
+    # interval (d_k, nxt): root > mid iff f(mid) < 0; for rho<0, f
+    # decreasing, interval (prv, d_k): root < mid iff f(mid) < 0 too.
+    far = fmid < 0
+    use_nbr = far & has_nbr
+    aidx = jnp.where(use_nbr, nbr_i, self_i)
+    # mu bracket in anchored coordinates (mu = lambda - d[aidx])
+    half = gap * 0.5
+    if pos:
+        lo0 = jnp.where(use_nbr, half - gap, 0.0)  # (-gap/2, 0)
+        hi0 = jnp.where(use_nbr, 0.0, jnp.where(has_nbr, half, gap))
+    else:
+        lo0 = jnp.where(use_nbr, 0.0, jnp.where(has_nbr, half, gap))
+        hi0 = jnp.where(use_nbr, half - gap, 0.0)
+        lo0, hi0 = jnp.minimum(lo0, hi0), jnp.maximum(lo0, hi0)
+
+    def bis_body(_, carry):
+        lo, hi = carry
+        mid = 0.5 * (lo + hi)
+        fm = f_at(aidx, mid)
+        go_right = (fm < 0) if pos else (fm > 0)
+        lo = jnp.where(go_right, mid, lo)
+        hi = jnp.where(go_right, hi, mid)
+        return lo, hi
+
+    lo, hi = lax.fori_loop(0, bisect_iters, bis_body, (lo0, hi0))
+    mu = 0.5 * (lo + hi)
+
+    # Fixed-point polish (laed4 inner iteration): bisection floors at
+    # gap*2^-iters, but a root hugging its anchor sits at mu ~ rho z_a^2 —
+    # as small as eps^2*gap.  The exact pole rearrangement
+    #   mu = rho z_a^2 / (1 + rho * sum_{j != a} z_j^2 / (Dan_kj - mu))
+    # is strongly attractive there; candidates outside the bisection bracket
+    # are rejected, so the root is never lost.
+    dan_full = d[None, :] - d[aidx][:, None]
+    not_anchor = idxs[None, :] != aidx[:, None]
+    zz2_anch = zz2[aidx]
+
+    def fp_body(_, mu):
+        den = dan_full - mu[:, None]
+        den = jnp.where(den == 0, tiny, den)
+        other = jnp.sum(jnp.where(not_anchor, zz2[None, :] / den, 0.0), axis=1)
+        g = rho * zz2_anch / (1.0 + rho * other)
+        ok = jnp.isfinite(g) & (g > lo) & (g < hi)
+        return jnp.where(ok, g, mu)
+
+    mu = lax.fori_loop(0, 25, fp_body, mu)
+    mu = jnp.where(active, mu, 0.0)
+    aidx = jnp.where(active, aidx, self_i)
+    lam = d[aidx] + mu
+
+    # --- Gu-Eisenstat z-hat from the converged roots ---
+    # |zhat_k|^2 = prod_{j act} (lam_j - d_k) / (|rho| prod_{j!=k act} (d_j - d_k))
+    # with lam_j - d_k = (d[aidx_j] - d_k) + mu_j (anchored, cancellation-free)
+    offk = ~jnp.eye(n, dtype=bool)
+    act_j = active[None, :] & offk
+    Dsafe = jnp.where(D == 0, 1.0, D)
+    lamd = (d[aidx][None, :] - d[:, None]) + mu[None, :]  # (k, j): lam_j - d_k
+    ratio = jnp.where(act_j, lamd / Dsafe, 1.0)
+    prod = jnp.prod(jnp.abs(ratio), axis=1)
+    lamk_dk = jnp.take_along_axis(lamd, idxs[:, None], axis=1)[:, 0]
+    zhat = jnp.sign(z) * jnp.sqrt(prod * jnp.abs(lamk_dk) / jnp.maximum(absrho, tiny))
+    zhat = jnp.where(active, zhat, 0.0)
+
+    # --- eigenvectors: v[i,k] = zhat_i / (d_i - lam_k), anchored form ---
+    den = (d[:, None] - d[aidx][None, :]) - mu[None, :]
+    den = jnp.where(den == 0, tiny, den)
+    v = zhat[:, None] / den
+    v = jnp.where(active[None, :], v, 0.0)
+    nrm = jnp.sqrt(jnp.sum(v * v, axis=0))
+    v = v / jnp.where(nrm == 0, 1.0, nrm)[None, :]
+    v = v + jnp.where(active, 0.0, 1.0)[None, :] * jnp.eye(n, dtype=dtype)
+
+    # --- undo the deflation rotations on V's rows (ascending = reverse of
+    # the descending deflation scan): V <- R_i^T V on rows (i, i+1) ---
+    def rot_body(i, v):
+        c, s = cs_arr[i], sn_arr[i]
+        r0 = lax.dynamic_slice_in_dim(v, i, 1, axis=0)[0]
+        r1 = lax.dynamic_slice_in_dim(v, i + 1, 1, axis=0)[0]
+        n0 = c * r0 - s * r1
+        n1 = s * r0 + c * r1
+        v = lax.dynamic_update_slice_in_dim(v, n0[None], i, axis=0)
+        return lax.dynamic_update_slice_in_dim(v, n1[None], i + 1, axis=0)
+
+    if n > 1:
+        v = lax.fori_loop(0, n - 1, rot_body, v)
+    return lam, v
+
+
+_DC_SMALL = 32  # base-case size (reference stedc small-problem cutoff)
+
+
+def stedc(d: jax.Array, e: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Divide & conquer tridiagonal eigensolver (src/stedc.cc chain).
+    Returns (w ascending, Z).  The merge matmul Q = (Q1 (+) Q2) V runs on
+    the MXU — this is the TPU-preferred vector path (MethodEig::DC default,
+    heev.cc:154)."""
+    n = d.shape[0]
+    if n <= _DC_SMALL:
+        return steqr(d, e)
+    m = n // 2
+    rho = e[m - 1]
+    d1 = d[:m].at[m - 1].add(-rho)
+    d2 = d[m:].at[0].add(-rho)
+    w1, q1 = stedc(d1, e[: m - 1])
+    w2, q2 = stedc(d2, e[m:])
+    dd = jnp.concatenate([w1, w2])
+    z = jnp.concatenate([q1[-1, :], q2[0, :]])
+    order = jnp.argsort(dd)
+    lam_s, v_s = _secular_merge(dd[order], z[order], rho)
+    # scatter secular rows back and assemble Q = blockdiag(q1,q2) @ V
+    inv = jnp.argsort(order)
+    v = v_s[inv, :]
+    q_top = matmul(q1, v[:m, :]).astype(d.dtype)
+    q_bot = matmul(q2, v[m:, :]).astype(d.dtype)
+    q = jnp.concatenate([q_top, q_bot], axis=0)
+    ord2 = jnp.argsort(lam_s)  # lam_s already ascending up to deflation
+    return lam_s[ord2], q[:, ord2]

@@ -42,6 +42,11 @@ def trsm_dist(
     """Solve op(A) X = B; A triangular-distributed, B distributed. X
     overwrites B's layout (left side; alpha folded by callers)."""
     p, q = mesh_shape(a.mesh)
+    if b.grid != a.grid or b.nb != a.nb or b.mt != a.nt or b.m != a.n:
+        raise ValueError(
+            f"trsm_dist operands mismatch: A {a.m}x{a.n} nb={a.nb} grid={a.grid}, "
+            f"B {b.m}x{b.n} nb={b.nb} grid={b.grid}"
+        )
     a.require_diag_pad("trsm_dist")
     xt = _trsm_jit(
         a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag

@@ -39,8 +39,8 @@ def make_mesh(
         p = len(devs) // q
     elif q is None:
         q = len(devs) // p
-    if p * q > len(devs):
-        raise ValueError(f"mesh {p}x{q} needs {p * q} devices, have {len(devs)}")
+    if p < 1 or q < 1 or p * q > len(devs):
+        raise ValueError(f"mesh {p}x{q} invalid for {len(devs)} devices")
     grid = np.asarray(devs[: p * q]).reshape(p, q)
     return Mesh(grid, (ROW_AXIS, COL_AXIS))
 

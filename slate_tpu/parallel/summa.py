@@ -50,9 +50,13 @@ def gemm_summa(
     p, q = mesh_shape(a.mesh)
     if b.grid != (p, q) or b.nb != a.nb:
         raise ValueError("gemm_summa operands must share mesh and nb")
+    if a.n != b.m:
+        raise ValueError(f"inner dims mismatch: A is {a.m}x{a.n}, B {b.m}x{b.n}")
+    if c is not None and (c.m != a.m or c.n != b.n or c.nb != a.nb or c.grid != (p, q)):
+        raise ValueError("C dims/layout must match alpha*A@B")
     kt = a.nt
     if b.mt != kt:
-        raise ValueError(f"inner tile dims mismatch: {a.nt} vs {b.mt}")
+        raise ValueError(f"inner tile grids mismatch: {a.nt} vs {b.mt}")
     ctiles = None if c is None else c.tiles
     out_t = _summa_jit(a.tiles, b.tiles, ctiles, alpha, beta, a.mesh, p, q, kt)
     return DistMatrix(tiles=out_t, m=a.m, n=b.n, nb=a.nb, mesh=a.mesh)

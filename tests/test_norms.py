@@ -1,0 +1,75 @@
+"""Norm drivers + condition estimators (test_norm.cc / gecondest etc.)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from slate_tpu.core.matrix import HermitianMatrix, TriangularMatrix
+from slate_tpu.linalg.chol import potrf_array
+from slate_tpu.linalg.lu import getrf_array
+from slate_tpu.linalg.norms import col_norms, gecondest, norm, pocondest, trcondest
+from slate_tpu.types import Norm, NormScope, Uplo
+from slate_tpu.utils.testing import generate
+
+
+def _a(n=30, seed=1):
+    return np.asarray(generate("rands", n, n, np.float64, seed=seed))
+
+
+@pytest.mark.parametrize(
+    "nt,ref",
+    [
+        (Norm.One, lambda a: np.abs(a).sum(0).max()),
+        (Norm.Inf, lambda a: np.abs(a).sum(1).max()),
+        (Norm.Max, lambda a: np.abs(a).max()),
+        (Norm.Fro, lambda a: np.linalg.norm(a)),
+    ],
+)
+def test_genorm(nt, ref):
+    a = _a()
+    got = float(norm(nt, jnp.asarray(a)))
+    np.testing.assert_allclose(got, ref(a), rtol=1e-13)
+
+
+def test_henorm_uses_triangle():
+    a = _a()
+    h = HermitianMatrix.from_array(jnp.asarray(a), Uplo.Lower)
+    full = np.tril(a) + np.tril(a, -1).T
+    np.testing.assert_allclose(float(norm(Norm.One, h)), np.abs(full).sum(0).max(), rtol=1e-13)
+
+
+def test_col_norms():
+    a = _a()
+    np.testing.assert_allclose(np.asarray(col_norms(jnp.asarray(a))), np.abs(a).max(0))
+
+
+def test_gecondest():
+    n = 40
+    a = _a(n, seed=2) + n * np.eye(n)
+    f = getrf_array(jnp.asarray(a))
+    anorm = np.abs(a).sum(0).max()
+    rcond = float(gecondest(Norm.One, f, anorm))
+    true_rcond = 1.0 / (anorm * np.abs(np.linalg.inv(a)).sum(0).max())
+    # estimator guarantees a lower bound within a modest factor
+    assert 0.1 * true_rcond <= rcond <= 10 * true_rcond
+
+
+def test_pocondest():
+    n = 40
+    g = _a(n, seed=3)
+    a = g @ g.T + n * np.eye(n)
+    l, info = potrf_array(jnp.asarray(a))
+    assert int(info) == 0
+    anorm = np.abs(a).sum(0).max()
+    rcond = float(pocondest(Norm.One, TriangularMatrix.from_array(l, Uplo.Lower), anorm))
+    true_rcond = 1.0 / (anorm * np.abs(np.linalg.inv(a)).sum(0).max())
+    assert 0.05 * true_rcond <= rcond <= 20 * true_rcond
+
+
+def test_trcondest():
+    n = 30
+    t = np.tril(_a(n, seed=4)) + n * np.eye(n)
+    rcond = float(trcondest(Norm.One, TriangularMatrix.from_array(jnp.asarray(t), Uplo.Lower)))
+    anorm = np.abs(t).sum(0).max()
+    true_rcond = 1.0 / (anorm * np.abs(np.linalg.inv(t)).sum(0).max())
+    assert 0.05 * true_rcond <= rcond <= 20 * true_rcond
