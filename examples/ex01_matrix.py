@@ -1,0 +1,10 @@
+"""Matrix types (reference examples/ex01_matrix.cc): typed views over arrays."""
+import numpy as np, jax.numpy as jnp
+import slate_tpu as st
+
+a = jnp.asarray(np.arange(16.0).reshape(4, 4))
+m = st.Matrix.from_array(a)
+h = st.HermitianMatrix.from_array(a, st.Uplo.Lower)
+t = st.TriangularMatrix.from_array(a, st.Uplo.Upper, st.Diag.Unit)
+print(m, h, t, sep="\n")
+print("transposed view:", m.transposed().shape)

@@ -1,0 +1,11 @@
+"""Tile-stack and block-cyclic layout transforms (ex02_conversion.cc)."""
+import numpy as np, jax.numpy as jnp
+from slate_tpu.core.tiling import to_tiles, from_tiles, to_cyclic, from_cyclic
+
+a = jnp.asarray(np.arange(64.0).reshape(8, 8))
+t = to_tiles(a, 4)
+print("tile stack:", t.shape)
+c = to_cyclic(t, 2, 2)
+back = from_tiles(from_cyclic(c, 2, 2), 8, 8)
+assert (np.asarray(back) == np.asarray(a)).all()
+print("round trip exact")

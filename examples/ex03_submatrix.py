@@ -1,0 +1,7 @@
+"""Zero-copy views: slice / transpose (ex03_submatrix.cc)."""
+import numpy as np, jax.numpy as jnp
+import slate_tpu as st
+
+a = st.Matrix.from_array(jnp.asarray(np.arange(36.0).reshape(6, 6)))
+sub = a.slice(1, 4, 2, 6)
+print("slice:", sub.shape, "conj-transposed:", a.conj_transposed().shape)

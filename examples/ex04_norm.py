@@ -1,0 +1,9 @@
+"""Norms over matrix types (ex04_norm.cc)."""
+import numpy as np, jax.numpy as jnp
+import slate_tpu as st
+from slate_tpu.linalg import norm
+from slate_tpu.types import Norm
+
+a = jnp.asarray(np.random.default_rng(0).standard_normal((50, 50)))
+for nt in (Norm.One, Norm.Inf, Norm.Max, Norm.Fro):
+    print(nt.name, float(norm(nt, a)))

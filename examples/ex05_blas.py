@@ -1,0 +1,10 @@
+"""Level-3 BLAS (ex05_blas.cc: the 8-line gemm usage)."""
+import numpy as np, jax.numpy as jnp
+import slate_tpu as st
+
+rng = np.random.default_rng(0)
+a = jnp.asarray(rng.standard_normal((512, 256)).astype(np.float32))
+b = jnp.asarray(rng.standard_normal((256, 384)).astype(np.float32))
+c = jnp.zeros((512, 384), jnp.float32)
+c = st.gemm(1.0, a, b, 0.0, c)
+print("C = A B:", c.shape, "ok:", np.allclose(np.asarray(c), np.asarray(a) @ np.asarray(b), atol=1e-3))

@@ -1,0 +1,14 @@
+"""LU solve, 4 pivoting strategies (ex06_linear_system_lu.cc)."""
+import numpy as np, jax, jax.numpy as jnp
+jax.config.update("jax_enable_x64", True)
+from slate_tpu.linalg import gesv_array
+from slate_tpu.types import MethodLU
+
+rng = np.random.default_rng(0)
+n = 200
+a = rng.standard_normal((n, n))
+xt = rng.standard_normal((n, 2))
+b = a @ xt
+for method in (MethodLU.PartialPiv, MethodLU.CALU, MethodLU.RBT):
+    x, f = gesv_array(jnp.asarray(a), jnp.asarray(b), method)
+    print(method.name, "err:", np.abs(np.asarray(x) - xt).max())

@@ -1,0 +1,12 @@
+"""SPD solve (ex07_linear_system_cholesky.cc)."""
+import numpy as np, jax, jax.numpy as jnp
+jax.config.update("jax_enable_x64", True)
+from slate_tpu.linalg import posv_array
+
+rng = np.random.default_rng(0)
+n = 300
+g = rng.standard_normal((n, n))
+a = g @ g.T + n * np.eye(n)
+xt = rng.standard_normal((n, 1))
+x, l, info = posv_array(jnp.asarray(a), jnp.asarray(a @ xt))
+print("info:", int(info), "err:", np.abs(np.asarray(x) - xt).max())

@@ -1,0 +1,11 @@
+"""Hermitian-indefinite solve (ex08_linear_system_indefinite.cc)."""
+import numpy as np, jax, jax.numpy as jnp
+jax.config.update("jax_enable_x64", True)
+from slate_tpu.linalg import hesv_array
+
+rng = np.random.default_rng(0)
+n = 100
+a = rng.standard_normal((n, n)); a = (a + a.T) / 2
+xt = rng.standard_normal((n, 1))
+x, f, info = hesv_array(jnp.asarray(a), jnp.asarray(a @ xt), nb=16)
+print("info:", int(info), "err:", np.abs(np.asarray(x) - xt).max())

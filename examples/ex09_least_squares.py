@@ -1,0 +1,12 @@
+"""Least squares (ex09_least_squares.cc): QR and CholQR paths."""
+import numpy as np, jax, jax.numpy as jnp
+jax.config.update("jax_enable_x64", True)
+from slate_tpu.linalg import gels_array
+from slate_tpu.linalg.qr import gels_cholqr_array
+
+rng = np.random.default_rng(0)
+a = rng.standard_normal((400, 150))
+b = rng.standard_normal((400, 3))
+for name, fn in [("qr", gels_array), ("cholqr", gels_cholqr_array)]:
+    x = np.asarray(fn(jnp.asarray(a), jnp.asarray(b)))
+    print(name, "normal-eq resid:", np.abs(a.T @ (a @ x - b)).max())

@@ -1,0 +1,8 @@
+"""Singular value decomposition (ex10_svd.cc)."""
+import numpy as np, jax, jax.numpy as jnp
+jax.config.update("jax_enable_x64", True)
+from slate_tpu.linalg import svd_array
+
+a = np.random.default_rng(0).standard_normal((120, 80))
+u, s, vh = svd_array(jnp.asarray(a), nb=16)
+print("sigma_max err:", abs(float(np.asarray(s)[0]) - np.linalg.svd(a, compute_uv=False)[0]))

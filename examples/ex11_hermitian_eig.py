@@ -1,0 +1,8 @@
+"""Hermitian eigenvalues (ex11_hermitian_eig.cc)."""
+import numpy as np, jax, jax.numpy as jnp
+jax.config.update("jax_enable_x64", True)
+from slate_tpu.linalg import heev_array
+
+a = np.random.default_rng(0).standard_normal((100, 100)); a = (a + a.T) / 2
+w, z = heev_array(jnp.asarray(a), nb=16)
+print("eig err:", np.abs(np.asarray(w) - np.linalg.eigvalsh(a)).max())

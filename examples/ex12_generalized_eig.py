@@ -1,0 +1,11 @@
+"""Generalized Hermitian eig (ex12 analog; hegv)."""
+import numpy as np, jax, jax.numpy as jnp
+jax.config.update("jax_enable_x64", True)
+from slate_tpu.linalg import hegv_array
+
+rng = np.random.default_rng(0)
+n = 80
+a = rng.standard_normal((n, n)); a = (a + a.T) / 2
+g = rng.standard_normal((n, n)); b = g @ g.T + n * np.eye(n)
+w, x, info = hegv_array(jnp.asarray(a), jnp.asarray(b))
+print("info:", int(info), "first eigs:", np.asarray(w)[:3])

@@ -1,0 +1,17 @@
+"""C API demo (ex14_scalapack_gemm analog): call the native shared library
+from ctypes the way a C application would."""
+import ctypes, os, subprocess, numpy as np
+
+root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+lib_path = os.path.join(root, "native", "lib", "libslatetpu_c.so")
+if not os.path.exists(lib_path):
+    subprocess.run(["bash", os.path.join(root, "native", "build.sh")], check=True)
+lib = ctypes.CDLL(lib_path)
+lib.slate_tpu_dgesv.argtypes = [ctypes.c_int64] * 2 + [ctypes.c_void_p] * 3
+n = 32
+rng = np.random.default_rng(0)
+a = rng.standard_normal((n, n)) + n * np.eye(n)
+xt = rng.standard_normal((n, 1)); b = a @ xt
+x = np.zeros_like(xt)
+info = lib.slate_tpu_dgesv(n, 1, a.ctypes.data, b.ctypes.data, x.ctypes.data)
+print("C-API dgesv info:", info, "err:", np.abs(x - xt).max())

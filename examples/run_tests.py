@@ -1,0 +1,15 @@
+#!/usr/bin/env python
+"""Run every example as an installation smoke test (examples/run_tests.py)."""
+import glob, os, subprocess, sys
+
+here = os.path.dirname(os.path.abspath(__file__))
+env = dict(os.environ, PYTHONPATH=os.path.dirname(here) + os.pathsep + os.environ.get("PYTHONPATH", ""))
+fails = []
+for ex in sorted(glob.glob(os.path.join(here, "ex*.py"))):
+    r = subprocess.run([sys.executable, ex], env=env, capture_output=True, text=True, timeout=900)
+    status = "ok" if r.returncode == 0 else "FAIL"
+    print(f"{os.path.basename(ex):<36} {status}")
+    if r.returncode != 0:
+        print(r.stdout[-500:], r.stderr[-800:])
+        fails.append(ex)
+sys.exit(1 if fails else 0)

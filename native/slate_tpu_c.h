@@ -1,0 +1,46 @@
+/* C API for slate_tpu — analogue of include/slate/c_api/slate.h.
+ *
+ * Link against libslatetpu_c.so (native/build.sh).  All matrices are
+ * row-major contiguous float64.  Functions return LAPACK-style info codes
+ * (0 = success; >0 numerical failure index; <=-100 bridge error).
+ *
+ * The first call initializes an embedded Python/JAX runtime unless the
+ * host process is already Python.  Set PYTHONPATH to include the
+ * slate_tpu package root.
+ */
+#ifndef SLATE_TPU_C_H
+#define SLATE_TPU_C_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Solve A X = B, general A (n x n), partial-pivot LU. */
+int slate_tpu_dgesv(int64_t n, int64_t nrhs, const double* a,
+                    const double* b, double* x);
+
+/* Solve A X = B, A symmetric positive definite. */
+int slate_tpu_dposv(int64_t n, int64_t nrhs, const double* a,
+                    const double* b, double* x);
+
+/* Least squares min |A X - B|, A (m x n), X (n x nrhs). */
+int slate_tpu_dgels(int64_t m, int64_t n, int64_t nrhs, const double* a,
+                    const double* b, double* x);
+
+/* C = alpha A B + beta C. */
+int slate_tpu_dgemm(int64_t m, int64_t n, int64_t k, double alpha,
+                    const double* a, const double* b, double beta, double* c);
+
+/* Symmetric eigen-decomposition: w (n), z (n x n) column eigvecs. */
+int slate_tpu_dsyev(int64_t n, const double* a, double* w, double* z);
+
+/* Thin SVD: s (min(m,n)), u (m x k), vt (k x n). */
+int slate_tpu_dgesvd(int64_t m, int64_t n, const double* a, double* s,
+                     double* u, double* vt);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* SLATE_TPU_C_H */

@@ -1,0 +1,126 @@
+// SVG timeline trace writer — native analogue of the reference's
+// src/auxiliary/Trace.cc (trace::Trace::finish emits a standalone SVG with
+// per-thread rows, color legend and time ticks, Trace.cc:330-600).
+//
+// C ABI consumed from Python via ctypes (slate_tpu/utils/trace.py).  Events
+// are appended from the host side; write_svg lays them out one row per lane
+// with a microsecond ruler, matching the reference's viewer-free output.
+
+#include <algorithm>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Event {
+    std::string name;
+    int lane;
+    double t0, t1;
+    std::string color;
+};
+
+struct Trace {
+    std::vector<Event> events;
+};
+
+const char* kPalette[] = {
+    "#4e79a7", "#f28e2b", "#e15759", "#76b7b2", "#59a14f",
+    "#edc948", "#b07aa1", "#ff9da7", "#9c755f", "#bab0ac",
+};
+
+}  // namespace
+
+extern "C" {
+
+void* slate_trace_new() { return new Trace(); }
+
+void slate_trace_free(void* t) { delete static_cast<Trace*>(t); }
+
+void slate_trace_event(void* t, const char* name, int lane, double t0,
+                       double t1, const char* color) {
+    auto* tr = static_cast<Trace*>(t);
+    tr->events.push_back(
+        {name ? name : "", lane, t0, t1, color ? color : ""});
+}
+
+int slate_trace_count(void* t) {
+    return static_cast<int>(static_cast<Trace*>(t)->events.size());
+}
+
+// Returns 0 on success. scale = pixels per second (reference trace_scale).
+int slate_trace_write_svg(void* t, const char* path, double scale) {
+    auto* tr = static_cast<Trace*>(t);
+    if (tr->events.empty()) return 1;
+    FILE* f = std::fopen(path, "w");
+    if (!f) return 2;
+
+    double tmin = 1e300, tmax = -1e300;
+    int max_lane = 0;
+    std::map<std::string, std::string> legend;
+    int next_color = 0;
+    for (auto& e : tr->events) {
+        tmin = std::min(tmin, e.t0);
+        tmax = std::max(tmax, e.t1);
+        max_lane = std::max(max_lane, e.lane);
+        if (legend.find(e.name) == legend.end()) {
+            legend[e.name] = e.color.empty()
+                ? kPalette[next_color++ % 10]
+                : e.color;
+        }
+    }
+    const double row_h = 24.0, pad = 40.0, legend_h = 22.0;
+    double span = std::max(tmax - tmin, 1e-9);
+    double width = span * scale + 2 * pad;
+    double height = (max_lane + 1) * row_h + 2 * pad +
+                    legend_h * ((legend.size() + 3) / 4) + 20;
+
+    std::fprintf(f,
+        "<svg xmlns='http://www.w3.org/2000/svg' width='%.0f' height='%.0f' "
+        "font-family='monospace' font-size='11'>\n", width, height);
+    std::fprintf(f, "<rect width='100%%' height='100%%' fill='white'/>\n");
+
+    // time ruler: ~10 ticks
+    double tick = span / 10.0;
+    for (int i = 0; i <= 10; i++) {
+        double x = pad + i * tick * scale;
+        std::fprintf(f,
+            "<line x1='%.1f' y1='%.0f' x2='%.1f' y2='%.1f' stroke='#ddd'/>\n",
+            x, pad - 6, x, pad + (max_lane + 1) * row_h);
+        std::fprintf(f,
+            "<text x='%.1f' y='%.0f' fill='#666'>%.3fs</text>\n",
+            x - 14, pad - 10, i * tick);
+    }
+
+    for (auto& e : tr->events) {
+        double x = pad + (e.t0 - tmin) * scale;
+        double w = std::max((e.t1 - e.t0) * scale, 0.5);
+        double y = pad + e.lane * row_h;
+        std::fprintf(f,
+            "<rect x='%.2f' y='%.1f' width='%.2f' height='%.1f' fill='%s' "
+            "stroke='#333' stroke-width='0.3'><title>%s [%.6f, %.6f]s"
+            "</title></rect>\n",
+            x, y + 2, w, row_h - 4, legend[e.name].c_str(), e.name.c_str(),
+            e.t0 - tmin, e.t1 - tmin);
+    }
+
+    // legend rows (reference's X11-color legend, Trace.cc:489-)
+    int i = 0;
+    double ly0 = pad + (max_lane + 1) * row_h + 18;
+    for (auto& kv : legend) {
+        double lx = pad + (i % 4) * 180.0;
+        double ly = ly0 + (i / 4) * legend_h;
+        std::fprintf(f,
+            "<rect x='%.1f' y='%.1f' width='14' height='14' fill='%s'/>"
+            "<text x='%.1f' y='%.1f'>%s</text>\n",
+            lx, ly, kv.second.c_str(), lx + 18, ly + 11, kv.first.c_str());
+        i++;
+    }
+    std::fprintf(f, "</svg>\n");
+    std::fclose(f);
+    return 0;
+}
+
+}  // extern "C"

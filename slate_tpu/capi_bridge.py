@@ -1,0 +1,90 @@
+"""Python side of the C API (native/c_api.cc).
+
+Receives raw buffer addresses from the C shims, wraps them zero-copy with
+numpy (row-major doubles), runs the JAX drivers, writes results back into
+caller memory, returns a LAPACK-style info code.  The analogue of the
+reference's generated src/c_api/wrappers.cc bodies.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+
+def _view(ptr: int, shape, writable=False) -> np.ndarray:
+    n = int(np.prod(shape))
+    buf = (ctypes.c_double * n).from_address(ptr)
+    return np.ctypeslib.as_array(buf).reshape(shape)  # zero-copy view
+
+
+def _jx(a: np.ndarray):
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_enable_x64", True)
+    return jnp.asarray(a)
+
+
+def dgesv(n, nrhs, pa, pb, px) -> int:
+    from .linalg import gesv_array
+
+    a = _view(pa, (n, n))
+    b = _view(pb, (n, nrhs))
+    x, f = gesv_array(_jx(a), _jx(b))
+    _view(px, (n, nrhs), writable=True)[:] = np.asarray(x)
+    return int(f.info)
+
+
+def dposv(n, nrhs, pa, pb, px) -> int:
+    from .linalg import posv_array
+
+    a = _view(pa, (n, n))
+    b = _view(pb, (n, nrhs))
+    x, _, info = posv_array(_jx(a), _jx(b))
+    _view(px, (n, nrhs), writable=True)[:] = np.asarray(x)
+    return int(info)
+
+
+def dgels(m, n, nrhs, pa, pb, px) -> int:
+    from .linalg import gels_array
+
+    a = _view(pa, (m, n))
+    b = _view(pb, (m, nrhs))
+    x = gels_array(_jx(a), _jx(b))
+    _view(px, (n, nrhs), writable=True)[:] = np.asarray(x)
+    return 0
+
+
+def dgemm(m, n, k, alpha, pa, pb, beta, pc) -> int:
+    from .blas3.blas3 import gemm_array
+
+    a = _view(pa, (m, k))
+    b = _view(pb, (k, n))
+    c = _view(pc, (m, n))
+    out = gemm_array(alpha, _jx(a), _jx(b), beta, _jx(c))
+    _view(pc, (m, n), writable=True)[:] = np.asarray(out)
+    return 0
+
+
+def dsyev(n, pa, pw, pz) -> int:
+    from .linalg import heev_array
+
+    a = _view(pa, (n, n))
+    w, z = heev_array(_jx(a))
+    _view(pw, (n,), writable=True)[:] = np.asarray(w)
+    _view(pz, (n, n), writable=True)[:] = np.asarray(z)
+    return 0
+
+
+def dgesvd(m, n, pa, ps, pu, pvt) -> int:
+    from .linalg import svd_array
+
+    a = _view(pa, (m, n))
+    u, s, vt = svd_array(_jx(a))
+    k = min(m, n)
+    _view(ps, (k,), writable=True)[:] = np.asarray(s)
+    _view(pu, (m, k), writable=True)[:] = np.asarray(u)
+    _view(pvt, (k, n), writable=True)[:] = np.asarray(vt)
+    return 0

@@ -1,0 +1,153 @@
+"""LAPACK-signature API: drop-in named routines over numpy/JAX arrays.
+
+Analogue of the reference's ``lapack_api/`` (23 files: slate_dgetrf etc.,
+LAPACK-style shims for single-process callers) and the spirit of
+``scalapack_api/`` (drop-in pdgemm_): in the TPU ecosystem the "drop-in"
+surface is numpy/scipy-style Python, so each routine takes/returns arrays
+with LAPACK naming and semantics.  Precision prefixes: s/d (f32/f64),
+c/z (c64/c128) — the d/z versions require jax x64 to be enabled.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+
+from .blas3.blas3 import gemm_array, trsm_array
+from .linalg import (
+    gels_array,
+    geqrf_array,
+    gesv_array,
+    getrf_array,
+    getri_array,
+    getrs_array,
+    heev_array,
+    hesv_array,
+    norm,
+    posv_array,
+    potrf_array,
+    potrs_array,
+    svd_array,
+)
+from .linalg.norms import gecondest, pocondest
+from .types import Diag, Norm, Op, Side, Uplo
+
+_DTYPES = {"s": jnp.float32, "d": jnp.float64, "c": jnp.complex64, "z": jnp.complex128}
+
+
+def _typed(fn):
+    """Generate s/d/c/z-prefixed variants of ``fn(dtype, *args)``."""
+
+    @functools.wraps(fn)
+    def wrapper(prefix, *args, **kw):
+        return fn(_DTYPES[prefix], *args, **kw)
+
+    return wrapper
+
+
+def _cast(dtype, a):
+    return jnp.asarray(a).astype(dtype)
+
+
+def _make(prefix):
+    dt = _DTYPES[prefix]
+
+    ns = {}
+
+    def gemm(transa, transb, m, n, k, alpha, a, b, beta, c):
+        opa = {"N": lambda x: x, "T": lambda x: x.T, "C": lambda x: jnp.conj(x).T}[transa.upper()]
+        opb = {"N": lambda x: x, "T": lambda x: x.T, "C": lambda x: jnp.conj(x).T}[transb.upper()]
+        return gemm_array(alpha, opa(_cast(dt, a)), opb(_cast(dt, b)), beta, _cast(dt, c))
+
+    def gesv(a, b):
+        x, f = gesv_array(_cast(dt, a), _cast(dt, b))
+        return x, f, int(f.info)
+
+    def getrf(a):
+        return getrf_array(_cast(dt, a))
+
+    def getrs(f, b, trans="N"):
+        op = {"N": Op.NoTrans, "T": Op.Trans, "C": Op.ConjTrans}[trans.upper()]
+        return getrs_array(f, _cast(dt, b), op)
+
+    def getri(f):
+        return getri_array(f)
+
+    def posv(a, b, uplo="L"):
+        x, l, info = posv_array(_cast(dt, a), _cast(dt, b), _uplo(uplo))
+        return x, l, int(info)
+
+    def potrf(a, uplo="L"):
+        l, info = potrf_array(_cast(dt, a), _uplo(uplo))
+        return l, int(info)
+
+    def potrs(l, b, uplo="L"):
+        return potrs_array(_cast(dt, l), _cast(dt, b), _uplo(uplo))
+
+    def geqrf(a):
+        return geqrf_array(_cast(dt, a))
+
+    def gels(a, b):
+        return gels_array(_cast(dt, a), _cast(dt, b))
+
+    def gesvd(a):
+        return svd_array(_cast(dt, a))
+
+    def gecon(norm_char, a, anorm=None):
+        ad = _cast(dt, a)
+        f = getrf_array(ad)
+        nt = Norm.One if norm_char.upper() in ("1", "O") else Norm.Inf
+        if anorm is None:
+            anorm = float(norm(nt, ad))
+        return float(gecondest(nt, f, anorm))
+
+    def trsm(side, uplo, trans, diag, alpha, a, b):
+        return trsm_array(
+            Side.Left if side.upper() == "L" else Side.Right,
+            _uplo(uplo),
+            {"N": Op.NoTrans, "T": Op.Trans, "C": Op.ConjTrans}[trans.upper()],
+            Diag.Unit if diag.upper() == "U" else Diag.NonUnit,
+            alpha, _cast(dt, a), _cast(dt, b),
+        )
+
+    ns.update(
+        gemm=gemm, gesv=gesv, getrf=getrf, getrs=getrs, getri=getri,
+        posv=posv, potrf=potrf, potrs=potrs, geqrf=geqrf, gels=gels,
+        gesvd=gesvd, gecon=gecon, trsm=trsm,
+    )
+
+    if prefix in ("s", "d"):
+        def syev(a):
+            w, z = heev_array(_cast(dt, a))
+            return w, z
+
+        def sysv(a, b):
+            x, f, info = hesv_array(_cast(dt, a), _cast(dt, b))
+            return x, f, int(info)
+
+        ns.update(syev=syev, sysv=sysv)
+    else:
+        def heev(a):
+            w, z = heev_array(_cast(dt, a))
+            return w, z
+
+        def hesv(a, b):
+            x, f, info = hesv_array(_cast(dt, a), _cast(dt, b))
+            return x, f, int(info)
+
+        ns.update(heev=heev, hesv=hesv)
+    return ns
+
+
+def _uplo(u):
+    return Uplo.Lower if u.upper() == "L" else Uplo.Upper
+
+
+# generate slate_dgesv-style names (reference lapack_api naming)
+for _p in "sdcz":
+    for _name, _fn in _make(_p).items():
+        globals()[f"slate_{_p}{_name}"] = _fn
+        globals()[f"{_p}{_name}"] = _fn  # bare LAPACK names too
+
+del _p, _name, _fn

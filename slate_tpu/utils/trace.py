@@ -1,0 +1,128 @@
+"""Tracing/profiling: RAII-style blocks, per-phase timers, SVG timelines.
+
+Analogue of the reference's trace subsystem (include/slate/internal/Trace.hh
+``trace::Block`` RAII events, src/auxiliary/Trace.cc SVG emission with
+per-thread rows + legend, and the coarse named-timer map ``slate::timers``,
+src/core/types.cc:24).
+
+The SVG writer is native C++ (native/trace_svg.cc) loaded via ctypes —
+matching the reference's native writer; events are collected here.  For
+deep kernel-level profiles use jax.profiler alongside (the TPU-native
+equivalent of nvprof in the reference's workflow).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_LIB = os.path.join(_REPO, "native", "lib", "libslatetpu_trace.so")
+
+# coarse named timers (slate::timers analogue) — drivers add phase durations
+timers: Dict[str, float] = {}
+
+
+class Trace:
+    """Event collector; ``on()``/``off()`` gate like trace::Trace."""
+
+    _enabled = False
+    _events: List[Tuple[str, int, float, float]] = []
+    _t0: Optional[float] = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def on(cls):
+        cls._enabled = True
+        cls._events = []
+        cls._t0 = time.perf_counter()
+
+    @classmethod
+    def off(cls):
+        cls._enabled = False
+
+    @classmethod
+    def enabled(cls) -> bool:
+        return cls._enabled
+
+    @classmethod
+    def add(cls, name: str, lane: int, t0: float, t1: float):
+        with cls._lock:
+            cls._events.append((name, lane, t0, t1))
+
+    @classmethod
+    def finish(cls, path: str = "trace.svg", scale: float = 200.0) -> Optional[str]:
+        """Emit the SVG timeline via the native writer (Trace.cc:330-600
+        analogue). Returns the path, or None if no events / no writer."""
+        if not cls._events:
+            return None
+        lib = _load_writer()
+        if lib is None:
+            return None
+        h = lib.slate_trace_new()
+        try:
+            for name, lane, t0, t1 in cls._events:
+                lib.slate_trace_event(
+                    h, name.encode(), lane, ctypes.c_double(t0), ctypes.c_double(t1), b""
+                )
+            rc = lib.slate_trace_write_svg(h, path.encode(), ctypes.c_double(scale))
+        finally:
+            lib.slate_trace_free(h)
+        cls._events = []
+        return path if rc == 0 else None
+
+
+_writer = None
+
+
+def _load_writer():
+    global _writer
+    if _writer is not None:
+        return _writer
+    if not os.path.exists(_LIB):
+        os.makedirs(os.path.dirname(_LIB), exist_ok=True)
+        try:  # build on demand; trace-only build works without python headers
+            subprocess.run(
+                ["g++", "-O2", "-fPIC", "-shared", "-o", _LIB,
+                 os.path.join(_REPO, "native", "trace_svg.cc")],
+                check=True, capture_output=True,
+            )
+        except Exception:
+            return None
+        if not os.path.exists(_LIB):
+            return None
+    lib = ctypes.CDLL(_LIB)
+    lib.slate_trace_new.restype = ctypes.c_void_p
+    lib.slate_trace_event.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_double, ctypes.c_double, ctypes.c_char_p,
+    ]
+    lib.slate_trace_write_svg.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double,
+    ]
+    lib.slate_trace_write_svg.restype = ctypes.c_int
+    lib.slate_trace_free.argtypes = [ctypes.c_void_p]
+    lib.slate_trace_count.argtypes = [ctypes.c_void_p]
+    lib.slate_trace_count.restype = ctypes.c_int
+    _writer = lib
+    return _writer
+
+
+@contextmanager
+def block(name: str, lane: int = 0):
+    """trace::Block RAII analogue: times the region when tracing is on and
+    always accumulates into the named-timer map."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        t1 = time.perf_counter()
+        timers[name] = timers.get(name, 0.0) + (t1 - t0)
+        if Trace.enabled():
+            base = Trace._t0 or 0.0
+            Trace.add(name, lane, t0 - base, t1 - base)

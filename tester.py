@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""Parameter-sweep tester: the testsweeper/`test/tester` analogue.
+
+Usage (mirrors `test/tester <routine> --dim ... --type ...`, SURVEY §4):
+
+    python tester.py gemm --dim 256:1024:256 --type s,d
+    python tester.py potrf --dim 1024 --type d --check y
+    python tester.py heev svd --dim 200 --type d
+    python tester.py --help
+
+Per combination prints: routine, type, dims, error, status, time, gflops —
+the reference tester's output row (docs/usage.md:36-44).  Gflop formulas
+follow blas::Gflop (gemm 2mnk; potrf n^3/3; getrf 2n^3/3; geqrf 4mn^2-4n^3/3;
+heev ~4n^3/3; svd ~8n^3/3).  Residual gates follow test/*.cc (3-eps style).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+_DTYPES = {"s": np.float32, "d": np.float64, "c": np.complex64, "z": np.complex128}
+
+
+def _parse_dims(spec: str):
+    for part in spec.split(","):
+        if ":" in part:
+            bits = [int(x) for x in part.split(":")]
+            start, stop = bits[0], bits[1]
+            step = bits[2] if len(bits) > 2 else start
+            yield from range(start, stop + 1, step)
+        else:
+            yield int(part)
+
+
+def _eps(dtype):
+    return np.finfo(np.float32 if dtype in (np.float32, np.complex64) else np.float64).eps
+
+
+def _rand(rng, m, n, dtype):
+    a = rng.standard_normal((m, n))
+    if np.issubdtype(dtype, np.complexfloating):
+        a = a + 1j * rng.standard_normal((m, n))
+    return a.astype(dtype)
+
+
+def _time(fn, *args):
+    import jax
+
+    out = fn(*args)  # warm/compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    return out, time.perf_counter() - t0
+
+
+def run_gemm(n, dtype, rng, check):
+    import jax.numpy as jnp
+    from slate_tpu.ops.matmul import matmul
+
+    a, b = _rand(rng, n, n, dtype), _rand(rng, n, n, dtype)
+    c, t = _time(matmul, jnp.asarray(a), jnp.asarray(b))
+    gflops = 2 * n**3 / t / 1e9
+    err = 0.0
+    if check:
+        x = _rand(rng, n, 1, dtype)
+        lhs = np.asarray(c) @ x
+        rhs = a @ (b @ x)
+        err = np.abs(lhs - rhs).max() / (np.abs(rhs).max() + 1e-30)
+    return err, t, gflops, err < 100 * n * _eps(dtype)
+
+
+def run_potrf(n, dtype, rng, check):
+    import jax.numpy as jnp
+    from slate_tpu.linalg import potrf_array
+
+    g = _rand(rng, n, n, dtype)
+    a = g @ g.conj().T + n * np.eye(n, dtype=dtype)
+    (l, info), t = _time(potrf_array, jnp.asarray(a))
+    gflops = n**3 / 3 / t / 1e9
+    ld = np.tril(np.asarray(l))
+    err = np.linalg.norm(ld @ ld.conj().T - a) / np.linalg.norm(a) if check else 0.0
+    return err, t, gflops, int(info) == 0 and err < 30 * n * _eps(dtype)
+
+
+def run_getrf(n, dtype, rng, check):
+    import jax.numpy as jnp
+    from slate_tpu.linalg import getrf_array
+
+    a = _rand(rng, n, n, dtype)
+    f, t = _time(getrf_array, jnp.asarray(a))
+    gflops = 2 * n**3 / 3 / t / 1e9
+    err = 0.0
+    if check:
+        lu, perm = np.asarray(f.lu), np.asarray(f.perm)
+        l = np.tril(lu, -1) + np.eye(n, dtype=dtype)
+        u = np.triu(lu)
+        err = np.linalg.norm(l @ u - a[perm]) / np.linalg.norm(a)
+    return err, t, gflops, err < 30 * n * _eps(dtype)
+
+
+def run_gesv(n, dtype, rng, check):
+    import jax.numpy as jnp
+    from slate_tpu.linalg import gesv_array
+
+    a = _rand(rng, n, n, dtype)
+    b = _rand(rng, n, 8, dtype)
+    (x, f), t = _time(lambda aa, bb: gesv_array(aa, bb), jnp.asarray(a), jnp.asarray(b))
+    gflops = (2 * n**3 / 3 + 2 * n**2 * 8) / t / 1e9
+    err = np.abs(a @ np.asarray(x) - b).max() / (np.abs(b).max() * np.abs(a).sum(1).max()) if check else 0.0
+    return err, t, gflops, err < 30 * n * _eps(dtype)
+
+
+def run_geqrf(n, dtype, rng, check):
+    import jax.numpy as jnp
+    from slate_tpu.linalg import geqrf_array
+    from slate_tpu.linalg.qr import geqrf_q, geqrf_r
+
+    m = n
+    a = _rand(rng, m, n, dtype)
+    f, t = _time(geqrf_array, jnp.asarray(a))
+    gflops = (4 * m * n**2 - 4 * n**3 / 3) / t / 1e9
+    err = 0.0
+    if check:
+        q = np.asarray(geqrf_q(f))
+        r = np.asarray(geqrf_r(f))
+        err = np.linalg.norm(q @ r - a) / np.linalg.norm(a)
+    return err, t, gflops, err < 30 * n * _eps(dtype)
+
+
+def run_gels(n, dtype, rng, check):
+    import jax.numpy as jnp
+    from slate_tpu.linalg import gels_array
+
+    m = 2 * n
+    a = _rand(rng, m, n, dtype)
+    b = _rand(rng, m, 4, dtype)
+    x, t = _time(gels_array, jnp.asarray(a), jnp.asarray(b))
+    gflops = (2 * m * n**2) / t / 1e9
+    err = 0.0
+    if check:  # normal-equations residual: A^H (A x - b) ~ 0
+        r = a @ np.asarray(x) - b
+        err = np.abs(a.conj().T @ r).max() / (np.abs(a).max() ** 2 * np.abs(x).max() * m)
+    return err, t, gflops, err < 100 * n * _eps(dtype)
+
+
+def run_heev(n, dtype, rng, check):
+    import jax.numpy as jnp
+    from slate_tpu.linalg import heev_array
+
+    a = _rand(rng, n, n, dtype)
+    a = (a + a.conj().T) / 2
+    (w, z), t = _time(lambda x: heev_array(x, nb=32), jnp.asarray(a))
+    gflops = 4 * n**3 / 3 / t / 1e9
+    err = 0.0
+    if check:
+        w, z = np.asarray(w), np.asarray(z)
+        err = np.abs(a @ z - z * w).max() / (np.abs(w).max() + 1e-30) / n
+    return err, t, gflops, err < 100 * _eps(dtype)
+
+
+def run_svd(n, dtype, rng, check):
+    import jax.numpy as jnp
+    from slate_tpu.linalg import svd_array
+
+    a = _rand(rng, n, n, dtype)
+    (u, s, vh), t = _time(lambda x: svd_array(x, nb=32), jnp.asarray(a))
+    gflops = 8 * n**3 / 3 / t / 1e9
+    err = 0.0
+    if check:
+        u, s, vh = np.asarray(u), np.asarray(s), np.asarray(vh)
+        err = np.abs(a - (u * s) @ vh).max() / (s[0] + 1e-30) / n
+    return err, t, gflops, err < 100 * _eps(dtype)
+
+
+def run_trsm(n, dtype, rng, check):
+    import jax.numpy as jnp
+    from slate_tpu.blas3.blas3 import trsm_array
+    from slate_tpu.types import Diag, Op, Side, Uplo
+
+    t_mat = np.tril(_rand(rng, n, n, dtype)) + n * np.eye(n, dtype=dtype)
+    b = _rand(rng, n, n, dtype)
+    x, t = _time(
+        lambda a_, b_: trsm_array(Side.Left, Uplo.Lower, Op.NoTrans, Diag.NonUnit, 1.0, a_, b_),
+        jnp.asarray(t_mat), jnp.asarray(b),
+    )
+    gflops = n**3 / t / 1e9
+    err = np.abs(t_mat @ np.asarray(x) - b).max() / (np.abs(b).max() * n) if check else 0.0
+    return err, t, gflops, err < 30 * _eps(dtype)
+
+
+ROUTINES = {
+    "gemm": run_gemm,
+    "potrf": run_potrf,
+    "getrf": run_getrf,
+    "gesv": run_gesv,
+    "geqrf": run_geqrf,
+    "gels": run_gels,
+    "heev": run_heev,
+    "svd": run_svd,
+    "trsm": run_trsm,
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("routines", nargs="+", choices=sorted(ROUTINES), help="routines to sweep")
+    ap.add_argument("--dim", default="256", help="sizes: N | start:stop[:step] | comma list")
+    ap.add_argument("--type", default="d", help="precisions from s,d,c,z")
+    ap.add_argument("--check", default="y", choices=["y", "n"])
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if any(p in args.type for p in "dz"):
+        jax.config.update("jax_enable_x64", True)
+
+    rng = np.random.default_rng(args.seed)
+    check = args.check == "y"
+    print(f"{'routine':<8} {'type':<4} {'n':>7} {'error':>10} {'status':>6} "
+          f"{'time(s)':>9} {'gflops':>10}")
+    failures = 0
+    for routine in args.routines:
+        for prefix in args.type.split(","):
+            for n in _parse_dims(args.dim):
+                err, t, gflops, ok = ROUTINES[routine](n, _DTYPES[prefix], rng, check)
+                status = "pass" if ok else "FAILED"
+                failures += 0 if ok else 1
+                print(f"{routine:<8} {prefix:<4} {n:>7} {err:>10.2e} {status:>6} "
+                      f"{t:>9.4f} {gflops:>10.1f}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
