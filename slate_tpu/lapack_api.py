@@ -10,7 +10,6 @@ c/z (c64/c128) — the d/z versions require jax x64 to be enabled.
 
 from __future__ import annotations
 
-import functools
 
 import jax.numpy as jnp
 
@@ -34,16 +33,6 @@ from .linalg.norms import gecondest, pocondest
 from .types import Diag, Norm, Op, Side, Uplo
 
 _DTYPES = {"s": jnp.float32, "d": jnp.float64, "c": jnp.complex64, "z": jnp.complex128}
-
-
-def _typed(fn):
-    """Generate s/d/c/z-prefixed variants of ``fn(dtype, *args)``."""
-
-    @functools.wraps(fn)
-    def wrapper(prefix, *args, **kw):
-        return fn(_DTYPES[prefix], *args, **kw)
-
-    return wrapper
 
 
 def _cast(dtype, a):

@@ -114,7 +114,10 @@ def _larfg_masked(x: Array, nactive) -> Tuple[Array, Array]:
     x = jnp.where(mask, x, 0)
     alpha = x[0]
     tailnorm2 = jnp.sum(jnp.abs(x) ** 2) - jnp.abs(alpha) ** 2
-    degenerate = (tailnorm2 <= 0) & (~cplx | (jnp.imag(alpha) == 0))
+    if cplx:
+        degenerate = (tailnorm2 <= 0) & (jnp.imag(alpha) == 0)
+    else:
+        degenerate = tailnorm2 <= 0
     norm = jnp.sqrt(jnp.abs(alpha) ** 2 + tailnorm2)
     re_a = jnp.real(alpha)
     sgn = jnp.where(re_a >= 0, 1.0, -1.0)
@@ -302,7 +305,9 @@ def hegv_array(
     if not want_vectors:
         return heev_array(c, want_vectors=False, method=method), None, info
     w, z = heev_array(c, want_vectors=True, method=method)
-    if itype == 1:
+    # Back-transform (hegv.cc:100-105): itype 1 and 2 both have y = L^H x,
+    # so x = L^-H y (trsm); only itype 3 (B A x = lambda x) has x = L y.
+    if itype in (1, 2):
         x = trsm_array(Side.Left, Uplo.Lower, Op.ConjTrans, Diag.NonUnit, 1.0, l, z)
     else:
         x = trmm_array(Side.Left, Uplo.Lower, Op.NoTrans, Diag.NonUnit, 1.0, l, z)

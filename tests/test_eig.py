@@ -108,6 +108,40 @@ def test_hegv():
     assert np.abs(x.T @ b @ x - np.eye(n)).max() < 1e-10
 
 
+def test_hegv_itype2():
+    # itype=2: A B x = lambda x; back-transform is x = L^-H y (hegv.cc:100-105)
+    n = 36
+    rng = np.random.default_rng(11)
+    a = rng.standard_normal((n, n))
+    a = (a + a.T) / 2
+    g = rng.standard_normal((n, n))
+    b = g @ g.T + n * np.eye(n)
+    w, x, info = hegv_array(jnp.asarray(a), jnp.asarray(b), itype=2)
+    w, x = np.asarray(w), np.asarray(x)
+    assert int(info) == 0
+    denom = np.abs(a).max() * np.abs(b).max()
+    assert np.abs(a @ (b @ x) - x * w).max() / denom < 1e-10
+    # itype=2 eigvecs are B-orthonormal: x = L^-H y with y orthonormal
+    assert np.abs(x.T @ b @ x - np.eye(n)).max() < 1e-9
+
+
+def test_hegv_itype3():
+    # itype=3: B A x = lambda x; back-transform is x = L y
+    n = 36
+    rng = np.random.default_rng(12)
+    a = rng.standard_normal((n, n))
+    a = (a + a.T) / 2
+    g = rng.standard_normal((n, n))
+    b = g @ g.T + n * np.eye(n)
+    w, x, info = hegv_array(jnp.asarray(a), jnp.asarray(b), itype=3)
+    w, x = np.asarray(w), np.asarray(x)
+    assert int(info) == 0
+    denom = np.abs(a).max() * np.abs(b).max()
+    assert np.abs(b @ (a @ x) - x * w).max() / denom < 1e-10
+    # itype=3 eigvecs are B^-1-orthonormal: x = L y with y orthonormal
+    assert np.abs(x.T @ np.linalg.solve(b, x) - np.eye(n)).max() < 1e-9
+
+
 def test_hesv_indefinite():
     from slate_tpu.linalg.indefinite import hesv_array
 
