@@ -22,6 +22,7 @@ from .lu import (
     getrf,
     getrf_array,
     getrf_nopiv_array,
+    getrf_scan_array,
     getrf_tntpiv_array,
     getri_array,
     getrs_array,

@@ -32,7 +32,7 @@ from jax import lax
 from ..core.matrix import symmetrize, tri_project
 from ..ops.matmul import matmul
 from ..types import MethodEig, Option, Options, Uplo, get_option
-from .qr import QRFactors, _v_of, geqrf_array
+
 from .tridiag import stedc, steqr, sterf
 
 Array = jax.Array
@@ -41,60 +41,101 @@ _EIG_NB = 32  # stage-1 band width (reference nb; hb2st window size)
 
 
 class He2hbFactors(NamedTuple):
-    """Band matrix + per-panel compact-WY reflectors (he2hb's V/T storage,
-    reference T matrix family he2hb.cc:60-80)."""
+    """Band matrix + stacked compact-WY reflectors (he2hb's V/T storage,
+    reference T matrix family he2hb.cc:60-80).  ``v[k]`` holds panel k's
+    explicit reflectors in GLOBAL row coordinates (zeros above the panel's
+    pivot rows), padded to a common height — one fixed shape so the whole
+    reduction traces as a single fori_loop program."""
 
     band: Array  # (n, n) full Hermitian array with bandwidth-nb content
-    panels: Tuple[QRFactors, ...]
+    v: Array  # (K, np2, nb) global-coordinate reflectors
+    t: Array  # (K, nb, nb) per-panel WY accumulators
     nb: int
 
 
+def _he2hb_panel_count(n: int, nb: int) -> int:
+    k = 0
+    while (k + 1) * nb < n - 1:
+        k += 1
+    return k
+
+
 def he2hb(a: Array, nb: int = _EIG_NB) -> He2hbFactors:
-    """Full Hermitian -> Hermitian band (bandwidth nb), Q stored per panel."""
+    """Full Hermitian -> Hermitian band (bandwidth nb), Q stored per panel.
+
+    One lax.fori_loop over panels with static shapes (O(1) program size in
+    n): per step, an offset-pivot panel QR of the full-height block column,
+    scatter of [R; 0] + its mirror into the band, and the global masked
+    two-sided compact-WY update B' = B - W V^H - V W^H (the SBR structure
+    the reference builds with he2hb_{hemm,her2k,trmm,gemm} internal ops,
+    he2hb.cc:207-604).
+    """
+    from .qr import _larft_v, _panel_qr_offset
+
     n = a.shape[0]
     cplx = jnp.issubdtype(a.dtype, jnp.complexfloating)
     a = symmetrize(a, Uplo.Lower, conj=cplx)
-    panels = []
-    k = 0
-    while (k + 1) * nb < n - 1:
-        j0, c0 = k * nb, (k + 1) * nb
-        w = c0 - j0
-        pan = a[c0:, j0:c0]
-        f = geqrf_array(pan)
-        v = _v_of(f.vr, f.t.shape[0])
-        m2 = n - c0
-        # panel column <- [R; 0] and its Hermitian mirror
-        topw = min(w, m2)
-        r_full = jnp.zeros((m2, w), a.dtype)
-        r_full = r_full.at[:topw].set(jnp.triu(f.vr[:topw]))
-        a = a.at[c0:, j0:c0].set(r_full)
-        a = a.at[j0:c0, c0:].set(jnp.conj(r_full).T)
-        # two-sided trailing update: B' = Q^H B Q, Q = I - V T V^H
-        b = a[c0:, c0:]
-        y = matmul(b, v).astype(a.dtype)  # B V
-        wmat = matmul(y, f.t).astype(a.dtype)  # Y T
-        x = matmul(jnp.conj(f.t).T, matmul(jnp.conj(v).T, wmat)).astype(a.dtype)
-        wt = wmat - 0.5 * matmul(v, x).astype(a.dtype)
-        b = b - matmul(wt, jnp.conj(v).T).astype(a.dtype) - matmul(v, jnp.conj(wt).T).astype(a.dtype)
-        b = 0.5 * (b + jnp.conj(b).T) if cplx else 0.5 * (b + b.T)
-        a = a.at[c0:, c0:].set(b)
-        panels.append(f)
-        k += 1
-    return He2hbFactors(a, tuple(panels), nb)
+    nsteps = _he2hb_panel_count(n, nb)
+    np2 = max(n, (nsteps + 1) * nb)  # padding so panel slices never clamp
+    if nsteps == 0:
+        return He2hbFactors(
+            a, jnp.zeros((0, np2, nb), a.dtype), jnp.zeros((0, nb, nb), a.dtype), nb
+        )
+    ap = jnp.pad(a, ((0, np2 - n), (0, np2 - n)))
+    rows = jnp.arange(np2)
+
+    def body(k, carry):
+        ap, vs, ts = carry
+        j0 = k * nb
+        c0 = j0 + nb
+        colblk = jax.lax.dynamic_slice(ap, (0, j0), (np2, nb))
+        masked = jnp.where((rows >= c0)[:, None], colblk, 0)
+        r_a, v, tau = _panel_qr_offset(masked, c0)
+        t = _larft_v(v, tau)
+        # panel columns <- history above c0, [R; 0] below; mirror row block
+        newcols = jnp.where((rows >= c0)[:, None], r_a, colblk)
+        ap = jax.lax.dynamic_update_slice(ap, newcols, (0, j0))
+        rowblk = jax.lax.dynamic_slice(ap, (j0, 0), (nb, np2))
+        rowblk = jnp.where((rows >= c0)[None, :], jnp.conj(newcols).T, rowblk)
+        ap = jax.lax.dynamic_update_slice(ap, rowblk, (j0, 0))
+        # two-sided trailing update, global masked: v is zero above c0 so
+        # the update touches only the trailing block
+        y = matmul(ap, v).astype(ap.dtype)
+        y = jnp.where((rows >= c0)[:, None], y, 0)
+        wmat = matmul(y, t).astype(ap.dtype)
+        x = matmul(jnp.conj(t).T, matmul(jnp.conj(v).T, wmat)).astype(ap.dtype)
+        wt = wmat - 0.5 * matmul(v, x).astype(ap.dtype)
+        ap = (
+            ap
+            - matmul(wt, jnp.conj(v).T).astype(ap.dtype)
+            - matmul(v, jnp.conj(wt).T).astype(ap.dtype)
+        )
+        ap = 0.5 * (ap + (jnp.conj(ap).T if cplx else ap.T))
+        return ap, vs.at[k].set(v), ts.at[k].set(t)
+
+    vs0 = jnp.zeros((nsteps, np2, nb), a.dtype)
+    ts0 = jnp.zeros((nsteps, nb, nb), a.dtype)
+    ap, vs, ts = jax.lax.fori_loop(0, nsteps, body, (ap, vs0, ts0))
+    return He2hbFactors(ap[:n, :n], vs, ts, nb)
 
 
 def unmtr_he2hb(f: He2hbFactors, c: Array) -> Array:
     """C <- Q C with Q = Q_0 Q_1 ... (src/unmtr_he2hb.cc): applied
-    right-to-left so eigenvectors of the band matrix lift to the original."""
-    nb = f.nb
-    for k in range(len(f.panels) - 1, -1, -1):
-        fk = f.panels[k]
-        c0 = (k + 1) * nb
-        v = _v_of(fk.vr, fk.t.shape[0])
-        tail = c[c0:]
-        upd = matmul(v, matmul(fk.t, matmul(jnp.conj(v).T, tail))).astype(c.dtype)
-        c = c.at[c0:].set(tail - upd)
-    return c
+    right-to-left so eigenvectors of the band matrix lift to the original.
+    V is stored globally (zeros above each panel), so the update touches
+    only the rows below the panel with no dynamic slicing."""
+    nsteps, np2, _ = f.v.shape
+    n = c.shape[0]
+    cp = jnp.pad(c, ((0, np2 - n),) + ((0, 0),) * (c.ndim - 1))
+
+    def body(i, cp):
+        k = nsteps - 1 - i
+        v, t = f.v[k], f.t[k]
+        upd = matmul(v, matmul(t, matmul(jnp.conj(v).T, cp))).astype(cp.dtype)
+        return cp - upd
+
+    cp = jax.lax.fori_loop(0, nsteps, body, cp)
+    return cp[:n]
 
 
 # ---------------------------------------------------------------------------

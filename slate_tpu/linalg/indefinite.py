@@ -24,7 +24,7 @@ from jax import lax
 
 from ..ops.matmul import matmul
 from .eig import He2hbFactors, Hb2stFactors, he2hb, hb2st, _EIG_NB
-from .qr import _v_of
+
 
 Array = jax.Array
 
@@ -134,16 +134,20 @@ def _apply_q(f: HetrfFactors, c: Array, adjoint: bool) -> Array:
 
 
 def _unmtr_he2hb_adj(f1: He2hbFactors, c: Array) -> Array:
-    """C <- Q^H C for the stage-1 Q (forward order, T^H)."""
-    nb = f1.nb
-    for k in range(len(f1.panels)):
-        fk = f1.panels[k]
-        c0 = (k + 1) * nb
-        v = _v_of(fk.vr, fk.t.shape[0])
-        tail = c[c0:]
-        upd = matmul(v, matmul(jnp.conj(fk.t).T, matmul(jnp.conj(v).T, tail))).astype(c.dtype)
-        c = c.at[c0:].set(tail - upd)
-    return c
+    """C <- Q^H C for the stage-1 Q (forward order, T^H).  V is stored in
+    global row coordinates (zeros above each panel), so each update only
+    touches the panel's trailing rows."""
+    nsteps, np2, _ = f1.v.shape
+    n = c.shape[0]
+    cp = jnp.pad(c, ((0, np2 - n),) + ((0, 0),) * (c.ndim - 1))
+
+    def body(k, cp):
+        v, t = f1.v[k], f1.t[k]
+        upd = matmul(v, matmul(jnp.conj(t).T, matmul(jnp.conj(v).T, cp))).astype(cp.dtype)
+        return cp - upd
+
+    cp = jax.lax.fori_loop(0, nsteps, body, cp)
+    return cp[:n]
 
 
 def _unmtr_hb2st_adj(f2: Hb2stFactors, z: Array) -> Array:

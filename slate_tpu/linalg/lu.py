@@ -130,6 +130,148 @@ def getrf_array(a: jax.Array) -> LUFactors:
 
 
 # ---------------------------------------------------------------------------
+# Single-program scanned LU (north-star sizes)
+#
+# The recursive form above traces a full binary tree of panels — ~2n/w HLO
+# node groups, which explodes compile time and program size at n = 65536
+# (the reference hits the same wall differently: its task DAG is runtime-
+# scheduled, getrf.cc:86-200).  The scanned form is ONE lax.fori_loop whose
+# body works on full-size arrays with static shapes and row/col masks, so
+# the program is O(1) in n.  Cost: the trailing update runs on the full
+# matrix every step (~2.25x the optimal flop count for m = n) — the same
+# trade the masked mesh kernels make (parallel/dist_chol.py) — but every
+# flop is a big MXU gemm, and compile time stays flat.
+# ---------------------------------------------------------------------------
+
+
+def _swaps_to_perm(piv: jax.Array, kk, m: int, nb: int) -> jax.Array:
+    """Permutation vector from a panel's pivot-swap sequence.
+
+    piv[j] is the global row swapped with row kk+j at elimination step j
+    (LAPACK ipiv semantics, 0-based).
+    """
+
+    def step(j, pv):
+        gi = kk + j
+        a_, b_ = pv[gi], pv[piv[j]]
+        return pv.at[gi].set(b_).at[piv[j]].set(a_)
+
+    return jax.lax.fori_loop(0, nb, step, jnp.arange(m))
+
+
+def _panel_lu_masked(panel: jax.Array, kk, nmin: int, m_true: int, pivot: bool = True):
+    """LU of full-height panel columns [kk, kk+nb) with rows < kk frozen.
+    Returns (factored panel, pivot row per column).
+
+    The panel is (mp, nb) with rows >= m_true zero padding; elimination
+    step j operates on global row/col index kk+j and is masked off once
+    kk+j >= nmin = min(m, n).  Padded and dead (all-zero) columns keep
+    p = gi, matching LAPACK's keep-in-place zero-pivot behavior.  With
+    ``pivot=False`` no row interchanges happen (pre-pivoted panels,
+    tournament path).
+    """
+    mp, nb = panel.shape
+    rows = jnp.arange(mp)
+    cols = jnp.arange(nb)
+
+    def step(j, carry):
+        pan, piv = carry
+        gi = kk + j
+        active = gi < nmin
+        if pivot:
+            col = jax.lax.dynamic_slice(pan, (0, j), (mp, 1))[:, 0]
+            mag = jnp.where(
+                (rows >= gi) & (rows < m_true) & active, jnp.abs(col), -jnp.inf
+            )
+            p = jnp.argmax(mag)
+            p = jnp.where(active & (mag[p] > 0), p, gi)
+            # swap rows gi <-> p
+            r_gi = jax.lax.dynamic_slice(pan, (gi, 0), (1, nb))
+            r_p = jax.lax.dynamic_slice(pan, (p, 0), (1, nb))
+            pan = jax.lax.dynamic_update_slice(pan, r_p, (gi, 0))
+            pan = jax.lax.dynamic_update_slice(pan, r_gi, (p, 0))
+            piv = piv.at[j].set(p)
+        col = jax.lax.dynamic_slice(pan, (0, j), (mp, 1))[:, 0]
+        pivval = col[gi]
+        denom = jnp.where(pivval == 0, jnp.ones_like(pivval), pivval)
+        below = ((rows > gi) & active).astype(pan.dtype)
+        lcol = col / denom * below
+        newcol = col * (1 - below) + lcol
+        pan = jax.lax.dynamic_update_slice(pan, newcol[:, None], (0, j))
+        urow = pan[gi] * (cols > j).astype(pan.dtype)
+        pan = pan - jnp.outer(lcol, urow)
+        return pan, piv
+
+    piv0 = kk + jnp.arange(nb)  # identity swaps for masked-off columns
+    return jax.lax.fori_loop(0, nb, step, (panel, piv0))
+
+
+def _apply_bounded_perm(x: jax.Array, pv: jax.Array, targets: jax.Array):
+    """x[pv] when pv differs from the identity only at ``targets``
+    (static count): gather + scatter 2nb rows instead of all of x."""
+    vals = x[pv[targets]]
+    return x.at[targets].set(vals, mode="drop", unique_indices=False)
+
+
+def _scan_step_update(out, pan, perm, piv, kk, nb: int):
+    """Shared tail of one scanned panel step: apply the panel's row swaps
+    (bounded scatter — a panel moves at most 2nb rows), write the factored
+    panel back, masked trsm for the U row block, masked trailing gemm."""
+    mp, n = out.shape
+    rows = jnp.arange(mp)
+    cols = jnp.arange(n)
+
+    pv = _swaps_to_perm(piv, kk, mp, nb)
+    targets = jnp.concatenate([kk + jnp.arange(nb), piv])
+    out = _apply_bounded_perm(out, pv, targets)
+    perm = _apply_bounded_perm(perm, pv, targets)
+    out = jax.lax.dynamic_update_slice(out, pan, (0, kk))
+    l11 = tri_project(
+        jax.lax.dynamic_slice(pan, (kk, 0), (nb, nb)), Uplo.Lower, Diag.Unit
+    )
+    rowblk = jax.lax.dynamic_slice(out, (kk, 0), (nb, n))
+    u12 = trsm_array(Side.Left, Uplo.Lower, Op.NoTrans, Diag.Unit, 1.0, l11, rowblk)
+    right = (cols >= kk + nb)[None, :]
+    rowblk = jnp.where(right, u12, rowblk)
+    out = jax.lax.dynamic_update_slice(out, rowblk, (kk, 0))
+    l21 = pan * ((rows >= kk + nb)[:, None]).astype(pan.dtype)
+    u12m = rowblk * right.astype(pan.dtype)
+    out = out - matmul(l21, u12m).astype(out.dtype)
+    return out, perm
+
+
+def getrf_scan_array(a: jax.Array, nb: int = _PANEL_W) -> LUFactors:
+    """Partial-pivot LU as one fixed-shape scanned program (PA = LU).
+
+    Same math and pivot choices as ``getrf_array`` (src/getrf.cc
+    semantics); built for north-star sizes where the recursive trace is
+    too large to compile.  On exactly singular inputs the zero-pivot rows
+    stay in place (info > 0 flags them) rather than swapping zero rows.
+    """
+    m, n = a.shape
+    nmin = min(m, n)
+    nsteps = -(-nmin // nb)
+    # pad rows AND cols so the dynamic panel slices never clamp (a clamped
+    # start silently reads the wrong window)
+    mp = max(m, nsteps * nb)
+    np_ = max(n, nsteps * nb)
+    out = jnp.pad(a, ((0, mp - m), (0, np_ - n)))
+
+    def body(k, carry):
+        out, perm = carry
+        kk = k * nb
+        panel = jax.lax.dynamic_slice(out, (0, kk), (mp, nb))
+        pan, piv = _panel_lu_masked(panel, kk, nmin, m)
+        # the factored panel is already in post-swap row order; swapping
+        # `out` rows then overwriting columns [kk, kk+nb) reconciles both
+        out, perm = _scan_step_update(out, pan, perm, piv, kk, nb)
+        return out, perm
+
+    out, perm = jax.lax.fori_loop(0, nsteps, body, (out, jnp.arange(mp)))
+    return LUFactors(out[:m, :n], perm[:m], _lu_info(out[:m, :n]))
+
+
+# ---------------------------------------------------------------------------
 # No-pivot LU (src/getrf_nopiv.cc) — structurally potrf-like
 # ---------------------------------------------------------------------------
 
@@ -174,29 +316,35 @@ def getrf_nopiv_array(a: jax.Array) -> LUFactors:
 # ---------------------------------------------------------------------------
 
 
-def _tournament_pivots(panel: jax.Array, w: int) -> jax.Array:
-    """Select w pivot row indices via a binary reduction tree of small LUs
-    (communication-avoiding: one tree round replaces per-column exchanges).
-    Returns indices into panel rows, best rows first."""
-    m = panel.shape[0]
+def _tournament_pivots_masked(panel: jax.Array, w: int, kk, m_true: int) -> jax.Array:
+    """Tournament pivot selection over full-height panel rows with rows
+    < kk (already factored) and >= m_true (padding) masked out.  Static
+    shapes throughout: the block grid and tree depth depend only on the
+    padded height.  Returns w global row indices (invalid slots carry the
+    sentinel mp when fewer than w candidate rows remain)."""
+    mp = panel.shape[0]
+    rows = jnp.arange(mp)
+    valid = (rows >= kk) & (rows < m_true)
+    ap = jnp.where(valid[:, None], panel, 0)
+    idx = jnp.where(valid, rows, mp)  # sentinel rows sort last in each LU
     block = max(2 * w, _PANEL_W)
-    nblk = -(-m // block)
-    pad = nblk * block - m
-    ap = jnp.pad(panel, ((0, pad), (0, 0)))
-    idx = jnp.pad(jnp.arange(m), (0, pad), constant_values=m)  # pad rows sort last
+    nblk = -(-mp // block)
+    pad = nblk * block - mp
+    ap = jnp.pad(ap, ((0, pad), (0, 0)))
+    idx = jnp.pad(idx, (0, pad), constant_values=mp)
     cand_a = ap.reshape(nblk, block, w)
     cand_i = idx.reshape(nblk, block)
 
     def local_top(a_blk, i_blk):
-        lu, p = _panel_lu(a_blk)
+        _, p = _panel_lu(a_blk)
         return a_blk[p][:w], i_blk[p][:w]
 
     tops_a, tops_i = jax.vmap(local_top)(cand_a, cand_i)
     while tops_a.shape[0] > 1:
         k = tops_a.shape[0]
-        if k % 2 == 1:  # odd: carry last block through
+        if k % 2 == 1:  # odd: pad a dead block
             tops_a = jnp.concatenate([tops_a, tops_a[-1:] * 0], axis=0)
-            tops_i = jnp.concatenate([tops_i, jnp.full_like(tops_i[-1:], m)], axis=0)
+            tops_i = jnp.concatenate([tops_i, jnp.full_like(tops_i[-1:], mp)], axis=0)
             k += 1
         pa = tops_a.reshape(k // 2, 2 * w, w)
         pi = tops_i.reshape(k // 2, 2 * w)
@@ -204,35 +352,58 @@ def _tournament_pivots(panel: jax.Array, w: int) -> jax.Array:
     return tops_i[0]
 
 
-def getrf_tntpiv_array(a: jax.Array, nb: int = _NB) -> LUFactors:
-    """Blocked LU with tournament pivoting per panel.  Within a panel, the
-    tournament tree picks w pivot rows which are swapped to the top, then the
-    panel factors without further pivoting (getrf_tntpiv.cc:18-169)."""
+def _tournament_swap_seq(piv: jax.Array, kk, mp: int) -> jax.Array:
+    """Convert tournament-selected global rows into a LAPACK-style
+    sequential swap sequence (swap i brings selected row i to kk+i),
+    tracking row positions as earlier swaps displace them."""
+    w = piv.shape[0]
+
+    def step(i, carry):
+        seq, pos2row, row2pos = carry
+        tgt = kk + i
+        valid = piv[i] < mp
+        cur = jnp.where(valid, row2pos[jnp.minimum(piv[i], mp - 1)], tgt)
+        r1 = pos2row[tgt]
+        r2 = pos2row[cur]
+        pos2row = pos2row.at[tgt].set(r2).at[cur].set(r1)
+        row2pos = row2pos.at[r2].set(tgt).at[r1].set(cur)
+        return seq.at[i].set(cur), pos2row, row2pos
+
+    seq0 = kk + jnp.arange(w)
+    ident = jnp.arange(mp)
+    seq, _, _ = jax.lax.fori_loop(0, w, step, (seq0, ident, ident))
+    return seq
+
+
+def getrf_tntpiv_array(a: jax.Array, nb: int = _PANEL_W) -> LUFactors:
+    """Blocked LU with tournament pivoting (CALU) as one fixed-shape
+    scanned program.  Per panel, the tournament tree picks nb pivot rows
+    which are swapped to the top LAPACK-style, then the panel factors
+    without further interchanges (getrf_tntpiv.cc:18-169,
+    internal_getrf_tntpiv.cc)."""
     m, n = a.shape
-    perm = jnp.arange(m)
-    nb = min(nb, _PANEL_W)
-    out = a
-    # Python loop over panels: shapes shrink but repeat across calls of same
-    # (m, n, nb); masked single-program form is the round-2 optimization.
-    for k in range(0, min(m, n), nb):
-        w = min(nb, n - k, m - k)
-        panel = out[k:, k : k + w]
-        piv = _tournament_pivots(panel, w)
-        # build full row order for the trailing block: selected rows first
-        rest_mask = jnp.ones(panel.shape[0], dtype=bool).at[piv].set(False)
-        order = jnp.concatenate([piv, jnp.where(rest_mask, size=panel.shape[0] - w)[0]])
-        out = out.at[k:].set(out[k:][order])
-        perm = perm.at[k:].set(perm[k:][order])
-        # no-pivot factor of the pivoted panel + trailing update
-        blk = _nopiv_base(out[k:, k : k + w])
-        out = out.at[k:, k : k + w].set(blk)
-        if k + w < n:
-            l11 = blk[:w, :w]
-            u12 = trsm_array(Side.Left, Uplo.Lower, Op.NoTrans, Diag.Unit, 1.0, l11, out[k : k + w, k + w :])
-            out = out.at[k : k + w, k + w :].set(u12)
-            upd = matmul(blk[w:, :w], u12).astype(a.dtype)
-            out = out.at[k + w :, k + w :].add(-upd)
-    return LUFactors(out, perm, _lu_info(out))
+    nmin = min(m, n)
+    nb = min(nb, _PANEL_W, nmin)
+    nsteps = -(-nmin // nb)
+    mp = max(m, nsteps * nb)
+    np_ = max(n, nsteps * nb)
+    out = jnp.pad(a, ((0, mp - m), (0, np_ - n)))
+
+    def body(k, carry):
+        out, perm = carry
+        kk = k * nb
+        panel = jax.lax.dynamic_slice(out, (0, kk), (mp, nb))
+        piv_rows = _tournament_pivots_masked(panel, nb, kk, m)
+        piv = _tournament_swap_seq(piv_rows, kk, mp)
+        pv = _swaps_to_perm(piv, kk, mp, nb)
+        targets = jnp.concatenate([kk + jnp.arange(nb), piv])
+        panel = _apply_bounded_perm(panel, pv, targets)
+        pan, _ = _panel_lu_masked(panel, kk, nmin, m, pivot=False)
+        out, perm = _scan_step_update(out, pan, perm, piv, kk, nb)
+        return out, perm
+
+    out, perm = jax.lax.fori_loop(0, nsteps, body, (out, jnp.arange(mp)))
+    return LUFactors(out[:m, :n], perm[:m], _lu_info(out[:m, :n]))
 
 
 # ---------------------------------------------------------------------------

@@ -101,6 +101,75 @@ def _panel_qr(a: Array) -> Tuple[Array, Array]:
     return a, tau
 
 
+def _panel_qr_offset(a: Array, row0) -> Tuple[Array, Array, Array]:
+    """Householder QR of a full-height column block whose pivot row for
+    column j is the (traced) global row ``row0 + j``.
+
+    Rows < row0 of ``a`` must be zero (caller masks its history out); the
+    elimination never touches them, so the result can be scattered back
+    into a larger matrix without disturbing already-factored content.
+    Dead columns (no weight at or below the pivot) get tau = 0.
+
+    Returns (r, v, tau): ``r`` is ``a`` with R at rows row0..row0+w and
+    zeros below each pivot; ``v`` holds the explicit reflectors (unit
+    pivot entries, zeros above); ``tau`` the w scalar factors.
+
+    This is the fixed-shape panel the scanned two-stage reductions
+    (he2hb / ge2tb) loop over — the reference runs the same panel QR per
+    block column inside its task DAG (internal_geqrf.cc, he2hb.cc:207).
+    """
+    m, w = a.shape
+    rows = jnp.arange(m)
+    cplx = jnp.issubdtype(a.dtype, jnp.complexfloating)
+
+    def step(j, carry):
+        a, vmat, tau = carry
+        gi = row0 + j
+        col = jax.lax.dynamic_slice(a, (0, j), (m, 1))[:, 0]
+        below = rows > gi
+        alpha = col[gi]
+        xnorm2 = jnp.sum(jnp.where(below, jnp.abs(col) ** 2, 0))
+        anorm = jnp.sqrt(jnp.abs(alpha) ** 2 + xnorm2)
+        s = _sign_safe(
+            alpha if not cplx else jnp.where(jnp.real(alpha) == 0, jnp.asarray(1, a.dtype), alpha)
+        )
+        beta = -s * anorm.astype(a.dtype)
+        zero_col = anorm == 0
+        beta = jnp.where(zero_col, jnp.ones_like(beta), beta)
+        tj = (beta - alpha) / beta
+        tj = jnp.where(zero_col, jnp.zeros_like(tj), tj)
+        denom = alpha - beta
+        denom = jnp.where(denom == 0, jnp.ones_like(denom), denom)
+        v = jnp.where(below, col / denom, jnp.zeros_like(col))
+        v = v.at[gi].set(jnp.where(zero_col, jnp.zeros((), a.dtype), jnp.ones((), a.dtype)))
+        w_row = matmul(jnp.conj(v)[None, :], a)[0]
+        cmask = (jnp.arange(w) > j).astype(a.dtype)
+        a = a - jnp.outer(tj * v, w_row * cmask)
+        newcol = jnp.where(below, jnp.zeros_like(col), col)
+        newcol = newcol.at[gi].set(jnp.where(zero_col, alpha, beta))
+        a = jax.lax.dynamic_update_slice(a, newcol[:, None], (0, j))
+        return a, vmat.at[:, j].set(v), tau.at[j].set(tj)
+
+    r, v, tau = jax.lax.fori_loop(
+        0, w, step, (a, jnp.zeros_like(a), jnp.zeros(w, a.dtype))
+    )
+    return r, v, tau
+
+
+def _larft_v(v: Array, tau: Array) -> Array:
+    """Compact-WY T from explicit reflectors (columns of ``v``)."""
+    w = v.shape[1]
+    vhv = matmul(jnp.conj(v).T, v)
+
+    def step(j, t):
+        tcol = -tau[j] * matmul(t, vhv[:, j][:, None])[:, 0]
+        mask = (jnp.arange(w) < j).astype(v.dtype)
+        t = t.at[:, j].set(tcol * mask)
+        return t.at[j, j].set(tau[j])
+
+    return jax.lax.fori_loop(0, w, step, jnp.zeros((w, w), v.dtype))
+
+
 def _larft(vr: Array, tau: Array) -> Array:
     """Build the compact-WY T from packed reflectors (LAPACK larft forward
     columnwise): T[:j, j] = -tau_j * T[:j, :j] @ (V^H v_j)."""

@@ -22,7 +22,7 @@ TPU design:
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -30,17 +30,7 @@ from jax import lax
 
 from ..ops.matmul import matmul
 from .eig import _larfg_masked
-from .qr import (
-    LQFactors,
-    QRFactors,
-    _v_of,
-    gelqf_array,
-    geqrf_array,
-    unmlq_array,
-    unmqr_array,
-)
 from .tridiag import stedc, sterf
-from ..types import Op, Side
 
 Array = jax.Array
 
@@ -49,70 +39,123 @@ _SVD_NB = 32
 
 class Ge2tbFactors(NamedTuple):
     """Band + stage-1 reflectors (reference U/V T-matrix families,
-    ge2tb.cc:60-100)."""
+    ge2tb.cc:60-100).  Reflectors are stacked in GLOBAL coordinates
+    (``vq[k]`` zero above row k*nb; ``vl[k]`` zero above column (k+1)*nb,
+    stored as column vectors of A^H) so the whole reduction and both
+    back-transforms trace as single fori_loop programs."""
 
     band: Array  # (m, n) with upper-band content (bandwidth nb above diag)
-    qpanels: Tuple[QRFactors, ...]  # left (U-side) panels, col block k
-    lpanels: Tuple[LQFactors, ...]  # right (V-side) panels
+    vq: Array  # (K, mp2, nb) left (U-side) reflectors
+    tq: Array  # (K, nb, nb)
+    vl: Array  # (K, np2, nb) right (V-side) reflectors (zeros = dead panel)
+    tl: Array  # (K, nb, nb)
     nb: int
 
 
 def ge2tb(a: Array, nb: int = _SVD_NB) -> Ge2tbFactors:
-    """General (m >= n) -> upper triangular band, alternating QR/LQ panels."""
+    """General (m >= n) -> upper triangular band, alternating QR/LQ panels.
+
+    One lax.fori_loop over block columns with static shapes: per step an
+    offset-pivot panel QR of the (masked) full-height block column, a
+    global masked compact-WY application to the trailing columns, then the
+    mirrored LQ step on the block row (via QR of its conjugate transpose).
+    LQ steps that would destroy the final band (remaining width <= 1) are
+    masked to identity, matching the unrolled form's skip.
+    """
+    from .qr import _larft_v, _panel_qr_offset
+
     m, n = a.shape
-    qpanels, lpanels = [], []
+    if m < n:
+        raise ValueError(f"ge2tb requires m >= n, got {a.shape}")
     nblocks = -(-n // nb)
-    for k in range(nblocks):
+    mp2 = max(m, (nblocks + 1) * nb)
+    np2 = max(n, (nblocks + 1) * nb)
+    ap = jnp.pad(a, ((0, mp2 - m), (0, np2 - n)))
+    rows = jnp.arange(mp2)
+    cols = jnp.arange(np2)
+
+    def body(k, carry):
+        ap, vqs, tqs, vls, tls = carry
         j0 = k * nb
-        j1 = min(j0 + nb, n)
-        # QR panel: eliminate below-diagonal of block column k
-        fq = geqrf_array(a[j0:, j0:j1])
-        w = fq.t.shape[0]
-        topw = min(j1 - j0, m - j0)
-        rblk = jnp.zeros((m - j0, j1 - j0), a.dtype)
-        rblk = rblk.at[:topw].set(jnp.triu(fq.vr[:topw]))
-        rest = unmqr_array(Side.Left, Op.ConjTrans, fq, a[j0:, j1:])
-        a = a.at[j0:, j0:j1].set(rblk)
-        a = a.at[j0:, j1:].set(rest)
-        qpanels.append(fq)
-        # LQ panel: eliminate right of the first superdiagonal block — needed
-        # whenever the remaining width exceeds 1, else rows j0:j1 keep
-        # full-width content beyond the ku=nb band that tb2bd assumes
-        if n - j1 > 1:
-            fl = gelqf_array(a[j0:j1, j1:])
-            lw = fl.t.shape[0]
-            lblk = jnp.zeros((j1 - j0, n - j1), a.dtype)
-            kk = min(j1 - j0, n - j1)
-            lblk = lblk.at[:, :kk].set(jnp.tril(fl.lv[:, :kk]))
-            below = unmlq_array(Side.Right, Op.ConjTrans, fl, a[j1:, j1:])
-            a = a.at[j0:j1, j1:].set(lblk)
-            a = a.at[j1:, j1:].set(below)
-            lpanels.append(fl)
-    return Ge2tbFactors(a, tuple(qpanels), tuple(lpanels), nb)
+        j1 = j0 + nb
+        # ---- QR panel: eliminate below-diagonal of block column k
+        colblk = jax.lax.dynamic_slice(ap, (0, j0), (mp2, nb))
+        masked = jnp.where((rows >= j0)[:, None], colblk, 0)
+        r_a, vq, tauq = _panel_qr_offset(masked, j0)
+        tq = _larft_v(vq, tauq)
+        # apply Q^H to trailing columns (>= j1) before writing R back
+        w1 = matmul(jnp.conj(vq).T, ap)
+        upd = matmul(vq, matmul(jnp.conj(tq).T, w1)).astype(ap.dtype)
+        ap = ap - upd * (cols >= j1)[None, :].astype(ap.dtype)
+        newcols = jnp.where((rows >= j0)[:, None], r_a, colblk)
+        ap = jax.lax.dynamic_update_slice(ap, newcols, (0, j0))
+        # ---- LQ panel on block row k: eliminate right of the superdiagonal
+        # block, via QR of the conj-transposed row block
+        lq_active = j1 < n - 1
+        rowblk = jax.lax.dynamic_slice(ap, (j0, 0), (nb, np2))
+        rowblkh = jnp.conj(rowblk).T  # (np2, nb)
+        maskedh = jnp.where((cols >= j1)[:, None] & lq_active, rowblkh, 0)
+        l_a, vl, taul = _panel_qr_offset(maskedh, j1)
+        tl = _larft_v(vl, taul)
+        vl = vl * jnp.asarray(lq_active, ap.dtype)
+        tl = tl * jnp.asarray(lq_active, ap.dtype)
+        # apply from the right to rows >= j1: A <- A (I - Vl Tl Vl^H)
+        w2 = matmul(ap, vl)
+        upd = matmul(matmul(w2, tl), jnp.conj(vl).T).astype(ap.dtype)
+        ap = ap - upd * (rows >= j1)[:, None].astype(ap.dtype)
+        newrows = jnp.where(
+            ((cols >= j1) & lq_active)[None, :], jnp.conj(l_a).T, rowblk
+        )
+        ap = jax.lax.dynamic_update_slice(ap, newrows, (j0, 0))
+        return (
+            ap,
+            vqs.at[k].set(vq),
+            tqs.at[k].set(tq),
+            vls.at[k].set(vl),
+            tls.at[k].set(tl),
+        )
+
+    carry0 = (
+        ap,
+        jnp.zeros((nblocks, mp2, nb), a.dtype),
+        jnp.zeros((nblocks, nb, nb), a.dtype),
+        jnp.zeros((nblocks, np2, nb), a.dtype),
+        jnp.zeros((nblocks, nb, nb), a.dtype),
+    )
+    ap, vqs, tqs, vls, tls = jax.lax.fori_loop(0, nblocks, body, carry0)
+    return Ge2tbFactors(ap[:m, :n], vqs, tqs, vls, tls, nb)
 
 
 def unmbr_ge2tb_u(f: Ge2tbFactors, c: Array) -> Array:
     """C <- Q C for the stage-1 left factor (unmbr_ge2tb U side)."""
-    nb = f.nb
-    for k in range(len(f.qpanels) - 1, -1, -1):
-        j0 = k * nb
-        c = c.at[j0:].set(
-            unmqr_array(Side.Left, Op.NoTrans, f.qpanels[k], c[j0:])
-        )
-    return c
+    nsteps, mp2, _ = f.vq.shape
+    n = c.shape[0]
+    cp = jnp.pad(c, ((0, mp2 - n),) + ((0, 0),) * (c.ndim - 1))
+
+    def body(i, cp):
+        k = nsteps - 1 - i
+        v, t = f.vq[k], f.tq[k]
+        return cp - matmul(v, matmul(t, matmul(jnp.conj(v).T, cp))).astype(cp.dtype)
+
+    cp = jax.lax.fori_loop(0, nsteps, body, cp)
+    return cp[:n]
 
 
 def unmbr_ge2tb_v(f: Ge2tbFactors, c: Array) -> Array:
     """C <- P C for the stage-1 right factor (V side; P from the LQ
-    panels, applied as left ops on V columns)."""
-    nb = f.nb
-    for k in range(len(f.lpanels) - 1, -1, -1):
-        j1 = min(k * nb + nb, c.shape[0])
-        # LQ Q acts on the trailing rows; Q^H from gelqf = rows j1:
-        c = c.at[j1:].set(
-            unmlq_array(Side.Left, Op.ConjTrans, f.lpanels[k], c[j1:])
-        )
-    return c
+    panels, applied as left ops on V columns).  Dead panels carry zero
+    reflectors and apply as identity."""
+    nsteps, np2, _ = f.vl.shape
+    n = c.shape[0]
+    cp = jnp.pad(c, ((0, np2 - n),) + ((0, 0),) * (c.ndim - 1))
+
+    def body(i, cp):
+        k = nsteps - 1 - i
+        v, t = f.vl[k], f.tl[k]
+        return cp - matmul(v, matmul(t, matmul(jnp.conj(v).T, cp))).astype(cp.dtype)
+
+    cp = jax.lax.fori_loop(0, nsteps, body, cp)
+    return cp[:n]
 
 
 # ---------------------------------------------------------------------------

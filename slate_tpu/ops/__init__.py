@@ -18,3 +18,4 @@ from .tile_ops import (
     tzset,
 )
 from .matmul import matmul, matmul_pallas
+from .ozaki import matmul_f64

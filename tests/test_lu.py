@@ -187,3 +187,48 @@ def test_rbt_factors_reusable():
     x2 = f.solve(jnp.asarray(b2))
     resid = np.abs(a @ np.asarray(x2) - b2).max() / np.abs(b2).max()
     assert resid < 1e-8
+
+
+# ---------------------------------------------------------------------------
+# Scanned (single-program) variants — north-star-size code paths
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shape,nb", [((200, 200), 64), ((300, 100), 64),
+                                      ((100, 300), 32), ((65, 130), 64)])
+def test_getrf_scan_shapes(shape, nb):
+    from slate_tpu.linalg.lu import getrf_scan_array
+
+    a = generate("rands", *shape, np.float64, seed=7)
+    f = getrf_scan_array(jnp.asarray(a), nb=nb)
+    _check_lu(a, f)
+    assert sorted(np.asarray(f.perm).tolist()) == list(range(shape[0]))
+
+
+def test_getrf_scan_matches_recursive_pivots():
+    from slate_tpu.linalg.lu import getrf_scan_array
+
+    a = generate("rands", 96, 96, np.float64, seed=8)
+    f1 = getrf_scan_array(jnp.asarray(a))
+    f2 = getrf_array(jnp.asarray(a))
+    assert (np.asarray(f1.perm) == np.asarray(f2.perm)).all()
+    assert np.abs(np.asarray(f1.lu) - np.asarray(f2.lu)).max() < 1e-12
+
+
+def test_getrf_scan_singular_info():
+    from slate_tpu.linalg.lu import getrf_scan_array
+
+    a = np.asarray(generate("rands", 64, 64, np.float64, seed=9)).copy()
+    a[:, 10] = 0.0
+    f = getrf_scan_array(jnp.asarray(a))
+    assert int(f.info) == 11
+
+
+def test_getrf_tntpiv_scan_solve():
+    # non-diag-dominant solve through the scanned tournament path
+    a = generate("rands", 130, 130, np.float64, seed=10)
+    b = generate("rands", 130, 2, np.float64, seed=11)
+    f = getrf_tntpiv_array(jnp.asarray(a), nb=32)
+    _check_lu(a, f, rtol=1e-12)
+    x = np.asarray(getrs_array(f, jnp.asarray(b)))
+    assert np.abs(a @ x - b).max() / np.abs(a).max() < 1e-10
