@@ -213,7 +213,7 @@ def _apply_bounded_perm(x: jax.Array, pv: jax.Array, targets: jax.Array):
     return x.at[targets].set(vals, mode="drop", unique_indices=False)
 
 
-def _scan_step_update(out, pan, perm, piv, kk, nb: int):
+def _scan_step_update(out, pan, perm, piv, kk, nb: int, pv=None):
     """Shared tail of one scanned panel step: apply the panel's row swaps
     (bounded scatter — a panel moves at most 2nb rows), write the factored
     panel back, masked trsm for the U row block, masked trailing gemm."""
@@ -221,7 +221,8 @@ def _scan_step_update(out, pan, perm, piv, kk, nb: int):
     rows = jnp.arange(mp)
     cols = jnp.arange(n)
 
-    pv = _swaps_to_perm(piv, kk, mp, nb)
+    if pv is None:
+        pv = _swaps_to_perm(piv, kk, mp, nb)
     targets = jnp.concatenate([kk + jnp.arange(nb), piv])
     out = _apply_bounded_perm(out, pv, targets)
     perm = _apply_bounded_perm(perm, pv, targets)
@@ -316,22 +317,19 @@ def getrf_nopiv_array(a: jax.Array) -> LUFactors:
 # ---------------------------------------------------------------------------
 
 
-def _tournament_pivots_masked(panel: jax.Array, w: int, kk, m_true: int) -> jax.Array:
-    """Tournament pivot selection over full-height panel rows with rows
-    < kk (already factored) and >= m_true (padding) masked out.  Static
-    shapes throughout: the block grid and tree depth depend only on the
-    padded height.  Returns w global row indices (invalid slots carry the
-    sentinel mp when fewer than w candidate rows remain)."""
-    mp = panel.shape[0]
-    rows = jnp.arange(mp)
-    valid = (rows >= kk) & (rows < m_true)
-    ap = jnp.where(valid[:, None], panel, 0)
-    idx = jnp.where(valid, rows, mp)  # sentinel rows sort last in each LU
+def _tournament_reduce(ap: jax.Array, idx: jax.Array, w: int, sentinel: int):
+    """Binary-tree reduction of pivot candidates: small partial-pivot LUs
+    pick the w best rows per block, pairs of blocks merge until one block
+    remains.  ``ap`` (rows, w) must have invalid rows zeroed and ``idx``
+    (rows,) their ids set to ``sentinel``.  Returns (values, ids) of the w
+    winners.  Shared by the single-chip scanned tntpiv and the mesh
+    tournament (parallel/dist_lu.py)."""
+    mp = ap.shape[0]
     block = max(2 * w, _PANEL_W)
     nblk = -(-mp // block)
     pad = nblk * block - mp
     ap = jnp.pad(ap, ((0, pad), (0, 0)))
-    idx = jnp.pad(idx, (0, pad), constant_values=mp)
+    idx = jnp.pad(idx, (0, pad), constant_values=sentinel)
     cand_a = ap.reshape(nblk, block, w)
     cand_i = idx.reshape(nblk, block)
 
@@ -344,12 +342,29 @@ def _tournament_pivots_masked(panel: jax.Array, w: int, kk, m_true: int) -> jax.
         k = tops_a.shape[0]
         if k % 2 == 1:  # odd: pad a dead block
             tops_a = jnp.concatenate([tops_a, tops_a[-1:] * 0], axis=0)
-            tops_i = jnp.concatenate([tops_i, jnp.full_like(tops_i[-1:], mp)], axis=0)
+            tops_i = jnp.concatenate(
+                [tops_i, jnp.full_like(tops_i[-1:], sentinel)], axis=0
+            )
             k += 1
         pa = tops_a.reshape(k // 2, 2 * w, w)
         pi = tops_i.reshape(k // 2, 2 * w)
         tops_a, tops_i = jax.vmap(local_top)(pa, pi)
-    return tops_i[0]
+    return tops_a[0], tops_i[0]
+
+
+def _tournament_pivots_masked(panel: jax.Array, w: int, kk, m_true: int) -> jax.Array:
+    """Tournament pivot selection over full-height panel rows with rows
+    < kk (already factored) and >= m_true (padding) masked out.  Static
+    shapes throughout: the block grid and tree depth depend only on the
+    padded height.  Returns w global row indices (invalid slots carry the
+    sentinel mp when fewer than w candidate rows remain)."""
+    mp = panel.shape[0]
+    rows = jnp.arange(mp)
+    valid = (rows >= kk) & (rows < m_true)
+    ap = jnp.where(valid[:, None], panel, 0)
+    idx = jnp.where(valid, rows, mp)  # sentinel rows sort last in each LU
+    _, tops_i = _tournament_reduce(ap, idx, w, mp)
+    return tops_i
 
 
 def _tournament_swap_seq(piv: jax.Array, kk, mp: int) -> jax.Array:
@@ -399,7 +414,7 @@ def getrf_tntpiv_array(a: jax.Array, nb: int = _PANEL_W) -> LUFactors:
         targets = jnp.concatenate([kk + jnp.arange(nb), piv])
         panel = _apply_bounded_perm(panel, pv, targets)
         pan, _ = _panel_lu_masked(panel, kk, nmin, m, pivot=False)
-        out, perm = _scan_step_update(out, pan, perm, piv, kk, nb)
+        out, perm = _scan_step_update(out, pan, perm, piv, kk, nb, pv=pv)
         return out, perm
 
     out, perm = jax.lax.fori_loop(0, nsteps, body, (out, jnp.arange(mp)))

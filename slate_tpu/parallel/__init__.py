@@ -6,12 +6,14 @@ from .mesh import COL_AXIS, ROW_AXIS, make_mesh, mesh_shape, replicated, tile_sh
 from .dist import DistMatrix, empty_like, from_dense, padded_tiles, redistribute, to_dense
 from .summa import gemm_summa
 from .dist_chol import potrf_dist
-from .dist_lu import getrf_nopiv_dist
+from .dist_lu import getrf_nopiv_dist, getrf_tntpiv_dist, permute_rows_dist
 from .dist_trsm import trsm_dist
 from .drivers import (
     gemm_mesh,
     gesv_nopiv_mesh,
+    gesv_tntpiv_mesh,
     getrf_nopiv_mesh,
+    getrf_tntpiv_mesh,
     posv_mesh,
     potrf_mesh,
 )
@@ -32,10 +34,14 @@ __all__ = [
     "gemm_summa",
     "potrf_dist",
     "getrf_nopiv_dist",
+    "getrf_tntpiv_dist",
+    "permute_rows_dist",
     "trsm_dist",
     "gemm_mesh",
     "gesv_nopiv_mesh",
+    "gesv_tntpiv_mesh",
     "getrf_nopiv_mesh",
+    "getrf_tntpiv_mesh",
     "posv_mesh",
     "potrf_mesh",
 ]

@@ -1,8 +1,9 @@
-"""Distributed right-looking LU (no-pivot and tournament-pivot entry) over
-the block-cyclic mesh.
+"""Distributed right-looking LU (no-pivot and tournament-pivot) over the
+block-cyclic mesh.
 
-TPU-native analogue of ``src/getrf_nopiv.cc`` (same task structure as potrf:
-panel, bcast, trailing gemm) and the scaffolding of ``src/getrf_tntpiv.cc``.
+TPU-native analogues of ``src/getrf_nopiv.cc`` (same task structure as
+potrf: panel, bcast, trailing gemm) and ``src/getrf_tntpiv.cc`` (CALU) with
+``src/internal/internal_swap.cc``'s cross-rank row motion.
 
 Per k inside one ``lax.fori_loop`` (see dist_chol.py for the pattern):
 - diagonal tile -> everyone (masked psums), factored redundantly with the
@@ -14,11 +15,17 @@ Per k inside one ``lax.fori_loop`` (see dist_chol.py for the pattern):
   (listBcast right + down, getrf_nopiv.cc), then one masked batched einsum
   subtracts L[i,k] U[k,j] from the trailing tiles.
 
-Partial pivoting across ranks (getrf.cc row swaps, internal_swap.cc) is
-deliberately NOT done at the mesh level: the TPU-friendly default is
-tournament pivoting confined to tile panels (getrf_tntpiv.cc) or the RBT
-preconditioner (gesv_rbt) + no-pivot mesh LU, both of which keep row motion
-local.  Single-chip partial pivoting lives in linalg.lu.getrf_array.
+``getrf_tntpiv_dist`` prepends per step: a tournament over the panel tile
+column — each device reduces its local candidate rows through the binary
+LU tree (linalg.lu._tournament_reduce), an all_gather over mesh axis 'p'
+merges the per-device winners (the reference's cross-rank tournament
+rounds, internal_getrf_tntpiv.cc), the winner ids broadcast along 'q' —
+then cross-shard full-row swaps: the <= 2nb affected row slices are
+psum-gathered over 'p' and scattered to their destinations (the TPU form
+of internal_swap.cc:136-300's per-row MPI sends: one collective instead of
+nb point-to-points).  Partial pivoting proper (argmax per column, getrf.cc)
+stays single-chip: tournament pivoting IS the communication-avoiding mesh
+variant the reference prefers at scale.
 """
 
 from __future__ import annotations
@@ -31,7 +38,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..linalg.lu import _getrf_nopiv_rec
+from ..linalg.lu import _getrf_nopiv_rec, _tournament_reduce
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 from .comm import (
@@ -55,66 +62,78 @@ def getrf_nopiv_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array]:
     ), info
 
 
+def _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c):
+    """One right-looking LU tile step (panel solves + bcasts + trailing
+    gemm) on the swapped/unswapped local stack.  Shared by the no-pivot
+    and tournament kernels."""
+    nb = t_loc.shape[2]
+    dtype = t_loc.dtype
+    eye = jnp.eye(nb, dtype=dtype)
+    kr, kc = k // p, k // q
+    dtile = bcast_diag_tile(t_loc, k, p, q, nb)
+    luk = _getrf_nopiv_rec(dtile)  # packed L\U, unit L diag implicit
+    ukk = jnp.triu(luk)
+
+    # panel column: L[i,k] = A[i,k] U_kk^{-1}  (i > k)
+    pcol = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)[:, 0]
+    lsolved = lax.linalg.triangular_solve(
+        jnp.broadcast_to(ukk, pcol.shape), pcol,
+        left_side=False, lower=False, transpose_a=False,
+    )
+    below = (i_log > k)[:, None, None]
+    on_d = (i_log == k)[:, None, None]
+    newcol = jnp.where(below, lsolved, jnp.where(on_d, luk, pcol))
+    mine_c = (c == k % q)
+    t_loc = lax.dynamic_update_slice_in_dim(
+        t_loc, jnp.where(mine_c, newcol, pcol)[:, None], kc, axis=1
+    )
+
+    # panel row: U[k,j] = L_kk^{-1} A[k,j]  (j > k)
+    prow = lax.dynamic_slice_in_dim(t_loc, kr, 1, axis=0)[0]
+    usolved = lax.linalg.triangular_solve(
+        jnp.broadcast_to(jnp.tril(luk, -1) + eye, prow.shape), prow,
+        left_side=True, lower=True, transpose_a=False,
+        unit_diagonal=True,
+    )
+    right = (j_log > k)[:, None, None]
+    newrow = jnp.where(right, usolved, prow)
+    mine_r = (r == k % p)
+    t_loc = lax.dynamic_update_slice_in_dim(
+        t_loc, jnp.where(mine_r, newrow, prow)[None], kr, axis=0
+    )
+
+    # broadcasts + trailing update (masked by the zeros in pan/prow)
+    pan = bcast_from_col(jnp.where(below & mine_c, newcol, 0), k % q)
+    urow = bcast_from_row(jnp.where(right & mine_r, newrow, 0), k % p)
+    upd = jnp.einsum("iab,jbc->ijac", pan, urow, precision=PRECISE)
+    return t_loc - upd.astype(dtype)
+
+
+def _lu_info_dist(t_loc, i_log, j_log, nt, nb):
+    """info: 1 + first zero/non-finite U diagonal (getrf.cc:102-104)."""
+    diag_tiles = (i_log[:, None] == j_log[None, :])[:, :, None]
+    dvals = jnp.einsum("ijaa->ija", t_loc)
+    bad = (~jnp.isfinite(jnp.abs(dvals)) | (dvals == 0)) & diag_tiles
+    gidx = i_log[:, None, None] * nb + jnp.arange(nb)[None, None, :] + 1
+    big = nt * nb + 1
+    local_info = jnp.min(jnp.where(bad, gidx, big))
+    info = lax.pmin(lax.pmin(local_info, ROW_AXIS), COL_AXIS)
+    return jnp.where(info >= big, 0, info).astype(jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
 def _lu_jit(at, mesh, p, q, nt):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
         mtl, ntl, nb, _ = t_loc.shape
-        dtype = t_loc.dtype
         r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
-        eye = jnp.eye(nb, dtype=dtype)
 
         def step(k, t_loc):
-            kr, kc = k // p, k // q
-            dtile = bcast_diag_tile(t_loc, k, p, q, nb)
-            luk = _getrf_nopiv_rec(dtile)  # packed L\U, unit L diag implicit
-            ukk = jnp.triu(luk)
-
-            # panel column: L[i,k] = A[i,k] U_kk^{-1}  (i > k)
-            pcol = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)[:, 0]
-            lsolved = lax.linalg.triangular_solve(
-                jnp.broadcast_to(ukk, pcol.shape), pcol,
-                left_side=False, lower=False, transpose_a=False,
-            )
-            below = (i_log > k)[:, None, None]
-            on_d = (i_log == k)[:, None, None]
-            newcol = jnp.where(below, lsolved, jnp.where(on_d, luk, pcol))
-            mine_c = (c == k % q)
-            t_loc = lax.dynamic_update_slice_in_dim(
-                t_loc, jnp.where(mine_c, newcol, pcol)[:, None], kc, axis=1
-            )
-
-            # panel row: U[k,j] = L_kk^{-1} A[k,j]  (j > k)
-            prow = lax.dynamic_slice_in_dim(t_loc, kr, 1, axis=0)[0]
-            usolved = lax.linalg.triangular_solve(
-                jnp.broadcast_to(jnp.tril(luk, -1) + eye, prow.shape), prow,
-                left_side=True, lower=True, transpose_a=False,
-                unit_diagonal=True,
-            )
-            right = (j_log > k)[:, None, None]
-            newrow = jnp.where(right, usolved, prow)
-            mine_r = (r == k % p)
-            t_loc = lax.dynamic_update_slice_in_dim(
-                t_loc, jnp.where(mine_r, newrow, prow)[None], kr, axis=0
-            )
-
-            # broadcasts + trailing update (masked by the zeros in pan/prow)
-            pan = bcast_from_col(jnp.where(below & mine_c, newcol, 0), k % q)
-            urow = bcast_from_row(jnp.where(right & mine_r, newrow, 0), k % p)
-            upd = jnp.einsum("iab,jbc->ijac", pan, urow, precision=PRECISE)
-            return t_loc - upd.astype(dtype)
+            return _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c)
 
         t_loc = lax.fori_loop(0, nt, step, t_loc)
-        # info: 1 + first zero/non-finite U diagonal (getrf.cc:102-104)
-        diag_tiles = (i_log[:, None] == j_log[None, :])[:, :, None]
-        dvals = jnp.einsum("ijaa->ija", t_loc)
-        bad = (~jnp.isfinite(jnp.abs(dvals)) | (dvals == 0)) & diag_tiles
-        gidx = i_log[:, None, None] * nb + jnp.arange(nb)[None, None, :] + 1
-        big = nt * nb + 1
-        local_info = jnp.min(jnp.where(bad, gidx, big))
-        info = lax.pmin(lax.pmin(local_info, ROW_AXIS), COL_AXIS)
-        info = jnp.where(info >= big, 0, info).astype(jnp.int32)
+        info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, info[None, None]
 
     lut, info = shard_map(
@@ -125,3 +144,164 @@ def _lu_jit(at, mesh, p, q, nt):
         check_vma=False,
     )(at)
     return lut, jnp.max(info)
+
+
+# ---------------------------------------------------------------------------
+# Tournament-pivoted mesh LU (CALU, src/getrf_tntpiv.cc + internal_swap.cc)
+# ---------------------------------------------------------------------------
+
+
+def getrf_tntpiv_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array, jax.Array]:
+    """Factor P A = L U with tournament pivoting across the mesh.
+
+    Returns (LU DistMatrix, perm, info): ``perm`` is the global row
+    permutation over the PADDED row space (length mt*nb; rows >= a.m are
+    pad fixed points) with LAPACK meaning row i of PA = original row
+    perm[i].
+    """
+    p, q = mesh_shape(a.mesh)
+    if a.mt != a.nt:
+        raise ValueError("getrf_tntpiv_dist needs a square tile grid")
+    a.require_diag_pad("getrf_tntpiv_dist")
+    lut, perm, info = _tntpiv_jit(a.tiles, a.mesh, p, q, a.nt, a.m)
+    return (
+        DistMatrix(tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True),
+        perm,
+        info,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
+def _tntpiv_jit(at, mesh, p, q, nt, m_true):
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(t_loc):
+        mtl, ntl, nb, _ = t_loc.shape
+        dtype = t_loc.dtype
+        r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
+        mglob = nt * nb  # padded global row count
+        sent = mglob  # tournament sentinel (sorts last, marks dead slots)
+        flat_gids = (i_log[:, None] * nb + jnp.arange(nb)[None, :]).reshape(-1)
+
+        def step(k, carry):
+            t_loc, rowperm = carry
+            base = k * nb
+            kc = k // q
+
+            # ---- local tournament over my slice of panel column k ----
+            pcol = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)[:, 0]
+            flat = pcol.reshape(mtl * nb, nb)
+            valid = (flat_gids >= base) & (flat_gids < m_true) & (c == k % q)
+            cand = jnp.where(valid[:, None], flat, 0)
+            ids = jnp.where(valid, flat_gids, sent)
+            vloc, iloc = _tournament_reduce(cand, ids, nb, sent)
+
+            # ---- cross-row merge: gather per-device winners, re-reduce ----
+            ga = lax.all_gather(vloc, ROW_AXIS, axis=0).reshape(p * nb, nb)
+            gi = lax.all_gather(iloc, ROW_AXIS, axis=0).reshape(p * nb)
+            _, win = _tournament_reduce(ga, gi, nb, sent)
+            win = bcast_from_col(jnp.where(c == k % q, win, 0), k % q)
+
+            # ---- simulate the LAPACK-style sequential swaps (replicated):
+            # swap j brings winner row win[j] (at its CURRENT position —
+            # earlier swaps in this panel may have displaced it) to
+            # position base+j.  pos2row/row2pos track the displacement,
+            # like linalg.lu._tournament_swap_seq does single-chip.
+            ident = jnp.arange(mglob)
+
+            def sim(j, sc):
+                pos2row, row2pos, rp = sc
+                b_ = win[j]
+                ok = b_ < sent
+                bc = jnp.minimum(b_, mglob - 1)
+                tgt = base + j
+                cur = jnp.where(ok, row2pos[bc], tgt)
+                r1 = pos2row[tgt]
+                r2 = pos2row[cur]
+                pos2row2 = pos2row.at[tgt].set(r2).at[cur].set(r1)
+                row2pos2 = row2pos.at[r2].set(tgt).at[r1].set(cur)
+                pa_, pb_ = rp[tgt], rp[cur]
+                rp2 = rp.at[tgt].set(pb_).at[cur].set(pa_)
+                return (
+                    jnp.where(ok, pos2row2, pos2row),
+                    jnp.where(ok, row2pos2, row2pos),
+                    jnp.where(ok, rp2, rp),
+                )
+
+            pos2row, _, rowperm = lax.fori_loop(0, nb, sim, (ident, ident, rowperm))
+
+            # every position a panel swap can touch is in {base..base+nb} u
+            # {original winner positions}; second-half slots whose winner
+            # sat inside block k (or was a sentinel) duplicate a first-half
+            # slot and are dropped
+            pos = jnp.concatenate([base + jnp.arange(nb), win])
+            slot_ok = jnp.concatenate(
+                [jnp.ones(nb, bool), (win >= base + nb) & (win < sent)]
+            )
+            occ = pos2row[jnp.minimum(pos, mglob - 1)]  # final occupant rows
+
+            # ---- physical full-row swap: gather the <= 2nb source row
+            # slices over 'p', scatter to their destinations ----
+            src = jnp.minimum(occ, mglob - 1)
+            src_t, src_r = src // nb, src % nb
+            own_src = (src_t % p == r) & slot_ok
+            vals = t_loc[jnp.minimum(src_t // p, mtl - 1), :, src_r, :]
+            vals = jnp.where(own_src[:, None, None], vals, 0)
+            rows_data = lax.psum(vals, ROW_AXIS)
+            dst = jnp.minimum(pos, mglob - 1)
+            dst_t, dst_r = dst // nb, dst % nb
+            own_dst = (dst_t % p == r) & slot_ok
+            dst_loc = jnp.where(own_dst, dst_t // p, mtl)  # mtl -> dropped
+            t_loc = t_loc.at[dst_loc, :, dst_r, :].set(
+                rows_data.astype(dtype), mode="drop"
+            )
+
+            # ---- standard right-looking step on the pivoted panel ----
+            return _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c), rowperm
+
+        rowperm0 = jnp.arange(mglob)
+        t_loc, rowperm = lax.fori_loop(0, nt, step, (t_loc, rowperm0))
+        info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
+        return t_loc, rowperm[None], info[None, None]
+
+    lut, perm, info = shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=(spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS)),
+        check_vma=False,
+    )(at)
+    # every device computes the identical replicated permutation; the
+    # out-spec stacks one copy per mesh row — take the first
+    return lut, perm[0], jnp.max(info)
+
+
+def permute_rows_dist(b: DistMatrix, perm: jax.Array) -> DistMatrix:
+    """B <- P B for a global row permutation over the padded row space
+    (the pivot-application data motion of getrs, internal_swap.cc run as
+    one collective).  Cost: one all_gather of B over mesh axis 'p' — meant
+    for skinny right-hand sides."""
+    p, q = mesh_shape(b.mesh)
+    bt = _permute_rows_jit(b.tiles, jnp.asarray(perm), b.mesh, p, q)
+    return DistMatrix(
+        tiles=bt, m=b.m, n=b.n, nb=b.nb, mesh=b.mesh, diag_pad=b.diag_pad
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _permute_rows_jit(bt, perm, mesh, p, q):
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(b_loc, perm):
+        mtl, ntl, nb, _ = b_loc.shape
+        r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
+        all_b = lax.all_gather(b_loc, ROW_AXIS, axis=0)  # (p, mtl, ntl, nb, nb)
+        g = i_log[:, None] * nb + jnp.arange(nb)[None, :]  # my dest rows
+        src = perm[g]
+        st, sr = src // nb, src % nb
+        new = all_b[st % p, st // p, :, sr, :]  # (mtl, nb, ntl, nb)
+        return jnp.transpose(new, (0, 2, 1, 3))
+
+    return shard_map(
+        kernel, mesh=mesh, in_specs=(spec, P()), out_specs=spec, check_vma=False
+    )(bt, perm)

@@ -21,7 +21,7 @@ from jax.sharding import Mesh
 from ..types import Diag, Op, Uplo
 from .dist import DistMatrix, from_dense, to_dense
 from .dist_chol import potrf_dist
-from .dist_lu import getrf_nopiv_dist
+from .dist_lu import getrf_nopiv_dist, getrf_tntpiv_dist, permute_rows_dist
 from .dist_trsm import trsm_dist
 from .summa import gemm_summa
 
@@ -67,10 +67,31 @@ def gesv_nopiv_mesh(
     a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed LU solve without pivoting (src/gesv_nopiv path). For
-    general matrices compose with the RBT preconditioner (linalg.rbt) or use
-    the single-chip partial-pivot getrf."""
+    general matrices use gesv_tntpiv_mesh (tournament pivoting), the RBT
+    preconditioner (linalg.rbt), or the single-chip partial-pivot getrf."""
     lu, info = getrf_nopiv_mesh(a, mesh, nb)
     bd = from_dense(b, mesh, nb)
     y = trsm_dist(lu, bd, Uplo.Lower, Op.NoTrans, Diag.Unit)
+    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans)
+    return to_dense(x), info
+
+
+def getrf_tntpiv_mesh(
+    a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
+) -> Tuple[DistMatrix, jax.Array, jax.Array]:
+    """Distributed tournament-pivoted LU (src/getrf_tntpiv.cc): P A = L U.
+    Returns (LU, perm over the padded row space, info)."""
+    return getrf_tntpiv_dist(from_dense(a, mesh, nb, diag_pad_one=True))
+
+
+def gesv_tntpiv_mesh(
+    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
+) -> Tuple[jax.Array, jax.Array]:
+    """Distributed general solve with tournament pivoting
+    (src/gesv.cc with MethodLU::CALU): factor, permute B, two trsm sweeps."""
+    lu, perm, info = getrf_tntpiv_mesh(a, mesh, nb)
+    bd = from_dense(b, mesh, nb)
+    pb = permute_rows_dist(bd, perm)
+    y = trsm_dist(lu, pb, Uplo.Lower, Op.NoTrans, Diag.Unit)
     x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans)
     return to_dense(x), info
