@@ -8,10 +8,13 @@ from .summa import gemm_summa
 from .dist_chol import potrf_dist
 from .dist_lu import getrf_nopiv_dist, getrf_tntpiv_dist, permute_rows_dist
 from .dist_trsm import trsm_dist
+from .dist_qr import DistQR, geqrf_dist, unmqr_dist
 from .drivers import (
     gemm_mesh,
     gesv_nopiv_mesh,
     gesv_tntpiv_mesh,
+    gels_mesh,
+    geqrf_mesh,
     getrf_nopiv_mesh,
     getrf_tntpiv_mesh,
     posv_mesh,
@@ -37,6 +40,11 @@ __all__ = [
     "getrf_tntpiv_dist",
     "permute_rows_dist",
     "trsm_dist",
+    "DistQR",
+    "geqrf_dist",
+    "unmqr_dist",
+    "gels_mesh",
+    "geqrf_mesh",
     "gemm_mesh",
     "gesv_nopiv_mesh",
     "gesv_tntpiv_mesh",

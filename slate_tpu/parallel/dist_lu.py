@@ -282,7 +282,14 @@ def permute_rows_dist(b: DistMatrix, perm: jax.Array) -> DistMatrix:
     one collective).  Cost: one all_gather of B over mesh axis 'p' — meant
     for skinny right-hand sides."""
     p, q = mesh_shape(b.mesh)
-    bt = _permute_rows_jit(b.tiles, jnp.asarray(perm), b.mesh, p, q)
+    perm = jnp.asarray(perm)
+    mglob = b.mt * b.nb
+    if perm.shape != (mglob,):
+        raise ValueError(
+            f"permute_rows_dist: perm must cover the padded row space "
+            f"({mglob},), got {perm.shape}"
+        )
+    bt = _permute_rows_jit(b.tiles, perm, b.mesh, p, q)
     return DistMatrix(
         tiles=bt, m=b.m, n=b.n, nb=b.nb, mesh=b.mesh, diag_pad=b.diag_pad
     )

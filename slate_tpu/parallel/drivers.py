@@ -22,6 +22,7 @@ from ..types import Diag, Op, Uplo
 from .dist import DistMatrix, from_dense, to_dense
 from .dist_chol import potrf_dist
 from .dist_lu import getrf_nopiv_dist, getrf_tntpiv_dist, permute_rows_dist
+from .dist_qr import geqrf_dist, unmqr_dist
 from .dist_trsm import trsm_dist
 from .summa import gemm_summa
 
@@ -74,6 +75,34 @@ def gesv_nopiv_mesh(
     y = trsm_dist(lu, bd, Uplo.Lower, Op.NoTrans, Diag.Unit)
     x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans)
     return to_dense(x), info
+
+
+def geqrf_mesh(a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB):
+    """Distributed CAQR factorization (src/geqrf.cc). Returns DistQR."""
+    return geqrf_dist(from_dense(a, mesh, nb))
+
+
+def gels_mesh(
+    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
+) -> Tuple[jax.Array, jax.Array]:
+    """Distributed least squares min ||A X - B|| for m >= n via CAQR
+    (src/gels_qr.cc): X = R^-1 (Q^H B)[:n].  Returns (X, R diag info).
+
+    The R top-square re-distribution goes through one dense round trip —
+    the tile-level redistribute is the scalable path (redistribute()).
+    """
+    m, n = a.shape
+    f = geqrf_mesh(a, mesh, nb)
+    bd = from_dense(b, mesh, nb)
+    qb = to_dense(unmqr_dist(f, bd, Op.ConjTrans))[:n]
+    r = jnp.triu(to_dense(f.fact)[:n, :n])
+    rd = from_dense(r, mesh, nb, diag_pad_one=True)
+    xd = trsm_dist(rd, from_dense(qb, mesh, nb), Uplo.Upper, Op.NoTrans)
+    rdiag = jnp.diagonal(r)
+    info = jnp.where(
+        jnp.any(rdiag == 0), jnp.argmax(rdiag == 0) + 1, 0
+    ).astype(jnp.int32)
+    return to_dense(xd), info
 
 
 def getrf_tntpiv_mesh(
