@@ -7,8 +7,9 @@ from .dist import DistMatrix, empty_like, from_dense, padded_tiles, redistribute
 from .summa import gemm_summa
 from .dist_chol import potrf_dist
 from .dist_lu import getrf_nopiv_dist, getrf_tntpiv_dist, permute_rows_dist
-from .dist_trsm import trsm_dist
+from .dist_trsm import trsm_dist, trsm_dist_right
 from .dist_qr import DistQR, geqrf_dist, unmqr_dist
+from .dist_aux import herk_dist, norm_dist
 from .drivers import (
     gemm_mesh,
     gesv_nopiv_mesh,
@@ -40,6 +41,9 @@ __all__ = [
     "getrf_tntpiv_dist",
     "permute_rows_dist",
     "trsm_dist",
+    "trsm_dist_right",
+    "herk_dist",
+    "norm_dist",
     "DistQR",
     "geqrf_dist",
     "unmqr_dist",

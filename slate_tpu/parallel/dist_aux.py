@@ -1,0 +1,153 @@
+"""Distributed norms and Hermitian rank-k updates over the block-cyclic
+mesh — the pieces a distributed solve needs to residual-check itself
+without ever gathering to one host.
+
+TPU-native analogues of ``src/norm.cc`` (local tile norms +
+``MPI_Allreduce``; internal_genorm.cc) and ``src/herk.cc`` /
+``src/internal/internal_herk.cc`` (SUMMA-style trailing product with the
+transposed panel obtained by column index, cf. dist_chol.py).
+
+Padding note: DistMatrix pads tile grids (and, for factor inputs, puts 1
+on the pad diagonal), so every kernel here masks by the true (m, n)
+extent before reducing — otherwise pad identity blocks leak into norms.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..types import Norm, Uplo
+from .comm import PRECISE, bcast_from_col, local_indices, shard_map
+from .dist import DistMatrix
+from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
+
+
+def norm_dist(norm: Norm, d: DistMatrix) -> jax.Array:
+    """Matrix norm of a DistMatrix, computed fully distributed
+    (src/norm.cc: local reduce + allreduce).  One/Inf/Max/Fro."""
+    p, q = mesh_shape(d.mesh)
+    return _norm_jit(d.tiles, d.mesh, p, q, d.m, d.n, norm)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
+def _norm_jit(at, mesh, p, q, m_true, n_true, norm):
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(t_loc):
+        mtl, ntl, nb, _ = t_loc.shape
+        r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
+        gr = i_log[:, None, None, None] * nb + jnp.arange(nb)[None, None, :, None]
+        gc = j_log[None, :, None, None] * nb + jnp.arange(nb)[None, None, None, :]
+        mask = (gr < m_true) & (gc < n_true)
+        absa = jnp.where(mask, jnp.abs(t_loc), 0)
+
+        def allred(x, op):
+            return op(op(x, ROW_AXIS), COL_AXIS)
+
+        if norm == Norm.Max:
+            out = allred(jnp.max(absa), lax.pmax)
+        elif norm == Norm.Fro:
+            # lassq-style scaling (cf. ops.tile_ops.genorm): divide by the
+            # global max before squaring so huge entries do not overflow
+            amax = allred(jnp.max(absa), lax.pmax)
+            scale = jnp.where(amax > 0, amax, 1)
+            ssq = allred(jnp.sum((absa / scale) ** 2), lax.psum)
+            out = scale * jnp.sqrt(ssq)
+        elif norm == Norm.One:
+            colsums = jnp.sum(absa, axis=(0, 2))  # (ntl, nb) local col sums
+            colsums = lax.psum(colsums, ROW_AXIS)
+            out = lax.pmax(jnp.max(colsums), COL_AXIS)
+            out = lax.pmax(out, ROW_AXIS)  # replicate across rows too
+        elif norm == Norm.Inf:
+            rowsums = jnp.sum(absa, axis=(1, 3))  # (mtl, nb)
+            rowsums = lax.psum(rowsums, COL_AXIS)
+            out = lax.pmax(jnp.max(rowsums), ROW_AXIS)
+            out = lax.pmax(out, COL_AXIS)
+        else:
+            raise ValueError(norm)
+        return out[None, None]
+
+    out = shard_map(
+        kernel, mesh=mesh, in_specs=(spec,), out_specs=P(ROW_AXIS, COL_AXIS),
+        check_vma=False,
+    )(at)
+    return out[0, 0]
+
+
+def herk_dist(
+    alpha,
+    a: DistMatrix,
+    beta=0.0,
+    c: Optional[DistMatrix] = None,
+    uplo: Uplo = Uplo.Lower,
+    full: bool = False,
+) -> DistMatrix:
+    """C := alpha A A^H + beta C, C Hermitian (m, m) distributed.
+
+    ``full=True`` fills both triangles (handy for residual checks);
+    otherwise only the ``uplo`` triangle (+ diagonal) is written, matching
+    slate::herk's storage contract (src/herk.cc).
+    """
+    p, q = mesh_shape(a.mesh)
+    if c is not None and (c.m != a.m or c.n != a.m or c.grid != (p, q) or c.nb != a.nb):
+        raise ValueError("herk_dist: C layout must match A A^H")
+    ct = None if c is None else c.tiles
+    out = _herk_jit(
+        a.tiles, ct, alpha, beta, a.mesh, p, q, a.nt, a.n, uplo, full
+    )
+    no_pad = a.mt * a.nb == a.m  # C is (m, m) on A's row tile grid
+    return DistMatrix(
+        tiles=out, m=a.m, n=a.m, nb=a.nb, mesh=a.mesh, diag_pad=no_pad
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _herk_jit(at, ct, alpha, beta, mesh, p, q, kt, k_true, uplo, full):
+    spec = P(ROW_AXIS, COL_AXIS)
+    cplx = jnp.issubdtype(at.dtype, jnp.complexfloating)
+
+    def kernel(a_loc):
+        mtl, ktl, nb, _ = a_loc.shape
+        dtype = a_loc.dtype
+        r, c_, i_log, j_log = local_indices(p, q, mtl, mtl)
+
+        def step(k, acc):
+            acol_own = lax.dynamic_slice_in_dim(a_loc, k // q, 1, axis=1)[:, 0]
+            acol = bcast_from_col(acol_own, k % q)  # (mtl, nb, nb) by row idx
+            # mask the contraction to A's true column extent: identity pad
+            # diagonals (diag_pad_one inputs) must not leak into A A^H
+            kmask = (k * nb + jnp.arange(nb)) < k_true
+            acol = acol * kmask[None, None, :].astype(dtype)
+            # transposed panel by my C-column indices (dist_chol.py pattern)
+            allpan = lax.all_gather(acol, ROW_AXIS, axis=0)  # (p, mtl, nb, nb)
+            ntl = acc.shape[1]
+            jc = lax.axis_index(COL_AXIS) + jnp.arange(ntl) * q
+            panT = allpan[jc % p, jc // p]  # (ntl_c, nb, nb)
+            panT = jnp.conj(panT) if cplx else panT
+            upd = jnp.einsum("iab,jcb->ijac", acol, panT, precision=PRECISE)
+            return acc + upd.astype(dtype)
+
+        mtl_c = mtl
+        ntl_c = -(-at.shape[0] // q)  # C is square (mt x mt tiles)
+        acc0 = jnp.zeros((mtl_c, ntl_c, nb, nb), dtype)
+        acc = lax.fori_loop(0, kt, step, acc0)
+        if not full:
+            jc = lax.axis_index(COL_AXIS) + jnp.arange(ntl_c) * q
+            ii = i_log[:, None, None, None] * nb + jnp.arange(nb)[None, None, :, None]
+            jj = jc[None, :, None, None] * nb + jnp.arange(nb)[None, None, None, :]
+            keep = (ii >= jj) if uplo == Uplo.Lower else (ii <= jj)
+            acc = jnp.where(keep, acc, 0)
+        return acc
+
+    prod = shard_map(
+        kernel, mesh=mesh, in_specs=(spec,), out_specs=spec, check_vma=False
+    )(at)
+    if ct is None:
+        return (alpha * prod).astype(at.dtype)
+    return (alpha * prod + beta * ct).astype(at.dtype)

@@ -6,8 +6,10 @@ row k — diag-tile solve on the owning mesh row, broadcast of the solved RHS
 row along axis 'p', broadcast of the A panel along axis 'q' (or the
 transpose-gather for op != NoTrans, cf. dist_chol.py), one masked batched
 einsum update.  All four (uplo, op) combinations share one kernel body with
-trace-time flags.  Left-side solves only: right-side callers transpose
-their equation (X op(A) = B  <=>  op(A)^T X^T = B^T) before distributing.
+trace-time flags.  ``trsm_dist`` is the left-side solve;
+``trsm_dist_right`` mirrors it over B's tile columns for X op(A) = B
+(internal_trsmA's right-side variants) — no transposing redistribution
+needed.
 """
 
 from __future__ import annotations
@@ -112,6 +114,91 @@ def _trsm_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
                 pan = jnp.where(remaining[:, None, None], pan, 0)
 
             upd = jnp.einsum("iab,jbc->ijac", pan, xrow, precision=PRECISE)
+            return b_loc - upd.astype(b_loc.dtype)
+
+        return lax.fori_loop(0, nt, step, b_loc)
+
+    return shard_map(
+        kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
+    )(at, bt)
+
+
+def trsm_dist_right(
+    a: DistMatrix,
+    b: DistMatrix,
+    uplo: Uplo = Uplo.Lower,
+    op: Op = Op.NoTrans,
+    diag: Diag = Diag.NonUnit,
+) -> DistMatrix:
+    """Solve X op(A) = B; A triangular-distributed (n, n), B (m, n).
+    X overwrites B's layout."""
+    p, q = mesh_shape(a.mesh)
+    if b.grid != a.grid or b.nb != a.nb or b.nt != a.nt or b.n != a.m:
+        raise ValueError(
+            f"trsm_dist_right operands mismatch: A {a.m}x{a.n} nb={a.nb}, "
+            f"B {b.m}x{b.n} nb={b.nb}"
+        )
+    a.require_diag_pad("trsm_dist_right")
+    xt = _trsm_right_jit(a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag)
+    return DistMatrix(tiles=xt, m=b.m, n=b.n, nb=b.nb, mesh=b.mesh)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8))
+def _trsm_right_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
+    spec = P(ROW_AXIS, COL_AXIS)
+    trans = op != Op.NoTrans
+    conj = op == Op.ConjTrans
+    eff_lower = (uplo == Uplo.Lower) != trans
+    # X A = B with op(A) upper: X's leading columns close first -> forward
+    forward = not eff_lower
+    unit = diag == Diag.Unit
+
+    def kernel(a_loc, b_loc):
+        mtl_a, ntl_a, nb, _ = a_loc.shape
+        r, c, _, j_log_b = local_indices(p, q, mtl_a, ntl_a)
+
+        def opt(t):
+            t = jnp.swapaxes(t, -1, -2)
+            return jnp.conj(t) if conj else t
+
+        def step(s, b_loc):
+            k = s if forward else nt - 1 - s
+            kr, kc = k // p, k // q
+
+            dtile = bcast_diag_tile(a_loc, k, p, q, nb)
+            if trans:
+                dtile = opt(dtile)
+
+            # solve X[:, k] on the owning mesh column, write back, bcast 'q'
+            bcol = lax.dynamic_slice_in_dim(b_loc, kc, 1, axis=1)[:, 0]
+            xcol = lax.linalg.triangular_solve(
+                jnp.broadcast_to(dtile, bcol.shape), bcol,
+                left_side=False, lower=eff_lower, transpose_a=False,
+                unit_diagonal=unit,
+            )
+            mine_c = (c == k % q)
+            b_loc = lax.dynamic_update_slice_in_dim(
+                b_loc, jnp.where(mine_c, xcol, bcol)[:, None], kc, axis=1
+            )
+            xcol = bcast_from_col(jnp.where(mine_c, xcol, 0), k % q)
+
+            # row k of op(A) restricted to the remaining columns
+            remaining = (j_log_b > k) if forward else (j_log_b < k)
+            if not trans:
+                arow = lax.dynamic_slice_in_dim(a_loc, kr, 1, axis=0)[0]
+                mine_r = (r == k % p)
+                arow = bcast_from_row(jnp.where(mine_r, arow, 0), k % p)
+                arow = jnp.where(remaining[:, None, None], arow, 0)
+            else:
+                # op(A)[k, j] = op(A[j, k]): transpose-gather of A column k
+                acol = lax.dynamic_slice_in_dim(a_loc, kc, 1, axis=1)[:, 0]
+                mine_c2 = (c == k % q)
+                acol = bcast_from_col(jnp.where(mine_c2, acol, 0), k % q)
+                allcol = lax.all_gather(acol, ROW_AXIS, axis=0)  # (p,mtl,nb,nb)
+                arow = opt(allcol[j_log_b % p, j_log_b // p])
+                arow = jnp.where(remaining[:, None, None], arow, 0)
+
+            upd = jnp.einsum("iab,jbc->ijac", xcol, arow, precision=PRECISE)
             return b_loc - upd.astype(b_loc.dtype)
 
         return lax.fori_loop(0, nt, step, b_loc)

@@ -305,3 +305,88 @@ def test_caqr_single_tile_rows(rng):
     qa = np.asarray(to_dense(unmqr_dist(f, from_dense(jnp.asarray(a), mesh, 16), Op.ConjTrans)))
     r_up = np.triu(np.asarray(to_dense(f.fact))[:n, :n])
     assert np.abs(qa[:n] - r_up).max() < 1e-12
+
+
+def test_norm_dist(rng):
+    from slate_tpu.parallel import norm_dist
+    from slate_tpu.types import Norm
+
+    mesh = mesh24()
+    m, n, nb = 90, 70, 16  # non-multiples: pad masking matters
+    a = np.asarray(_rand(rng, m, n))
+    # diag_pad_one writes 1s into the pad region; norms must mask them out
+    ad = from_dense(jnp.asarray(a), mesh, nb, diag_pad_one=True)
+    for nt, ref in [
+        (Norm.Max, np.abs(a).max()),
+        (Norm.Fro, np.linalg.norm(a)),
+        (Norm.One, np.abs(a).sum(0).max()),
+        (Norm.Inf, np.abs(a).sum(1).max()),
+    ]:
+        assert abs(float(norm_dist(nt, ad)) - ref) < 1e-10 * max(1, ref)
+
+
+def test_herk_dist(rng):
+    from slate_tpu.parallel import herk_dist
+
+    mesh = mesh24()
+    a = np.asarray(_rand(rng, 90, 70))
+    ad = from_dense(jnp.asarray(a), mesh, 16, diag_pad_one=True)
+    ref = a @ a.T
+    cd = np.asarray(to_dense(herk_dist(1.0, ad, full=True)))
+    assert np.abs(cd - ref).max() < 1e-11
+    cl = np.asarray(to_dense(herk_dist(1.0, ad, uplo=Uplo.Lower)))
+    assert np.abs(np.tril(cl) - np.tril(ref)).max() < 1e-11
+    assert np.abs(np.triu(cl, 1)).max() == 0
+
+
+@pytest.mark.parametrize("uplo,op", [
+    (Uplo.Lower, Op.NoTrans), (Uplo.Lower, Op.Trans),
+    (Uplo.Upper, Op.NoTrans), (Uplo.Upper, Op.ConjTrans),
+])
+def test_trsm_dist_right(rng, uplo, op):
+    from slate_tpu.parallel import trsm_dist_right
+
+    mesh = mesh24()
+    m, n, nb = 90, 70, 16
+    t = np.tril(np.asarray(_rand(rng, n, n))) + n * np.eye(n)
+    if uplo == Uplo.Upper:
+        t = t.T
+    b = np.asarray(_rand(rng, m, n))
+    td = from_dense(jnp.asarray(t), mesh, nb, diag_pad_one=True)
+    bd = from_dense(jnp.asarray(b), mesh, nb)
+    x = np.asarray(to_dense(trsm_dist_right(td, bd, uplo, op)))
+    opa = t.T if op != Op.NoTrans else t
+    assert np.abs(x @ opa - b).max() / np.abs(b).max() < 1e-11
+
+
+def test_redistribute_device_side(rng):
+    from slate_tpu.parallel import redistribute
+
+    mesh = mesh24()
+    a = np.asarray(_rand(rng, 90, 70))
+    ad = from_dense(jnp.asarray(a), mesh, 16)
+    d2 = redistribute(ad, make_mesh(4, 2, devices=cpu_devices(8)))
+    assert np.abs(np.asarray(to_dense(d2)) - a).max() == 0
+    d3 = redistribute(ad, mesh22(), nb=32)  # mesh AND nb change
+    assert np.abs(np.asarray(to_dense(d3)) - a).max() == 0
+
+
+def test_posv_self_check_fully_distributed(rng):
+    # the residual pipeline never gathers to one host: potrf + trsm + SUMMA
+    # + distributed Fro norms (VERDICT round-1 item 7)
+    from slate_tpu.parallel import norm_dist, potrf_dist
+    from slate_tpu.types import Norm
+
+    mesh = mesh24()
+    n, nb = 96, 16
+    spd = np.asarray(_spd(rng, n))
+    b = np.asarray(_rand(rng, n, 8))
+    ad = from_dense(jnp.asarray(spd), mesh, nb, diag_pad_one=True)
+    bd = from_dense(jnp.asarray(b), mesh, nb)
+    l, info = potrf_dist(ad)
+    y = trsm_dist(l, bd, Uplo.Lower, Op.NoTrans)
+    xd = trsm_dist(l, y, Uplo.Lower, Op.ConjTrans)
+    rd = gemm_summa(1.0, from_dense(jnp.asarray(spd), mesh, nb), xd, -1.0, bd)
+    resid = float(norm_dist(Norm.Fro, rd)) / float(norm_dist(Norm.Fro, bd))
+    assert int(info) == 0
+    assert resid < 1e-12
