@@ -137,18 +137,32 @@ def potri(factor: TriangularMatrix):
 # ---------------------------------------------------------------------------
 
 
+def _band_worthwhile(n: int, band: int) -> bool:
+    from .band import band_worthwhile
+
+    return band_worthwhile(n, band)
+
+
 def pbtrf_array(a: jax.Array, kd: int, uplo: Uplo = Uplo.Lower) -> Tuple[jax.Array, jax.Array]:
-    """Band Cholesky. The factor of a kd-band SPD matrix is kd-banded, so the
-    dense recursive factorization followed by a band projection is exact; the
-    band structure is exploited for storage/solves while the factorization
-    itself rides the dense MXU path (reference pbtrf works tile-band-wise,
-    src/pbtrf.cc)."""
+    """Band Cholesky (src/pbtrf.cc).  Narrow bands take the windowed
+    O(n kd^2) path (linalg.band.pbtrf_band); wide bands ride the dense
+    recursive MXU factorization + band projection (exact either way)."""
     kl, ku = (kd, 0) if uplo == Uplo.Lower else (0, kd)
+    if uplo == Uplo.Lower and _band_worthwhile(a.shape[0], kd):
+        from .band import pbtrf_band
+
+        f = pbtrf_band(a, kd)
+        return f.l, f.info
     f, info = potrf_array(band_project(a, kl, ku), uplo)
     return band_project(f, kl, ku), info
 
 
 def pbtrs_array(f: jax.Array, b: jax.Array, kd: int, uplo: Uplo = Uplo.Lower) -> jax.Array:
+    if uplo == Uplo.Lower and _band_worthwhile(f.shape[0], kd):
+        from .band import BandChol, pbtrs_band, _pick_nb
+
+        fb = BandChol(f, kd, _pick_nb(kd), jnp.zeros((), jnp.int32))
+        return pbtrs_band(fb, b)
     return potrs_array(f, b, uplo)
 
 

@@ -512,10 +512,27 @@ def gbtrf_array(a: jax.Array, kl: int, ku: int) -> LUFactors:
     return LUFactors(l_part + u_part, f.perm, f.info)
 
 
-def gbtrs_array(f: LUFactors, b: jax.Array, kl: int, ku: int, op: Op = Op.NoTrans) -> jax.Array:
+def gbtrs_array(f, b: jax.Array, kl: int, ku: int, op: Op = Op.NoTrans) -> jax.Array:
+    from .band import BandLU, gbtrs_band
+
+    if isinstance(f, BandLU):  # narrow-band factor from gbsv_array's routing
+        if op != Op.NoTrans:
+            raise ValueError("windowed band factors support op=NoTrans only")
+        return gbtrs_band(f, b)
     return getrs_array(f, b, op)
 
 
 def gbsv_array(a: jax.Array, b: jax.Array, kl: int, ku: int):
+    """Band solve (src/gbsv.cc).  Narrow bands take the windowed
+    O(n kl (kl+ku)) path (linalg.band, LAPACK gbtrf pivot semantics —
+    its factor carries per-window permutations, not a global one); wide
+    bands fall back to the dense partial-pivot factorization."""
+    from .band import band_worthwhile
+
+    if band_worthwhile(a.shape[0], max(kl, 1) + max(ku, 1)):
+        from .band import gbsv_band
+
+        x, f, info = gbsv_band(a, b, kl, ku)
+        return x, f
     f = gbtrf_array(a, kl, ku)
     return gbtrs_array(f, b, kl, ku), f

@@ -1,0 +1,86 @@
+"""Windowed band tier (linalg/band.py) — correctness vs dense/LAPACK and
+the O(n band^2) speed advantage (VERDICT round-1 item 8)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from slate_tpu.linalg.band import gbsv_band, gbtrf_band, pbsv_band, pbtrf_band
+from slate_tpu.linalg.chol import pbsv_array, potrf_array
+from slate_tpu.linalg.lu import gbsv_array
+
+
+def _band_matrix(rng, n, kl, ku, spd=False):
+    a = np.zeros((n, n))
+    for d in range(-kl, ku + 1):
+        a += np.diag(rng.standard_normal(n - abs(d)), d)
+    if spd:
+        a = a @ a.T + n * np.eye(n)  # bandwidth kl + ku
+    return a
+
+
+@pytest.mark.parametrize("n,kd", [(100, 5), (257, 16), (64, 32)])
+def test_pbsv_band(rng, n, kd):
+    a = _band_matrix(rng, n, kd // 2, kd // 2, spd=True)
+    b = np.asarray(rng.standard_normal((n, 3)))
+    x, f, info = pbsv_band(jnp.asarray(a), jnp.asarray(b), kd)
+    resid = np.abs(a @ np.asarray(x) - b).max() / np.abs(b).max()
+    assert int(info) == 0 and resid < 1e-10
+    # the factor matches the dense Cholesky
+    ref = np.linalg.cholesky(a)
+    assert np.abs(np.asarray(f.l) - ref).max() < 1e-10
+
+
+@pytest.mark.parametrize("n,kl,ku", [(100, 4, 3), (257, 16, 8), (90, 1, 1)])
+def test_gbsv_band(rng, n, kl, ku):
+    a = _band_matrix(rng, n, kl, ku)  # non-dominant: real pivoting
+    b = np.asarray(rng.standard_normal((n, 2)))
+    x, f, info = gbsv_band(jnp.asarray(a), jnp.asarray(b), kl, ku)
+    x = np.asarray(x)
+    resid = np.abs(a @ x - b).max() / (np.abs(a).max() * max(1, np.abs(x).max()))
+    assert int(info) == 0 and resid < 1e-11
+
+
+def test_gbtrf_band_not_dominant_pivots(rng):
+    # tiny leading diagonal forces within-window pivoting
+    n, kl, ku = 64, 3, 2
+    a = _band_matrix(rng, n, kl, ku)
+    a[0, 0] = 1e-14
+    b = np.asarray(rng.standard_normal((n, 1)))
+    x, f, info = gbsv_band(jnp.asarray(a), jnp.asarray(b), kl, ku)
+    assert int(info) == 0
+    assert np.abs(a @ np.asarray(x) - b).max() / np.abs(b).max() < 1e-9
+
+
+def test_public_band_routes_to_windowed(rng):
+    # pbsv_array / gbsv_array pick the windowed path for narrow bands
+    n, kd = 200, 6
+    a = _band_matrix(rng, n, kd // 2, kd // 2, spd=True)
+    b = np.asarray(rng.standard_normal((n, 2)))
+    x, f, info = pbsv_array(jnp.asarray(a), jnp.asarray(b), kd)
+    assert int(info) == 0
+    assert np.abs(a @ np.asarray(x) - b).max() / np.abs(b).max() < 1e-10
+    ag = _band_matrix(rng, n, 2, 2)
+    xg, fg = gbsv_array(jnp.asarray(ag), jnp.asarray(b), 2, 2)
+    assert np.abs(ag @ np.asarray(xg) - b).max() / np.abs(b).max() < 1e-9
+
+
+def test_band_speed_advantage(rng):
+    # the windowed path must beat dense by a wide margin at n >> kd
+    n, kd = 2048, 32
+    a = _band_matrix(rng, n, kd // 2, kd // 2, spd=True)
+    aj = jnp.asarray(a)
+    fb = jax.jit(lambda x: pbtrf_band(x, kd).l)
+    fd = jax.jit(lambda x: potrf_array(x)[0])
+    fb(aj).block_until_ready()
+    fd(aj).block_until_ready()
+    t0 = time.perf_counter()
+    fb(aj).block_until_ready()
+    tb = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    fd(aj).block_until_ready()
+    td = time.perf_counter() - t0
+    assert tb < td / 2, (tb, td)
