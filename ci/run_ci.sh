@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# One-command CI: unit/numerical suite on the 8-device virtual CPU mesh,
+# then the example smoke tests (the reference's Jenkins matrix runs
+# test/run_tests.py + examples/run_tests.py the same way, Jenkinsfile:16-26).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+python -m pytest tests/ -q
+python examples/run_tests.py

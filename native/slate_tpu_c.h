@@ -1,44 +1,43 @@
 /* C API for slate_tpu — analogue of include/slate/c_api/slate.h.
  *
- * Link against libslatetpu_c.so (native/build.sh).  All matrices are
- * row-major contiguous float64.  Functions return LAPACK-style info codes
- * (0 = success; >0 numerical failure index; <=-100 bridge error).
+ * Link against libslatetpu_c.so (native/build.sh).  The s/d/c/z routine
+ * surface is generated (tools/gen_c_api.py) and declared in
+ * slate_tpu_c_generated.h — 80 symbols over 20 routines; all buffers are
+ * row-major contiguous; LAPACK-style info returns (0 success, >0
+ * numerical failure index, <=-100 bridge error).
  *
  * The first call initializes an embedded Python/JAX runtime unless the
- * host process is already Python.  Set PYTHONPATH to include the
- * slate_tpu package root.
+ * host process is already Python; the library locates the slate_tpu
+ * package relative to its own path (PYTHONPATH override also honored).
+ *
+ * ScaLAPACK-descriptor entries below accept descinit-style descriptors
+ * [dtype, ctxt, M, N, MB, NB, RSRC, CSRC, LLD] over COLUMN-major local
+ * arrays (single-process: the grid collapses to one rank and the device
+ * mesh provides the actual distribution).
  */
 #ifndef SLATE_TPU_C_H
 #define SLATE_TPU_C_H
 
 #include <stdint.h>
 
+#include "slate_tpu_c_generated.h"
+
 #ifdef __cplusplus
 extern "C" {
 #endif
 
-/* Solve A X = B, general A (n x n), partial-pivot LU. */
-int slate_tpu_dgesv(int64_t n, int64_t nrhs, const double* a,
-                    const double* b, double* x);
+/* Solve A X = B from descriptor arrays; B/X column-major with lld = n. */
+int slate_tpu_pdgesv(int64_t n, int64_t nrhs, double* a, const int* desca,
+                     double* b, const int* descb, double* x);
 
-/* Solve A X = B, A symmetric positive definite. */
-int slate_tpu_dposv(int64_t n, int64_t nrhs, const double* a,
-                    const double* b, double* x);
+/* In-place Cholesky of the descriptor-described column-major A. */
+int slate_tpu_pdpotrf(int64_t n, double* a, const int* desca);
 
-/* Least squares min |A X - B|, A (m x n), X (n x nrhs). */
-int slate_tpu_dgels(int64_t m, int64_t n, int64_t nrhs, const double* a,
-                    const double* b, double* x);
-
-/* C = alpha A B + beta C. */
-int slate_tpu_dgemm(int64_t m, int64_t n, int64_t k, double alpha,
-                    const double* a, const double* b, double beta, double* c);
-
-/* Symmetric eigen-decomposition: w (n), z (n x n) column eigvecs. */
-int slate_tpu_dsyev(int64_t n, const double* a, double* w, double* z);
-
-/* Thin SVD: s (min(m,n)), u (m x k), vt (k x n). */
-int slate_tpu_dgesvd(int64_t m, int64_t n, const double* a, double* s,
-                     double* u, double* vt);
+/* C = alpha A B + beta C over descriptor-described column-major arrays. */
+int slate_tpu_pdgemm(int64_t m, int64_t n, int64_t k, double alpha,
+                     const double* a, const int* desca, const double* b,
+                     const int* descb, double beta, double* c,
+                     const int* descc);
 
 #ifdef __cplusplus
 }

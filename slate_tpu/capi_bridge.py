@@ -20,71 +20,382 @@ def _view(ptr: int, shape, writable=False) -> np.ndarray:
 
 
 def _jx(a: np.ndarray):
-    import jax
     import jax.numpy as jnp
 
-    jax.config.update("jax_enable_x64", True)
+    _pin_backend()
     return jnp.asarray(a)
 
 
-def dgesv(n, nrhs, pa, pb, px) -> int:
+# ---------------------------------------------------------------------------
+# Generated s/d/c/z surface (native/c_api_generated.cc -> dispatch) and the
+# ScaLAPACK-descriptor entries.  The analogue of the reference's generated
+# src/c_api/wrappers.cc bodies + scalapack_api/ descriptor parsing.
+# ---------------------------------------------------------------------------
+
+_DTYPES = {"s": np.float32, "d": np.float64, "c": np.complex64, "z": np.complex128}
+
+
+def _tview(ptr: int, shape, dtype) -> np.ndarray:
+    n = int(np.prod(shape)) if shape else 1
+    nbytes = n * np.dtype(dtype).itemsize
+    buf = (ctypes.c_char * nbytes).from_address(ptr)
+    return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+
+def _writeback(ptr: int, arr: np.ndarray, dtype):
+    out = _tview(ptr, arr.shape, dtype)
+    np.copyto(out, np.asarray(arr, dtype=dtype))
+
+
+def _pin_backend():
+    """Honor JAX_PLATFORMS=cpu even when a TPU plugin force-registered
+    itself as the default backend (same workaround as tests/conftest.py)."""
+    import os
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        try:
+            jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        except RuntimeError:
+            pass
+
+
+def dispatch(name: str, tchar: str, ints, scalars, ptrs) -> int:
+    _pin_backend()
+    dt = _DTYPES[tchar]
+    rdt = np.float32 if tchar in ("s", "c") else np.float64
+    try:
+        return int(_ROUTINES[name](dt, rdt, ints, scalars, ptrs) or 0)
+    except Exception:
+        import traceback
+
+        traceback.print_exc()
+        return -110
+
+
+def _r_gesv(dt, rdt, ints, scalars, ptrs):
     from .linalg import gesv_array
 
-    a = _view(pa, (n, n))
-    b = _view(pb, (n, nrhs))
-    x, f = gesv_array(_jx(a), _jx(b))
-    _view(px, (n, nrhs), writable=True)[:] = np.asarray(x)
+    n, nrhs = ints
+    pa, pb, px = ptrs
+    x, f = gesv_array(_jx(_tview(pa, (n, n), dt)), _jx(_tview(pb, (n, nrhs), dt)))
+    _writeback(px, np.asarray(x), dt)
     return int(f.info)
 
 
-def dposv(n, nrhs, pa, pb, px) -> int:
+def _r_posv(dt, rdt, ints, scalars, ptrs):
     from .linalg import posv_array
 
-    a = _view(pa, (n, n))
-    b = _view(pb, (n, nrhs))
-    x, _, info = posv_array(_jx(a), _jx(b))
-    _view(px, (n, nrhs), writable=True)[:] = np.asarray(x)
+    n, nrhs = ints
+    pa, pb, px = ptrs
+    x, _, info = posv_array(_jx(_tview(pa, (n, n), dt)), _jx(_tview(pb, (n, nrhs), dt)))
+    _writeback(px, np.asarray(x), dt)
     return int(info)
 
 
-def dgels(m, n, nrhs, pa, pb, px) -> int:
+def _r_gels(dt, rdt, ints, scalars, ptrs):
     from .linalg import gels_array
 
-    a = _view(pa, (m, n))
-    b = _view(pb, (m, nrhs))
-    x = gels_array(_jx(a), _jx(b))
-    _view(px, (n, nrhs), writable=True)[:] = np.asarray(x)
+    m, n, nrhs = ints
+    pa, pb, px = ptrs
+    x = gels_array(_jx(_tview(pa, (m, n), dt)), _jx(_tview(pb, (m, nrhs), dt)))
+    _writeback(px, np.asarray(x), dt)
     return 0
 
 
-def dgemm(m, n, k, alpha, pa, pb, beta, pc) -> int:
+def _r_gemm(dt, rdt, ints, scalars, ptrs):
     from .blas3.blas3 import gemm_array
 
-    a = _view(pa, (m, k))
-    b = _view(pb, (k, n))
-    c = _view(pc, (m, n))
-    out = gemm_array(alpha, _jx(a), _jx(b), beta, _jx(c))
-    _view(pc, (m, n), writable=True)[:] = np.asarray(out)
+    m, n, k = ints
+    alpha, beta = scalars
+    pa, pb, pc = ptrs
+    c = _tview(pc, (m, n), dt)
+    out = gemm_array(alpha, _jx(_tview(pa, (m, k), dt)),
+                     _jx(_tview(pb, (k, n), dt)), beta, _jx(c))
+    _writeback(pc, np.asarray(out), dt)
     return 0
 
 
-def dsyev(n, pa, pw, pz) -> int:
+def _r_trsm(dt, rdt, ints, scalars, ptrs):
+    from .blas3.blas3 import trsm_array
+    from .types import Diag, Op, Side, Uplo
+
+    side, uplo, trans, diag, m, n = ints
+    (alpha,) = scalars
+    pa, pb = ptrs
+    na = m if side == 0 else n
+    x = trsm_array(
+        Side.Left if side == 0 else Side.Right,
+        Uplo.Lower if uplo == 0 else Uplo.Upper,
+        {0: Op.NoTrans, 1: Op.Trans, 2: Op.ConjTrans}[trans],
+        Diag.NonUnit if diag == 0 else Diag.Unit,
+        alpha, _jx(_tview(pa, (na, na), dt)), _jx(_tview(pb, (m, n), dt)),
+    )
+    _writeback(pb, np.asarray(x), dt)
+    return 0
+
+
+def _r_potrf(dt, rdt, ints, scalars, ptrs):
+    from .linalg import potrf_array
+    from .types import Uplo
+
+    n, uplo = ints
+    pa, pl = ptrs
+    l, info = potrf_array(_jx(_tview(pa, (n, n), dt)),
+                          Uplo.Lower if uplo == 0 else Uplo.Upper)
+    _writeback(pl, np.asarray(l), dt)
+    return int(info)
+
+
+def _r_potrs(dt, rdt, ints, scalars, ptrs):
+    from .linalg import potrs_array
+    from .types import Uplo
+
+    n, nrhs, uplo = ints
+    pl, pb, px = ptrs
+    x = potrs_array(_jx(_tview(pl, (n, n), dt)), _jx(_tview(pb, (n, nrhs), dt)),
+                    Uplo.Lower if uplo == 0 else Uplo.Upper)
+    _writeback(px, np.asarray(x), dt)
+    return 0
+
+
+def _r_getrf(dt, rdt, ints, scalars, ptrs):
+    from .linalg import getrf_array
+
+    m, n = ints
+    pa, plu, ppiv = ptrs
+    f = getrf_array(_jx(_tview(pa, (m, n), dt)))
+    _writeback(plu, np.asarray(f.lu), dt)
+    _writeback(ppiv, np.asarray(f.perm, np.int64), np.int64)
+    return int(f.info)
+
+
+def _r_getrf_tntpiv(dt, rdt, ints, scalars, ptrs):
+    from .linalg import getrf_tntpiv_array
+
+    m, n = ints
+    pa, plu, ppiv = ptrs
+    f = getrf_tntpiv_array(_jx(_tview(pa, (m, n), dt)))
+    _writeback(plu, np.asarray(f.lu), dt)
+    _writeback(ppiv, np.asarray(f.perm, np.int64), np.int64)
+    return int(f.info)
+
+
+def _r_getrs(dt, rdt, ints, scalars, ptrs):
+    from .linalg import getrs_array
+    from .linalg.lu import LUFactors
+    from .types import Op
+
+    n, nrhs, trans = ints
+    plu, ppiv, pb, px = ptrs
+    import jax.numpy as jnp
+
+    f = LUFactors(
+        _jx(_tview(plu, (n, n), dt)),
+        jnp.asarray(_tview(ppiv, (n,), np.int64)),
+        jnp.zeros((), jnp.int32),
+    )
+    x = getrs_array(f, _jx(_tview(pb, (n, nrhs), dt)),
+                    {0: Op.NoTrans, 1: Op.Trans, 2: Op.ConjTrans}[trans])
+    _writeback(px, np.asarray(x), dt)
+    return 0
+
+
+def _r_getri(dt, rdt, ints, scalars, ptrs):
+    from .linalg import getri_array
+    from .linalg.lu import LUFactors
+
+    (n,) = ints
+    plu, ppiv, pinv = ptrs
+    import jax.numpy as jnp
+
+    f = LUFactors(
+        _jx(_tview(plu, (n, n), dt)),
+        jnp.asarray(_tview(ppiv, (n,), np.int64)),
+        jnp.zeros((), jnp.int32),
+    )
+    _writeback(pinv, np.asarray(getri_array(f)), dt)
+    return 0
+
+
+def _r_heev(dt, rdt, ints, scalars, ptrs):
     from .linalg import heev_array
 
-    a = _view(pa, (n, n))
-    w, z = heev_array(_jx(a))
-    _view(pw, (n,), writable=True)[:] = np.asarray(w)
-    _view(pz, (n, n), writable=True)[:] = np.asarray(z)
+    n, jobz = ints
+    pa, pw, pz = ptrs
+    a = _jx(_tview(pa, (n, n), dt))
+    if jobz == 0:
+        w = heev_array(a, want_vectors=False)
+        _writeback(pw, np.asarray(w), rdt)
+        return 0
+    w, z = heev_array(a)
+    _writeback(pw, np.asarray(w), rdt)
+    _writeback(pz, np.asarray(z), dt)
     return 0
 
 
-def dgesvd(m, n, pa, ps, pu, pvt) -> int:
+def _r_gesvd(dt, rdt, ints, scalars, ptrs):
     from .linalg import svd_array
 
-    a = _view(pa, (m, n))
-    u, s, vt = svd_array(_jx(a))
-    k = min(m, n)
-    _view(ps, (k,), writable=True)[:] = np.asarray(s)
-    _view(pu, (m, k), writable=True)[:] = np.asarray(u)
-    _view(pvt, (k, n), writable=True)[:] = np.asarray(vt)
+    m, n = ints
+    pa, ps, pu, pvt = ptrs
+    u, s, vt = svd_array(_jx(_tview(pa, (m, n), dt)))
+    _writeback(ps, np.asarray(s), rdt)
+    _writeback(pu, np.asarray(u), dt)
+    _writeback(pvt, np.asarray(vt), dt)
+    return 0
+
+
+def _r_gbsv(dt, rdt, ints, scalars, ptrs):
+    from .linalg import gbsv_array
+
+    n, nrhs, kl, ku = ints
+    pa, pb, px = ptrs
+    x, f = gbsv_array(_jx(_tview(pa, (n, n), dt)), _jx(_tview(pb, (n, nrhs), dt)),
+                      int(kl), int(ku))
+    _writeback(px, np.asarray(x), dt)
+    return int(f.info)
+
+
+def _r_pbsv(dt, rdt, ints, scalars, ptrs):
+    from .linalg.chol import pbsv_array
+
+    n, nrhs, kd = ints
+    pa, pb, px = ptrs
+    x, _, info = pbsv_array(_jx(_tview(pa, (n, n), dt)),
+                            _jx(_tview(pb, (n, nrhs), dt)), int(kd))
+    _writeback(px, np.asarray(x), dt)
+    return int(info)
+
+
+def _r_sysv(dt, rdt, ints, scalars, ptrs):
+    from .linalg.indefinite import hesv_array
+
+    n, nrhs = ints
+    pa, pb, px = ptrs
+    x, _, info = hesv_array(_jx(_tview(pa, (n, n), dt)),
+                            _jx(_tview(pb, (n, nrhs), dt)))
+    _writeback(px, np.asarray(x), dt)
+    return int(info)
+
+
+def _r_norm(dt, rdt, ints, scalars, ptrs):
+    from .linalg import norm
+    from .types import Norm
+
+    ntype, m, n = ints
+    pa, pv = ptrs
+    v = norm({0: Norm.Max, 1: Norm.One, 2: Norm.Inf, 3: Norm.Fro}[ntype],
+             _jx(_tview(pa, (m, n), dt)))
+    _writeback(pv, np.asarray(v, rdt).reshape(()), rdt)
+    return 0
+
+
+def _r_gecondest(dt, rdt, ints, scalars, ptrs):
+    from .linalg import getrf_array, norm
+    from .linalg.norms import gecondest
+    from .types import Norm
+
+    ntype, n = ints
+    pa, pr = ptrs
+    nt = {1: Norm.One, 2: Norm.Inf}.get(ntype, Norm.One)
+    a = _jx(_tview(pa, (n, n), dt))
+    f = getrf_array(a)
+    r = gecondest(nt, f, float(norm(nt, a)))
+    _writeback(pr, np.asarray(r, rdt).reshape(()), rdt)
+    return 0
+
+
+def _r_trtri(dt, rdt, ints, scalars, ptrs):
+    from .linalg.tri import trtri_array
+    from .types import Diag, Uplo
+
+    n, uplo, diag = ints
+    pa, pi = ptrs
+    inv = trtri_array(_jx(_tview(pa, (n, n), dt)),
+                      Uplo.Lower if uplo == 0 else Uplo.Upper,
+                      Diag.NonUnit if diag == 0 else Diag.Unit)
+    _writeback(pi, np.asarray(inv), dt)
+    return 0
+
+
+def _r_qr(dt, rdt, ints, scalars, ptrs):
+    from .linalg import geqrf_array
+    from .linalg.qr import geqrf_q, geqrf_r
+
+    m, n = ints
+    pa, pq, pr = ptrs
+    f = geqrf_array(_jx(_tview(pa, (m, n), dt)))
+    _writeback(pq, np.asarray(geqrf_q(f)), dt)
+    _writeback(pr, np.asarray(geqrf_r(f)), dt)
+    return 0
+
+
+_ROUTINES = {
+    "gesv": _r_gesv, "posv": _r_posv, "gels": _r_gels, "gemm": _r_gemm,
+    "trsm": _r_trsm, "potrf": _r_potrf, "potrs": _r_potrs,
+    "getrf": _r_getrf, "getrf_tntpiv": _r_getrf_tntpiv, "getrs": _r_getrs,
+    "getri": _r_getri, "heev": _r_heev, "gesvd": _r_gesvd, "gbsv": _r_gbsv,
+    "pbsv": _r_pbsv, "sysv": _r_sysv, "norm": _r_norm,
+    "gecondest": _r_gecondest, "trtri": _r_trtri, "qr": _r_qr,
+}
+
+
+# ---------------------------------------------------------------------------
+# ScaLAPACK-descriptor entries (scalapack_api/ parity; column-major local
+# arrays described by descinit's [dtype, ctxt, M, N, MB, NB, RSRC, CSRC, LLD])
+# ---------------------------------------------------------------------------
+
+
+def _desc_view(pa: int, pdesc: int, rows: int, cols: int) -> np.ndarray:
+    desc = _tview(pdesc, (9,), np.int32)
+    if int(desc[0]) != 1:
+        raise ValueError(f"descriptor dtype {desc[0]} != 1 (dense)")
+    m, n, lld = int(desc[2]), int(desc[3]), int(desc[8])
+    if m < rows or n < cols or lld < rows:
+        raise ValueError(f"descriptor {m}x{n} lld={lld} < requested {rows}x{cols}")
+    flat = _tview(pa, (n * lld,), np.float64)
+    return flat.reshape(n, lld).T[:rows, :cols]  # column-major view
+
+
+def pdgesv(n, nrhs, pa, pdesca, pb, pdescb, px) -> int:
+    from .linalg import gesv_array
+
+    a = np.ascontiguousarray(_desc_view(pa, pdesca, n, n))
+    b = np.ascontiguousarray(_desc_view(pb, pdescb, n, nrhs))
+    x, f = gesv_array(_jx(a), _jx(b))
+    # write X back into descb's column-major layout
+    descb = _tview(pdescb, (9,), np.int32)
+    lld = int(descb[8])
+    flat = _tview(px, (int(descb[3]) * lld,), np.float64)
+    flat.reshape(int(descb[3]), lld).T[:n, :nrhs] = np.asarray(x)
+    return int(f.info)
+
+
+def pdpotrf(n, pa, pdesca) -> int:
+    from .linalg import potrf_array
+
+    a = np.ascontiguousarray(_desc_view(pa, pdesca, n, n))
+    l, info = potrf_array(_jx(a))
+    # write the factor back into the descriptor's column-major storage
+    desc = _tview(pdesca, (9,), np.int32)
+    lld = int(desc[8])
+    flat = _tview(pa, (int(desc[3]) * lld,), np.float64)
+    flat.reshape(int(desc[3]), lld).T[:n, :n] = np.asarray(l)
+    return int(info)
+
+
+def pdgemm(m, n, k, alpha, pa, pdesca, pb, pdescb, beta, pc, pdescc) -> int:
+    from .blas3.blas3 import gemm_array
+
+    a = np.ascontiguousarray(_desc_view(pa, pdesca, m, k))
+    b = np.ascontiguousarray(_desc_view(pb, pdescb, k, n))
+    c = np.ascontiguousarray(_desc_view(pc, pdescc, m, n))
+    out = gemm_array(alpha, _jx(a), _jx(b), beta, _jx(c))
+    desc = _tview(pdescc, (9,), np.int32)
+    lld = int(desc[8])
+    flat = _tview(pc, (int(desc[3]) * lld,), np.float64)
+    flat.reshape(int(desc[3]), lld).T[:m, :n] = np.asarray(out)
     return 0

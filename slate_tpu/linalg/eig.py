@@ -134,7 +134,8 @@ def unmtr_he2hb(f: He2hbFactors, c: Array) -> Array:
         upd = matmul(v, matmul(t, matmul(jnp.conj(v).T, cp))).astype(cp.dtype)
         return cp - upd
 
-    cp = jax.lax.fori_loop(0, nsteps, body, cp)
+    if nsteps:  # zero-panel case (n <= nb+1): Q is the identity
+        cp = jax.lax.fori_loop(0, nsteps, body, cp)
     return cp[:n]
 
 

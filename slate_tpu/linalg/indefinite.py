@@ -146,7 +146,8 @@ def _unmtr_he2hb_adj(f1: He2hbFactors, c: Array) -> Array:
         upd = matmul(v, matmul(jnp.conj(t).T, matmul(jnp.conj(v).T, cp))).astype(cp.dtype)
         return cp - upd
 
-    cp = jax.lax.fori_loop(0, nsteps, body, cp)
+    if nsteps:  # zero-panel case: Q is the identity
+        cp = jax.lax.fori_loop(0, nsteps, body, cp)
     return cp[:n]
 
 

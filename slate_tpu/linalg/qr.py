@@ -11,8 +11,8 @@ is recursive compact-WY (Elmroth-Gustavson): factor the left half, apply
 ``I - Y T Y^H`` to the right half with three matmuls, recurse, and merge
 T blocks — the same communication-avoiding tree, but the "tree" is the
 recursion and the merges are matmuls XLA schedules over the mesh (sharded
-runs get their collectives from GSPMD; an explicit ttqrt over mesh rows lives
-in slate_tpu.parallel).  The unblocked base panel is a masked
+runs get their collectives from GSPMD; the explicit mesh-axis ttqrt tree
+lives in slate_tpu.parallel.dist_qr).  The unblocked base panel is a masked
 ``lax.fori_loop`` of Householder reflections (LAPACK larfg/larf semantics,
 complex-safe).
 

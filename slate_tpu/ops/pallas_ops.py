@@ -1,0 +1,109 @@
+"""Pallas twins of the hot tile kernels (transpose, geadd, tile norms).
+
+The reference backs each of these with a dedicated CUDA kernel batched
+over tile-pointer arrays (``src/cuda/device_transpose.cu``,
+``device_geadd.cu``, ``device_genorm.cu``; decl
+include/slate/internal/device.hh:73-283).  The XLA forms in
+``tile_ops.py`` are the reference semantics for every dtype; these Pallas
+grids are the explicit-kernel variants for f32/bf16 tile stacks on TPU —
+one grid step per tile, VMEM-resident blocks, no intermediate HBM
+round-trips between the elementwise ops they fuse.
+
+Use :func:`use_pallas_tiles` to gate dispatch exactly like
+``ops.matmul._use_pallas`` does for the gemm kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is unavailable on pure-CPU builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+
+def use_pallas_tiles(a: jax.Array) -> bool:
+    """Pallas path: TPU backend, supported dtype, (k, nb, nb) tile stack
+    big enough that a grid launch beats XLA's fused form."""
+    if not _HAS_PLTPU or jax.default_backend() != "tpu":
+        return False
+    if a.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    return a.ndim == 3 and a.shape[-1] >= 128 and a.shape[0] >= 8
+
+
+def _transpose_kernel(a_ref, o_ref):
+    o_ref[:] = jnp.swapaxes(a_ref[:], -1, -2)
+
+
+@jax.jit
+def transpose_pallas(a: jax.Array) -> jax.Array:
+    """Batched tile transpose over a (k, nb, nb) stack
+    (device_transpose.cu): one grid step per tile."""
+    k, mb, nb = a.shape
+    return pl.pallas_call(
+        _transpose_kernel,
+        out_shape=jax.ShapeDtypeStruct((k, nb, mb), a.dtype),
+        grid=(k,),
+        in_specs=[pl.BlockSpec((1, mb, nb), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((1, nb, mb), lambda i: (i, 0, 0)),
+    )(a)
+
+
+def _geadd_kernel(alpha_ref, beta_ref, a_ref, b_ref, o_ref):
+    o_ref[:] = alpha_ref[0] * a_ref[:] + beta_ref[0] * b_ref[:]
+
+
+@jax.jit
+def geadd_pallas(alpha, a: jax.Array, beta, b: jax.Array) -> jax.Array:
+    """Batched B := alpha A + beta B over a tile stack (device_geadd.cu)."""
+    k, mb, nb = a.shape
+    al = jnp.asarray([alpha], a.dtype)
+    be = jnp.asarray([beta], a.dtype)
+    return pl.pallas_call(
+        _geadd_kernel,
+        out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype),
+        grid=(k,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM)
+            if _HAS_PLTPU
+            else pl.BlockSpec((1,), lambda i: (0,)),
+            pl.BlockSpec(memory_space=pltpu.SMEM)
+            if _HAS_PLTPU
+            else pl.BlockSpec((1,), lambda i: (0,)),
+            pl.BlockSpec((1, mb, nb), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, mb, nb), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, mb, nb), lambda i: (i, 0, 0)),
+    )(al, be, a, b)
+
+
+def _norm_max_kernel(a_ref, o_ref):
+    # two-stage reduction: lanes stay vectorized (column maxes) in-kernel,
+    # the final fold over nb happens in XLA outside.  The (8, nb) output
+    # block satisfies the TPU (8, 128) tiling floor.
+    cm = jnp.max(jnp.abs(a_ref[:]), axis=-2)  # (1, nb)
+    o_ref[:] = jnp.broadcast_to(cm, o_ref.shape)
+
+
+@jax.jit
+def genorm_max_pallas(a: jax.Array) -> jax.Array:
+    """Per-tile max-abs over a (k, nb, nb) stack (device_genorm.cu,
+    NormScope::Matrix reduced tile-wise)."""
+    k, mb, nb = a.shape
+    colmax = pl.pallas_call(
+        _norm_max_kernel,
+        out_shape=jax.ShapeDtypeStruct((k, 8, nb), a.dtype),
+        grid=(k,),
+        in_specs=[pl.BlockSpec((1, mb, nb), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((1, 8, nb), lambda i: (i, 0, 0)),
+    )(a)
+    return jnp.max(colmax[:, 0, :], axis=-1)

@@ -101,7 +101,12 @@ def tzset(uplo: Uplo, offdiag, diag, a: jax.Array) -> jax.Array:
 def transpose(a: jax.Array, conj: bool = False) -> jax.Array:
     """Tile transpose (device_transpose.cu). Layout conversion collapses to a
     logical transpose under XLA — no extended-buffer dance (Tile.hh
-    makeTransposable is runtime machinery XLA subsumes)."""
+    makeTransposable is runtime machinery XLA subsumes).  Big f32/bf16
+    tile stacks on TPU take the explicit Pallas grid (pallas_ops.py)."""
+    from .pallas_ops import transpose_pallas, use_pallas_tiles
+
+    if not conj and use_pallas_tiles(a):
+        return transpose_pallas(a)
     at = jnp.swapaxes(a, -1, -2)
     return jnp.conj(at) if conj else at
 

@@ -81,3 +81,18 @@ def test_matmul_fallback(rng):
     a, b = rng.standard_normal((64, 32)), rng.standard_normal((32, 48))
     out = ops.matmul(jnp.asarray(a), jnp.asarray(b))
     np.testing.assert_allclose(np.asarray(out), a @ b, rtol=1e-12)
+
+
+def test_pallas_ops_gate_and_fallback():
+    # on CPU the Pallas twins must gate off and tile_ops falls back to XLA
+    import jax
+    import jax.numpy as jnp
+
+    from slate_tpu.ops.pallas_ops import use_pallas_tiles
+    from slate_tpu.ops.tile_ops import transpose
+
+    a = jnp.arange(2 * 4 * 4, dtype=jnp.float32).reshape(2, 4, 4)
+    if jax.default_backend() != "tpu":
+        assert not use_pallas_tiles(a)
+    out = np.asarray(transpose(a))
+    assert (out == np.swapaxes(np.asarray(a), -1, -2)).all()
