@@ -58,12 +58,58 @@ def _potrf_lower(a: jax.Array) -> jax.Array:
     return jnp.block([[l11, z], [l21, l22]])
 
 
+def _potrf_scan(a: jax.Array, nb: int = 256) -> jax.Array:
+    """Single-program scanned lower Cholesky: one lax.fori_loop over
+    panels with static shapes, O(1) HLO size in n (the recursive trace
+    explodes at north-star sizes — cf. lu.getrf_scan_array).  The masked
+    full-width trailing update costs ~3x the optimal n^3/3 flops but
+    every flop is an MXU gemm.  Input must be full Hermitian."""
+    n = a.shape[0]
+    nsteps = -(-n // nb)
+    np_ = nsteps * nb
+    ap = jnp.pad(a, ((0, np_ - n), (0, np_ - n)))
+    dpad = jnp.arange(n, np_)
+    ap = ap.at[dpad, dpad].set(1)
+    rows = jnp.arange(np_)
+    cplx = jnp.issubdtype(a.dtype, jnp.complexfloating)
+
+    def step(k, ap):
+        kk = k * nb
+        dblk = jax.lax.dynamic_slice(ap, (kk, kk), (nb, nb))
+        ld = jax.lax.linalg.cholesky(dblk)
+        col = jax.lax.dynamic_slice(ap, (0, kk), (np_, nb))
+        ldh = jnp.conj(ld).T if cplx else ld.T
+        sol = jax.lax.linalg.triangular_solve(
+            ldh[None], col[None], left_side=False, lower=False,
+            transpose_a=False,
+        )[0]
+        below = (rows >= kk + nb)[:, None]
+        ondiag = ((rows >= kk) & (rows < kk + nb))[:, None]
+        dpat = jax.lax.dynamic_update_slice(
+            jnp.zeros((np_, nb), ap.dtype), jnp.tril(ld), (kk, 0)
+        )
+        newcol = jnp.where(below, sol, jnp.where(ondiag, dpat, col))
+        ap = jax.lax.dynamic_update_slice(ap, newcol, (0, kk))
+        l21 = newcol * below.astype(ap.dtype)
+        upd = matmul(l21, jnp.conj(l21).T if cplx else l21.T)
+        return ap - upd.astype(ap.dtype)
+
+    ap = jax.lax.fori_loop(0, nsteps, step, ap)
+    return ap[:n, :n]
+
+
+_POTRF_SCAN_MIN_N = 16384  # above this the recursive trace is too large
+
+
 def potrf_array(a: jax.Array, uplo: Uplo = Uplo.Lower) -> Tuple[jax.Array, jax.Array]:
     """Factor A = L L^H (or U^H U). ``a`` holds the uplo triangle (other
     triangle ignored). Returns (factor triangle, info); info = 0 on success
     else 1 + index of first non-positive pivot (src/potrf.cc:253-256)."""
     full = symmetrize(a, uplo, conj=jnp.issubdtype(a.dtype, jnp.complexfloating))
-    l = _potrf_lower(full)
+    if a.shape[0] > _POTRF_SCAN_MIN_N:
+        l = _potrf_scan(full)
+    else:
+        l = _potrf_lower(full)
     d = jnp.real(jnp.diagonal(l))
     bad = ~(jnp.isfinite(d) & (d > 0))
     info = jnp.where(jnp.any(bad), jnp.argmax(bad) + 1, 0).astype(jnp.int32)

@@ -238,6 +238,82 @@ def geqrf_array(a: Array) -> QRFactors:
     return QRFactors(vr, t)
 
 
+class QRScanFactors(NamedTuple):
+    """Scanned QR: R in ``r`` (upper), stacked per-panel global-coordinate
+    reflectors ``v`` (K, mp, nb) + WY accumulators ``t`` (K, nb, nb) — the
+    same storage the scanned two-stage reductions use (cf. eig.he2hb)."""
+
+    r: Array
+    v: Array
+    t: Array
+    nb: int
+
+
+def geqrf_scan_array(a: Array, nb: int = _QR_PANEL) -> QRScanFactors:
+    """Single-program scanned QR: one lax.fori_loop over panels with
+    static shapes (O(1) HLO size in n) — the recursive trace explodes at
+    north-star sizes.  Per panel: offset-pivot Householder QR of the
+    masked full-height block column, then one global compact-WY update of
+    the trailing columns."""
+    from jax import lax
+
+    m, n = a.shape
+    if m < n:
+        raise ValueError(f"geqrf_scan_array requires m >= n, got {a.shape}")
+    nblocks = -(-n // nb)
+    mp = max(m, (nblocks + 1) * nb)
+    np_ = max(n, (nblocks + 1) * nb)
+    ap = jnp.pad(a, ((0, mp - m), (0, np_ - n)))
+    rows = jnp.arange(mp)
+    cols = jnp.arange(np_)
+
+    def body(k, carry):
+        ap, vs, ts = carry
+        j0 = k * nb
+        j1 = j0 + nb
+        colblk = lax.dynamic_slice(ap, (0, j0), (mp, nb))
+        masked = jnp.where((rows >= j0)[:, None], colblk, 0)
+        r_a, v, tau = _panel_qr_offset(masked, j0)
+        t = _larft_v(v, tau)
+        w1 = matmul(jnp.conj(v).T, ap)
+        upd = matmul(v, matmul(jnp.conj(t).T, w1)).astype(ap.dtype)
+        ap = ap - upd * (cols >= j1)[None, :].astype(ap.dtype)
+        newcols = jnp.where((rows >= j0)[:, None], r_a, colblk)
+        ap = lax.dynamic_update_slice(ap, newcols, (0, j0))
+        return ap, vs.at[k].set(v), ts.at[k].set(t)
+
+    carry0 = (
+        ap,
+        jnp.zeros((nblocks, mp, nb), a.dtype),
+        jnp.zeros((nblocks, nb, nb), a.dtype),
+    )
+    ap, vs, ts = lax.fori_loop(0, nblocks, body, carry0)
+    return QRScanFactors(tri_project(ap[:m, :n], Uplo.Upper), vs, ts, nb)
+
+
+def unmqr_scan_array(f: QRScanFactors, c: Array, op: Op = Op.NoTrans) -> Array:
+    """Apply Q (or Q^H) from scanned factors: a fori_loop over the panel
+    stack, each step three matmuls (cf. svd.unmbr_ge2tb_u)."""
+    from jax import lax
+
+    if op == Op.Trans and jnp.issubdtype(f.v.dtype, jnp.complexfloating):
+        raise SlateError("unmqr_scan: Op.Trans unsupported for complex")
+    nsteps, mp, _ = f.v.shape
+    n0 = c.shape[0]
+    cp = jnp.pad(c, ((0, mp - n0),) + ((0, 0),) * (c.ndim - 1))
+    adjoint = op != Op.NoTrans
+
+    def body(i, cp):
+        k = i if adjoint else nsteps - 1 - i
+        v, t = f.v[k], f.t[k]
+        t = jnp.conj(t).T if adjoint else t
+        return cp - matmul(v, matmul(t, matmul(jnp.conj(v).T, cp))).astype(cp.dtype)
+
+    if nsteps:
+        cp = lax.fori_loop(0, nsteps, body, cp)
+    return cp[:n0]
+
+
 def unmqr_array(side: Side, op: Op, f: QRFactors, c: Array) -> Array:
     """Apply Q / Q^H from geqrf factors (src/unmqr.cc): 3 matmuls.  Op.Trans
     on complex factors is undefined for compact-WY (LAPACK unmqr allows only

@@ -47,12 +47,30 @@ def local_indices(p: int, q: int, mtl: int, ntl: int):
     return r, c, i_log, j_log
 
 
-def bcast_diag_tile(t_loc: jax.Array, k, p: int, q: int, nb: int) -> jax.Array:
+def bcast_diag_tile(
+    t_loc: jax.Array, k, p: int, q: int, nb: int, roff=0, coff=0
+) -> jax.Array:
     """Deliver tile (k, k) to every device: masked double psum over both
-    mesh axes (the reference's tileBcast of the panel-head tile)."""
+    mesh axes (the reference's tileBcast of the panel-head tile).
+    ``roff``/``coff`` shift local tile indexing when ``t_loc`` is a
+    trailing view (bucketed kernels)."""
     r = lax.axis_index(ROW_AXIS)
     c = lax.axis_index(COL_AXIS)
     own = (r == k % p) & (c == k % q)
-    dtile = lax.dynamic_slice(t_loc, (k // p, k // q, 0, 0), (1, 1, nb, nb))[0, 0]
+    dtile = lax.dynamic_slice(
+        t_loc, (k // p - roff, k // q - coff, 0, 0), (1, 1, nb, nb)
+    )[0, 0]
     dtile = jnp.where(own, dtile, jnp.zeros_like(dtile))
     return lax.psum(lax.psum(dtile, ROW_AXIS), COL_AXIS)
+
+
+def bucket_plan(nt: int, p: int, q: int, nbuckets: int):
+    """Static trailing-update segmentation shared by the bucketed
+    factorization kernels: yields (k0, k1, s0r, s0c) per bucket, where
+    s0r/s0c are uniform safe row/col tile cuts (every device keeps tiles
+    any rank may still touch — over-keeps at most one tile row/col)."""
+    nbkts = min(nbuckets, nt)
+    bounds = [nt * g // nbkts for g in range(nbkts)] + [nt]
+    for g in range(nbkts):
+        k0, k1 = bounds[g], bounds[g + 1]
+        yield k0, k1, max(0, (k0 - p + 1) // p), max(0, (k0 - q + 1) // q)

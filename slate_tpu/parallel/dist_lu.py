@@ -45,6 +45,7 @@ from .comm import (
     PRECISE,
     bcast_diag_tile,
     bcast_from_col,
+    bucket_plan,
     bcast_from_row,
     local_indices,
     shard_map,
@@ -62,15 +63,16 @@ def getrf_nopiv_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array]:
     ), info
 
 
-def _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c):
+def _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c, roff=0, coff=0):
     """One right-looking LU tile step (panel solves + bcasts + trailing
     gemm) on the swapped/unswapped local stack.  Shared by the no-pivot
-    and tournament kernels."""
+    and tournament kernels; ``roff``/``coff`` shift tile indexing when
+    ``t_loc`` is a trailing view (bucketed caller)."""
     nb = t_loc.shape[2]
     dtype = t_loc.dtype
     eye = jnp.eye(nb, dtype=dtype)
-    kr, kc = k // p, k // q
-    dtile = bcast_diag_tile(t_loc, k, p, q, nb)
+    kr, kc = k // p - roff, k // q - coff
+    dtile = bcast_diag_tile(t_loc, k, p, q, nb, roff, coff)
     luk = _getrf_nopiv_rec(dtile)  # packed L\U, unit L diag implicit
     ukk = jnp.triu(luk)
 
@@ -129,10 +131,21 @@ def _lu_jit(at, mesh, p, q, nt):
         mtl, ntl, nb, _ = t_loc.shape
         r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
 
-        def step(k, t_loc):
-            return _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c)
+        # trailing-update bucketing (see dist_chol.py): each segment runs
+        # on a statically smaller trailing view, cutting the masked flops
+        from .dist_chol import _BUCKETS
 
-        t_loc = lax.fori_loop(0, nt, step, t_loc)
+        for k0, k1, s0r, s0c in bucket_plan(nt, p, q, _BUCKETS):
+            view = t_loc[s0r:, s0c:]
+            i_v = r + (s0r + jnp.arange(mtl - s0r)) * p
+            j_v = c + (s0c + jnp.arange(ntl - s0c)) * q
+
+            def step(k, view, i_v=i_v, j_v=j_v, s0r=s0r, s0c=s0c):
+                return _nopiv_step(view, k, p, q, i_v, j_v, r, c, s0r, s0c)
+
+            view = lax.fori_loop(k0, k1, step, view)
+            t_loc = t_loc.at[s0r:, s0c:].set(view)
+
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, info[None, None]
 

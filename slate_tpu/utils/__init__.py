@@ -1,1 +1,4 @@
 from . import testing
+
+from .printing import print_matrix, sprint_matrix, sprint_ownership
+from .debug import Debug, DebugError, check_dist, check_finite

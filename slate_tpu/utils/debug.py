@@ -1,0 +1,94 @@
+"""Debug invariant checkers — the analogue of the reference's
+``src/auxiliary/Debug.hh`` (checkTilesLives, checkTilesLayout,
+printTiles, memory-leak checks), gated by ``Debug.on()``.
+
+The reference's invariants guard its runtime machinery (MOSI states, tile
+lives, layout conversions).  The TPU build has no such runtime, so the
+checks that remain meaningful are data-layout and numerical invariants:
+
+- ``check_dist(d)``: a DistMatrix's tile grid matches its metadata, its
+  sharding places cyclic blocks on the right devices, and the pad region
+  honors the diag_pad contract (zero off-diagonal, unit diagonal).
+- ``check_finite(name, x)``: NaN/Inf tripwire between pipeline stages.
+
+All checkers are no-ops unless ``Debug.on()`` was called (so they can sit
+permanently in drivers, like the reference's `if (debug) Debug::...`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Debug:
+    _enabled = False
+
+    @classmethod
+    def on(cls) -> None:
+        cls._enabled = True
+
+    @classmethod
+    def off(cls) -> None:
+        cls._enabled = False
+
+    @classmethod
+    def enabled(cls) -> bool:
+        return cls._enabled
+
+
+class DebugError(AssertionError):
+    pass
+
+
+def check_finite(name: str, x) -> None:
+    """NaN/Inf tripwire (Debug.hh printTiles-style spot check)."""
+    if not Debug.enabled():
+        return
+    arr = np.asarray(x)
+    if not np.all(np.isfinite(arr)):
+        bad = int(np.sum(~np.isfinite(arr)))
+        raise DebugError(f"check_finite({name}): {bad} non-finite entries")
+
+
+def check_dist(d, name: str = "A") -> None:
+    """DistMatrix structural invariants (checkTilesLayout analogue)."""
+    if not Debug.enabled():
+        return
+    p, q = d.grid
+    mt, nt = d.tiles.shape[:2]
+    if d.tiles.ndim != 4 or d.tiles.shape[2] != d.nb or d.tiles.shape[3] != d.nb:
+        raise DebugError(f"check_dist({name}): tile stack shape {d.tiles.shape} "
+                         f"inconsistent with nb={d.nb}")
+    if mt % p or nt % q:
+        raise DebugError(f"check_dist({name}): tile grid {mt}x{nt} not divisible "
+                         f"by mesh {p}x{q}")
+    if mt * d.nb < d.m or nt * d.nb < d.n:
+        raise DebugError(f"check_dist({name}): grid {mt}x{nt} tiles of {d.nb} "
+                         f"cannot hold logical {d.m}x{d.n}")
+    # sharding placement: axis 0 split over 'p', axis 1 over 'q'
+    sh = getattr(d.tiles, "sharding", None)
+    if sh is not None and hasattr(sh, "spec"):
+        spec = tuple(sh.spec)
+        want = ("p", "q")
+        got = tuple(s for s in spec[:2])
+        if got != want and got != (None, None):  # fully replicated is legal
+            raise DebugError(f"check_dist({name}): sharding spec {spec} does not "
+                             f"split tile axes over ('p', 'q')")
+    # pad contract
+    from ..core.tiling import from_cyclic, from_tiles
+
+    full = np.asarray(from_tiles(from_cyclic(d.tiles, p, q), mt * d.nb, nt * d.nb))
+    # pad rows of real columns and pad cols of real rows must be zero
+    if full[d.m:, : d.n].size and np.abs(full[d.m:, : d.n]).max() > 0:
+        raise DebugError(f"check_dist({name}): nonzero pad rows")
+    if full[: d.m, d.n:].size and np.abs(full[: d.m, d.n:]).max() > 0:
+        raise DebugError(f"check_dist({name}): nonzero pad cols")
+    pad = full[d.m:, d.n:]
+    if pad.size:
+        diag = pad.diagonal()
+        offdiag = pad - np.diag(diag)
+        if np.abs(offdiag).max() > 0:
+            raise DebugError(f"check_dist({name}): nonzero off-diagonal pad")
+        if d.diag_pad and pad.shape[0] == pad.shape[1] and not np.allclose(diag, 1):
+            raise DebugError(f"check_dist({name}): diag_pad=True but pad diagonal "
+                             f"is not identity")

@@ -141,3 +141,16 @@ def test_posv_mixed_gmres():
     x, rnorm = posv_mixed_gmres_array(jnp.asarray(a), jnp.asarray(b), Uplo.Lower)
     resid = np.abs(a @ np.asarray(x) - b).max()
     assert resid / np.abs(b).max() < 1e-10
+
+
+def test_potrf_scan_matches_recursive():
+    # single-program scanned Cholesky (north-star sizes code path)
+    from slate_tpu.linalg.chol import _potrf_scan
+
+    rng = np.random.default_rng(41)
+    for n in (100, 300):
+        g = rng.standard_normal((n, n))
+        a = g @ g.T + n * np.eye(n)
+        l = np.tril(np.asarray(_potrf_scan(jnp.asarray(a), nb=64)))
+        ref = np.linalg.cholesky(a)
+        assert np.abs(l - ref).max() / np.abs(ref).max() < 1e-13

@@ -97,3 +97,43 @@ def test_cyclic_roundtrip(rng):
     np.testing.assert_allclose(np.asarray(c2[1]), np.asarray(t[2]))
     np.testing.assert_allclose(np.asarray(c2[4]), np.asarray(t[1]))
     assert list(tiling.cyclic_perm(8, 2)) == [0, 2, 4, 6, 1, 3, 5, 7]
+
+
+def test_print_matrix_formats():
+    from slate_tpu.utils.printing import sprint_matrix, sprint_ownership
+    from slate_tpu.types import Uplo
+
+    a = np.arange(36, dtype=np.float64).reshape(6, 6)
+    s = sprint_matrix("A", a, nb=2)
+    assert "A = [" in s and "6-by-6" in s
+    s = sprint_matrix("L", a, uplo=Uplo.Lower)
+    assert "." in s  # masked upper entries
+    big = np.zeros((64, 64))
+    s = sprint_matrix("B", big, edgeitems=4)
+    assert "..." in s  # center elision
+
+
+def test_print_ownership_and_debug_checks():
+    import jax.numpy as jnp
+
+    from conftest import cpu_devices
+    from slate_tpu.parallel import from_dense
+    from slate_tpu.parallel.mesh import make_mesh
+    from slate_tpu.utils.debug import Debug, DebugError, check_dist, check_finite
+    from slate_tpu.utils.printing import sprint_ownership
+
+    mesh = make_mesh(2, 2, devices=cpu_devices(4))
+    d = from_dense(jnp.eye(40), mesh, 8, diag_pad_one=True)
+    assert "(0,0)" in sprint_ownership("A", d)
+    check_dist(d)  # no-op while off
+    Debug.on()
+    try:
+        check_dist(d)
+        check_finite("x", np.ones(3))
+        try:
+            check_finite("bad", np.asarray([1.0, np.nan]))
+            raise AssertionError("expected DebugError")
+        except DebugError:
+            pass
+    finally:
+        Debug.off()

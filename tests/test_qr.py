@@ -109,3 +109,24 @@ def test_unmqr_complex_trans_rejected():
     c = generate("randn", 24, 4, np.complex128, seed=41)
     with pytest.raises(SlateError):
         unmqr_array(Side.Left, Op.Trans, f, jnp.asarray(c))
+
+
+def test_geqrf_scan():
+    # single-program scanned QR (north-star sizes code path)
+    from slate_tpu.linalg.qr import geqrf_scan_array, unmqr_scan_array
+    from slate_tpu.types import Op
+
+    rng = np.random.default_rng(40)
+    for m, n, nb in [(96, 96, 32), (130, 70, 32)]:
+        a = rng.standard_normal((m, n))
+        f = geqrf_scan_array(jnp.asarray(a), nb=nb)
+        r = np.asarray(f.r)
+        r_ext = np.zeros((m, n))
+        r_ext[: min(m, n)] = r[: min(m, n)]
+        qr = np.asarray(unmqr_scan_array(f, jnp.asarray(r_ext), Op.NoTrans))
+        assert np.abs(qr - a).max() / np.abs(a).max() < 1e-13
+        b = rng.standard_normal((m, 3))
+        rt = np.asarray(
+            unmqr_scan_array(f, unmqr_scan_array(f, jnp.asarray(b), Op.ConjTrans))
+        )
+        assert np.abs(rt - b).max() < 1e-12

@@ -1,0 +1,159 @@
+#!/usr/bin/env python3
+"""North-star size sweep on the real chip (VERDICT round-1 item 2).
+
+Runs each routine in its own subprocess (OOM/timeout isolation), one JSON
+line per result; the driver-facing artifact is SWEEP_r02.json.  Usage:
+
+    PYTHONPATH=/root/repo:/root/.axon_site python tools/northstar_sweep.py
+
+Timing note: single timed execution after a warm-up compile; the tunnel
+adds ~0.1 s dispatch latency per call, included (i.e. numbers are a lower
+bound on throughput).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+CASES = [
+    ("getrf_scan", 32768, 900),
+    ("getrf_scan", 16384, 600),
+    ("potrf_scan", 32768, 900),
+    ("potrf_scan", 16384, 600),
+    ("geqrf", 32768, 900),
+    ("geqrf", 16384, 600),
+    ("gemm_f32", 16384, 600),
+    # eig/svd stage 2 (hb2st/tb2bd) is a serial bulge chase — O(n^2 w)
+    # sequential window updates; n=8192 crashed the TPU worker after hours
+    # of chase, and svd at 1024 reproducibly faults it.  These are the
+    # honest currently-demonstrated on-chip sizes; the wavefront-pipelined
+    # chase (reference P7) is the path to 8192.
+    ("heev", 1024, 1800),
+    ("svd", 512, 1800),
+]
+
+CHILD = r"""
+import json, time, sys
+import numpy as np, jax, jax.numpy as jnp
+sys.path.insert(0, {root!r})
+routine, n = {routine!r}, {n}
+key = jax.random.PRNGKey(0)
+
+def emit(secs, gflops, check, ok):
+    print("RESULT " + json.dumps({{
+        "routine": routine, "n": n, "dtype": "f32",
+        "seconds": round(secs, 2), "gflops": round(gflops, 1),
+        "check": check, "ok": bool(ok)}}), flush=True)
+
+if routine == "getrf_scan":
+    from slate_tpu.linalg.lu import getrf_scan_array
+    a = jax.random.normal(key, (n, n), jnp.float32) / 64
+    f = jax.jit(lambda x: getrf_scan_array(x))
+    out = f(a); info = int(out.info)
+    d0 = float(jnp.abs(jnp.diagonal(out.lu)).min())
+    del out
+    t0 = time.perf_counter()
+    out = f(a)
+    info2 = int(out.info)  # host sync
+    t1 = time.perf_counter()
+    ok = info == 0 and np.isfinite(d0) and d0 > 0
+    emit(t1 - t0, 2 / 3 * n**3 / (t1 - t0) / 1e9, f"info={{info}} dmin={{d0:.2e}}", ok)
+elif routine == "potrf_scan":
+    from slate_tpu.linalg.chol import _potrf_scan
+    # Wigner shift: spectrum of sym/sqrt(n) is in [-2, 2], so 3I + W is
+    # SPD without materializing a Gram product; input is donated and the
+    # program AOT-compiled so peak HBM stays ~2 matrices (n = 32768 = 4GB)
+    f = jax.jit(_potrf_scan, donate_argnums=0)
+    comp = f.lower(jax.ShapeDtypeStruct((n, n), jnp.float32)).compile()
+    build = jax.jit(
+        lambda x: (x + x.T) / (2.0 * np.sqrt(n))
+        + 3.0 * jnp.eye(n, dtype=jnp.float32),
+        donate_argnums=0,
+    )
+    a = build(jax.random.normal(key, (n, n), jnp.float32))
+    t0 = time.perf_counter()
+    l = comp(a)
+    dmin = float(jnp.real(jnp.diagonal(l)).min())
+    t1 = time.perf_counter()
+    emit(t1 - t0, n**3 / 3 / (t1 - t0) / 1e9, f"dmin={{dmin:.2e}}",
+         np.isfinite(dmin) and dmin > 0)
+elif routine == "geqrf":
+    from slate_tpu.linalg.qr import geqrf_scan_array
+    f = jax.jit(lambda x: geqrf_scan_array(x).r, donate_argnums=0)
+    comp = f.lower(jax.ShapeDtypeStruct((n, n), jnp.float32)).compile()
+    a = jax.random.normal(key, (n, n), jnp.float32)
+    t0 = time.perf_counter()
+    r = comp(a)
+    dmin = float(jnp.abs(jnp.diagonal(r)).min())
+    t1 = time.perf_counter()
+    emit(t1 - t0, 4 / 3 * n**3 / (t1 - t0) / 1e9, f"rmin={{dmin:.2e}}",
+         np.isfinite(dmin) and dmin > 0)
+elif routine == "gemm_f32":
+    from slate_tpu.ops.matmul import matmul
+    a = jax.random.normal(key, (n, n), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32)
+    f = jax.jit(lambda a, b: jnp.sum(jnp.abs(matmul(a, b)[:1])))
+    float(f(a, b))
+    t0 = time.perf_counter()
+    v = float(f(a + 1e-6, b))
+    t1 = time.perf_counter()
+    emit(t1 - t0, 2 * n**3 / (t1 - t0) / 1e9, f"sum={{v:.3e}}", np.isfinite(v))
+elif routine == "heev":
+    from slate_tpu.linalg.eig import heev_array
+    g = jax.random.normal(key, (n, n), jnp.float32)
+    a = (g + g.T) / 2
+    del g
+    f = jax.jit(lambda x: heev_array(x, want_vectors=False))
+    t0 = time.perf_counter()
+    w = f(a)
+    wmax = float(jnp.abs(w).max())
+    t1 = time.perf_counter()
+    # Weyl sanity: spectral radius of a Wigner matrix ~ 2 sqrt(n) * sigma
+    ok = np.isfinite(wmax) and abs(wmax / (2 * np.sqrt(n) * np.sqrt(0.5)) - 1) < 0.2
+    emit(t1 - t0, 4 / 3 * n**3 / (t1 - t0) / 1e9, f"wmax={{wmax:.3e}}", ok)
+elif routine == "svd":
+    from slate_tpu.linalg.svd import svd_array
+    a = jax.random.normal(key, (n, n), jnp.float32)
+    f = jax.jit(lambda x: svd_array(x, want_vectors=False))
+    t0 = time.perf_counter()
+    s = f(a)
+    smax = float(s.max())
+    t1 = time.perf_counter()
+    ok = np.isfinite(smax) and abs(smax / (2 * np.sqrt(n)) - 1) < 0.2
+    emit(t1 - t0, 8 / 3 * n**3 / (t1 - t0) / 1e9, f"smax={{smax:.3e}}", ok)
+"""
+
+
+def main():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    results = []
+    for routine, n, tmo in CASES:
+        code = CHILD.format(root=root, routine=routine, n=n)
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True, text=True,
+                timeout=tmo,
+            )
+            line = [l for l in proc.stdout.splitlines() if l.startswith("RESULT ")]
+            if line:
+                results.append(json.loads(line[-1][7:]))
+            else:
+                tail = (proc.stderr or "")[-300:]
+                results.append({"routine": routine, "n": n, "ok": False,
+                                "error": f"rc={proc.returncode} {tail}"})
+        except subprocess.TimeoutExpired:
+            results.append({"routine": routine, "n": n, "ok": False,
+                            "error": f"timeout>{tmo}s"})
+        print(json.dumps(results[-1]), flush=True)
+    out = os.path.join(root, "SWEEP_r02.json")
+    with open(out, "w") as f:
+        json.dump({"chip": "TPU v5e (1 chip, via tunnel)", "results": results}, f,
+                  indent=1)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
