@@ -23,6 +23,10 @@ from .mesh import COL_AXIS, ROW_AXIS
 
 PRECISE = lax.Precision.HIGHEST
 
+# default trailing-update segmentation for the bucketed factorization
+# kernels (4 measured best on the CPU mesh; artifacts/README.md)
+BUCKETS = 4
+
 
 def bcast_from_col(x: jax.Array, owner_col) -> jax.Array:
     """Broadcast ``x`` from mesh column ``owner_col`` to all columns
@@ -64,7 +68,7 @@ def bcast_diag_tile(
     return lax.psum(lax.psum(dtile, ROW_AXIS), COL_AXIS)
 
 
-def bucket_plan(nt: int, p: int, q: int, nbuckets: int):
+def bucket_plan(nt: int, p: int, q: int, nbuckets: int = BUCKETS):
     """Static trailing-update segmentation shared by the bucketed
     factorization kernels: yields (k0, k1, s0r, s0c) per bucket, where
     s0r/s0c are uniform safe row/col tile cuts (every device keeps tiles

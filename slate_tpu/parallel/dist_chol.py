@@ -19,7 +19,7 @@ Design inversion: the OpenMP task graph + MOSI tile migration becomes ONE
 - trailing update = one masked batched einsum over the local tile stack.
 
 Static shapes: the update runs on trailing views with i/j > k masks
-(SURVEY §7 "masked full-size updates"), segmented into _BUCKETS
+(SURVEY §7 "masked full-size updates"), segmented into comm.BUCKETS
 statically-shrinking buckets — ~1.4x the optimal n^3/3 flops at 4
 buckets (measured 1.7x step-time reduction vs the unbucketed kernel;
 artifacts/README.md).  The work-optimal single-chip path is linalg.chol;
@@ -60,9 +60,6 @@ def potrf_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array]:
     return DistMatrix(
         tiles=lt, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True
     ), info
-
-
-_BUCKETS = 4  # trailing-update segmentation (see kernel docstring)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
@@ -115,14 +112,14 @@ def _potrf_jit(at, mesh, p, q, nt):
             return step
 
         # Trailing-update bucketing: the masked full-size update costs ~3x
-        # the optimal n^3/3; segmenting the k-range into _BUCKETS Python
+        # the optimal n^3/3; segmenting the k-range into comm.BUCKETS Python
         # buckets lets each run on a STATICALLY smaller trailing view
         # (finished tile rows/cols are sliced off between buckets), cutting
         # the masked flops to ~0.47x of full at 4 buckets (~1.4x optimal).
         # The reference gets the same effect from its shrinking task DAG
         # (potrf.cc:94); lookahead overlap is XLA's async scheduling over
         # the per-step collectives.
-        for k0, k1, s0r, s0c in bucket_plan(nt, p, q, _BUCKETS):
+        for k0, k1, s0r, s0c in bucket_plan(nt, p, q):
             view = t_loc[s0r:, s0c:]
             i_log_v = r + (s0r + jnp.arange(mtl - s0r)) * p
             j_log_v = c + (s0c + jnp.arange(ntl - s0c)) * q
@@ -130,8 +127,7 @@ def _potrf_jit(at, mesh, p, q, nt):
             view = lax.fori_loop(k0, k1, step, view)
             t_loc = t_loc.at[s0r:, s0c:].set(view)
 
-        i_log = r + jnp.arange(mtl) * p
-        j_log = c + jnp.arange(ntl) * q
+        _, _, i_log, j_log = local_indices(p, q, mtl, ntl)
         # info: 1 + global index of first bad pivot (potrf.cc:253-256), 0 if
         # ok.  Granularity caveat: XLA's cholesky NaN-fills the whole failing
         # tile, so on failure info points at the failing *tile*'s first bad

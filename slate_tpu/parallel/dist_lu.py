@@ -133,9 +133,7 @@ def _lu_jit(at, mesh, p, q, nt):
 
         # trailing-update bucketing (see dist_chol.py): each segment runs
         # on a statically smaller trailing view, cutting the masked flops
-        from .dist_chol import _BUCKETS
-
-        for k0, k1, s0r, s0c in bucket_plan(nt, p, q, _BUCKETS):
+        for k0, k1, s0r, s0c in bucket_plan(nt, p, q):
             view = t_loc[s0r:, s0c:]
             i_v = r + (s0r + jnp.arange(mtl - s0r)) * p
             j_v = c + (s0c + jnp.arange(ntl - s0c)) * q

@@ -71,7 +71,8 @@ def check_dist(d, name: str = "A") -> None:
         spec = tuple(sh.spec)
         want = ("p", "q")
         got = tuple(s for s in spec[:2])
-        if got != want and got != (None, None):  # fully replicated is legal
+        # fully replicated is legal: P() tuples to (), P(None, None) to Nones
+        if got != want and got not in ((), (None, None)):
             raise DebugError(f"check_dist({name}): sharding spec {spec} does not "
                              f"split tile axes over ('p', 'q')")
     # pad contract

@@ -47,15 +47,109 @@ def _rand(rng, m, n, dtype):
     return a.astype(dtype)
 
 
-def _time(fn, *args):
+def _time(fn, *args, label: str = ""):
     import jax
+
+    from slate_tpu.utils.trace import Trace
 
     out = fn(*args)  # warm/compile
     jax.block_until_ready(out)
     t0 = time.perf_counter()
     out = fn(*args)
     jax.block_until_ready(out)
-    return out, time.perf_counter() - t0
+    t1 = time.perf_counter()
+    if Trace.enabled():
+        Trace.add(label or getattr(fn, "__name__", "op"), 0, t0, t1)
+    return out, t1 - t0
+
+
+def _ref_solve(routine, a, extra=None):
+    """--ref mode: run the same problem through scipy/LAPACK and compare
+    (the reference tester's ScaLAPACK `ref` comparison, test_gemm.cc:310,
+    with scipy as the single-process reference library)."""
+    import scipy.linalg as sla
+
+    if routine == "gesv":
+        return sla.solve(a, extra)
+    if routine == "heev":
+        return np.linalg.eigvalsh(a)
+    if routine == "svd":
+        return np.linalg.svd(a, compute_uv=False)
+    return None
+
+
+def _make_mesh_from_grid(grid: str):
+    import jax
+
+    from slate_tpu.parallel.mesh import make_mesh
+
+    p, q = (int(x) for x in grid.lower().split("x"))
+    devs = jax.devices()
+    if len(devs) < p * q:
+        try:
+            devs = jax.devices("cpu")
+        except RuntimeError:
+            pass
+    if len(devs) < p * q:
+        raise SystemExit(
+            f"--grid {grid} needs {p * q} devices but only {len(devs)} are "
+            f"visible; for a virtual mesh set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={p * q} "
+            f"JAX_PLATFORMS=cpu"
+        )
+    return make_mesh(p, q, devices=devs[: p * q])
+
+
+def run_gemm_mesh(n, dtype, rng, check, grid):
+    import jax.numpy as jnp
+
+    from slate_tpu.parallel import gemm_mesh
+
+    mesh = _make_mesh_from_grid(grid)
+    a, b = _rand(rng, n, n, dtype), _rand(rng, n, n, dtype)
+    nb = max(8, min(64, n // max(*_make_grid_dims(grid))))
+    c, t = _time(lambda x, y: gemm_mesh(1.0, x, y, mesh, nb=nb),
+                 jnp.asarray(a), jnp.asarray(b))
+    err = 0.0
+    if check:
+        ref = a @ b
+        err = np.abs(np.asarray(c) - ref).max() / (np.abs(ref).max() + 1e-30)
+    return err, t, 2 * n**3 / t / 1e9, err < 100 * n * _eps(dtype)
+
+
+def _make_grid_dims(grid):
+    return tuple(int(x) for x in grid.lower().split("x"))
+
+
+def run_posv_mesh(n, dtype, rng, check, grid):
+    import jax.numpy as jnp
+
+    from slate_tpu.parallel import posv_mesh
+
+    mesh = _make_mesh_from_grid(grid)
+    g = _rand(rng, n, n, dtype)
+    a = g @ g.conj().T + n * np.eye(n, dtype=dtype)
+    b = _rand(rng, n, 2, dtype)
+    (x, info), t = _time(lambda aa, bb: posv_mesh(aa, bb, mesh, nb=16),
+                         jnp.asarray(a), jnp.asarray(b))
+    err = np.abs(a @ np.asarray(x) - b).max() / np.abs(b).max() if check else 0.0
+    return err, t, n**3 / 3 / t / 1e9, int(info) == 0 and err < 100 * n * _eps(dtype)
+
+
+def run_gesv_mesh(n, dtype, rng, check, grid):
+    import jax.numpy as jnp
+
+    from slate_tpu.parallel import gesv_tntpiv_mesh
+
+    mesh = _make_mesh_from_grid(grid)
+    a = _rand(rng, n, n, dtype)
+    b = _rand(rng, n, 2, dtype)
+    (x, info), t = _time(lambda aa, bb: gesv_tntpiv_mesh(aa, bb, mesh, nb=16),
+                         jnp.asarray(a), jnp.asarray(b))
+    x = np.asarray(x)
+    err = (np.abs(a @ x - b).max() / (np.abs(a).max() * max(1, np.abs(x).max()) * n)
+           if check else 0.0)
+    return err, t, 2 * n**3 / 3 / t / 1e9, int(info) == 0 and err < 100 * _eps(dtype)
 
 
 def run_gemm(n, dtype, rng, check):
@@ -213,6 +307,15 @@ def main(argv=None):
     ap.add_argument("--type", default="d", help="precisions from s,d,c,z")
     ap.add_argument("--check", default="y", choices=["y", "n"])
     ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--grid", default="",
+                    help="PxQ mesh grid: run the distributed variants "
+                         "(gemm/posv/gesv) over a device mesh")
+    ap.add_argument("--ref", default="n", choices=["y", "n"],
+                    help="also run scipy/LAPACK and report the comparison "
+                         "(reference tester's ScaLAPACK ref mode)")
+    ap.add_argument("--trace", default="",
+                    help="write an SVG timeline of the sweep via "
+                         "slate_tpu.utils.trace to this path")
     args = ap.parse_args(argv)
 
     import jax
@@ -222,18 +325,81 @@ def main(argv=None):
 
     rng = np.random.default_rng(args.seed)
     check = args.check == "y"
-    print(f"{'routine':<8} {'type':<4} {'n':>7} {'error':>10} {'status':>6} "
-          f"{'time(s)':>9} {'gflops':>10}")
+    tracer = None
+    if args.trace:
+        from slate_tpu.utils.trace import Trace
+
+        Trace.on()
+        tracer = Trace
+    hdr = (f"{'routine':<10} {'type':<4} {'n':>7} {'error':>10} {'status':>6} "
+           f"{'time(s)':>9} {'gflops':>10}")
+    print(hdr + ("  ref_diff" if args.ref == "y" else ""))
     failures = 0
     for routine in args.routines:
         for prefix in args.type.split(","):
             for n in _parse_dims(args.dim):
-                err, t, gflops, ok = ROUTINES[routine](n, _DTYPES[prefix], rng, check)
+                dtype = _DTYPES[prefix]
+                if args.grid and routine in MESH_ROUTINES:
+                    err, t, gflops, ok = MESH_ROUTINES[routine](
+                        n, dtype, rng, check, args.grid)
+                    rname = routine + "@" + args.grid
+                else:
+                    err, t, gflops, ok = ROUTINES[routine](n, dtype, rng, check)
+                    rname = routine
+                refcol = ""
+                if args.ref == "y":
+                    import scipy  # noqa: F401  (fail loudly if missing)
+
+                    refcol = "  " + _ref_compare(routine, n, dtype, args.seed)
                 status = "pass" if ok else "FAILED"
                 failures += 0 if ok else 1
-                print(f"{routine:<8} {prefix:<4} {n:>7} {err:>10.2e} {status:>6} "
-                      f"{t:>9.4f} {gflops:>10.1f}")
+                print(f"{rname:<10} {prefix:<4} {n:>7} {err:>10.2e} {status:>6} "
+                      f"{t:>9.4f} {gflops:>10.1f}{refcol}")
+    if tracer is not None:
+        out = tracer.finish(args.trace)
+        tracer.off()
+        print(f"trace written to {out}")
     return 1 if failures else 0
+
+
+def _ref_compare(routine, n, dtype, seed) -> str:
+    """Re-run the same seeded problem through scipy and diff the results
+    (seeded identically so 'random matrices are the same regardless of
+    distribution', CHANGELOG.md:25-26)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed + n)
+    if routine == "gesv":
+        from slate_tpu.linalg import gesv_array
+
+        a = _rand(rng, n, n, dtype)
+        b = _rand(rng, n, 2, dtype)
+        x, _ = gesv_array(jnp.asarray(a), jnp.asarray(b))
+        ref = _ref_solve("gesv", a, b)
+        return f"|x-ref|={np.abs(np.asarray(x) - ref).max():.2e}"
+    if routine == "heev":
+        from slate_tpu.linalg import heev_array
+
+        g = _rand(rng, n, n, dtype)
+        a = (g + g.conj().T) / 2
+        w = heev_array(jnp.asarray(a), want_vectors=False)
+        ref = _ref_solve("heev", a)
+        return f"|w-ref|={np.abs(np.asarray(w) - ref).max():.2e}"
+    if routine == "svd":
+        from slate_tpu.linalg import svd_array
+
+        a = _rand(rng, n, n, dtype)
+        sv = svd_array(jnp.asarray(a), want_vectors=False)
+        ref = _ref_solve("svd", a)
+        return f"|s-ref|={np.abs(np.sort(np.asarray(sv))[::-1] - ref).max():.2e}"
+    return "(no ref)"
+
+
+MESH_ROUTINES = {
+    "gemm": run_gemm_mesh,
+    "potrf": run_posv_mesh,
+    "gesv": run_gesv_mesh,
+}
 
 
 if __name__ == "__main__":
