@@ -187,44 +187,74 @@ def hb2st(band: Array, w: int = _EIG_NB):
     """Hermitian band (bandwidth w, dense storage) -> real tridiagonal
     (d, e) + reflectors for the back-transform.  Returns
     (d, e_real, factors, phases); eigvec lifting: z_band =
-    phases * unmtr_hb2st(factors, z_tridiag)."""
+    phases * unmtr_hb2st(factors, z_tridiag).
+
+    Wavefront pipelining (reference P7, hb2st.cc:170-281 taskloop): hop
+    (sweep j, hop t) touches only the 3w x 3w diagonal block at
+    r0 = j + 1 + t*w, and two hops conflict iff their r0 differ by < 3w.
+    Scheduling hop (j, t) at time s = 4j + t places concurrent hops exactly
+    4w-1 >= 3w apart (disjoint) and executes every conflicting pair in
+    sequential order, so the chase runs in ~4n batched steps instead of
+    (n-2) * ceil(n/w) serial hops — each step one gather of K ~ n/(4w)
+    disjoint blocks, a batched pair of rank-1 updates, one scatter."""
     n = band.shape[0]
     dtype = band.dtype
     cplx = jnp.issubdtype(dtype, jnp.complexfloating)
-    pad = 2 * w
+    # pad 4w: real windows start at >= pad+1-w = 3w+1, so the dummy block
+    # [0, 3w) used by idle wavefront slots never overlaps a live window.
+    pad = 4 * w
     ap = jnp.zeros((n + 2 * pad, n + 2 * pad), dtype)
     ap = ap.at[pad : pad + n, pad : pad + n].set(band)
     max_hops = max(1, -(-(n - 1) // w))
+    nsweeps = max(n - 2, 1)
     vs = jnp.zeros((max(n - 1, 1), max_hops, w), dtype)
     taus = jnp.zeros((max(n - 1, 1), max_hops), dtype)
+    k_slots = max_hops // 4 + 1
+    islot = jnp.arange(k_slots)
+    w3 = 3 * w
 
-    def hop_body(t, carry):
-        j, ap, vs, taus = carry
-        r0 = j + 1 + t * w  # first row of the reflector window
-        col = jnp.where(t == 0, j, r0 - w)
-        nact = jnp.clip(n - r0, 0, w)
-        x = lax.dynamic_slice(ap, (pad + r0, pad + col), (w, 1))[:, 0]
-        v, tau = _larfg_masked(x, nact)
-        # left: rows [r0, r0+w) over cols [r0-w, r0+2w): H A, where the
-        # larfg convention is H x = beta e1 (so H, not H^H, eliminates)
-        rows = lax.dynamic_slice(ap, (pad + r0, pad + r0 - w), (w, 3 * w))
-        rows = rows - tau * jnp.outer(v, matmul(jnp.conj(v)[None, :], rows)[0])
-        ap = lax.dynamic_update_slice(ap, rows, (pad + r0, pad + r0 - w))
-        # right: rows [r0-w, r0+2w) over cols [r0, r0+w): A H^H
-        cols = lax.dynamic_slice(ap, (pad + r0 - w, pad + r0), (3 * w, w))
-        cols = cols - jnp.conj(tau) * jnp.outer(matmul(cols, v[:, None])[:, 0], jnp.conj(v))
-        ap = lax.dynamic_update_slice(ap, cols, (pad + r0 - w, pad + r0))
-        vs = lax.dynamic_update_slice(vs, v[None, None, :], (j, t, 0))
-        taus = lax.dynamic_update_slice(taus, tau[None, None], (j, t))
-        return j, ap, vs, taus
-
-    def sweep_body(j, carry):
+    def step_body(s, carry):
         ap, vs, taus = carry
-        _, ap, vs, taus = lax.fori_loop(0, max_hops, hop_body, (j, ap, vs, taus))
+        j = s // 4 - islot
+        t = s - 4 * j
+        r0 = j + 1 + t * w
+        valid = (j >= 0) & (j < nsweeps) & (t < max_hops) & (r0 <= n - 1)
+        nact = jnp.where(valid, jnp.clip(n - r0, 0, w), 0)
+        b0 = jnp.where(valid, pad + r0 - w, 0)
+        blocks = jax.vmap(
+            lambda b: lax.dynamic_slice(ap, (b, b), (w3, w3))
+        )(b0)
+        # in-block column of the vector being eliminated: the first hop of a
+        # sweep reads column j (= r0-1), later hops column r0-w
+        cidx = jnp.where(t == 0, w - 1, 0)
+
+        def one(block, ci, na):
+            x = lax.dynamic_slice(block, (w, ci), (w, 1))[:, 0]
+            v, tau = _larfg_masked(x, na)
+            # left: H applied to rows [r0, r0+w) (block rows [w, 2w))
+            mid = block[w : 2 * w, :]
+            mid = mid - tau * jnp.outer(v, matmul(jnp.conj(v)[None, :], mid)[0])
+            block = block.at[w : 2 * w, :].set(mid)
+            # right: A H^H on cols [r0, r0+w) (block cols [w, 2w))
+            colb = block[:, w : 2 * w]
+            colb = colb - jnp.conj(tau) * jnp.outer(
+                matmul(colb, v[:, None])[:, 0], jnp.conj(v)
+            )
+            block = block.at[:, w : 2 * w].set(colb)
+            return block, v, tau
+
+        blocks, vb, taub = jax.vmap(one)(blocks, cidx, nact)
+        idx = b0[:, None] + jnp.arange(w3)[None, :]
+        ap = ap.at[idx[:, :, None], idx[:, None, :]].set(blocks)
+        jw = jnp.where(valid, j, vs.shape[0])  # out-of-bounds -> dropped
+        tw = jnp.where(valid, t, 0)
+        vs = vs.at[jw, tw].set(vb, mode="drop")
+        taus = taus.at[jw, tw].set(taub, mode="drop")
         return ap, vs, taus
 
     if n > 2:
-        ap, vs, taus = lax.fori_loop(0, n - 2, sweep_body, (ap, vs, taus))
+        nsteps = 4 * (nsweeps - 1) + max_hops
+        ap, vs, taus = lax.fori_loop(0, nsteps, step_body, (ap, vs, taus))
     at = ap[pad : pad + n, pad : pad + n]
     d = jnp.real(jnp.diagonal(at))
     e = jnp.diagonal(at, -1)
@@ -242,39 +272,46 @@ def hb2st(band: Array, w: int = _EIG_NB):
     return d, e_real, Hb2stFactors(vs, taus, w, n), phases
 
 
-def unmtr_hb2st(f: Hb2stFactors, z: Array) -> Array:
-    """Z <- Q Z for the stage-2 Q (src/unmtr_hb2st.cc): reflectors applied
-    in reverse chronological order."""
-    n, w = f.n, f.w
-    nsweeps = f.vs.shape[0]
-    max_hops = f.vs.shape[1]
-    nrhs = z.shape[1]
-    pad = 2 * w
-    zp = jnp.zeros((n + 2 * pad, nrhs), z.dtype)
-    zp = zp.at[pad : pad + n].set(z)
+def _chase_sweep_apply(
+    vs: Array, taus: Array, z: Array, n: int, w: int, adjoint: bool
+) -> Array:
+    """Apply a bulge-chase reflector family to Z, one batched sweep at a
+    time.  Within one sweep j the hops touch DISJOINT w-row slabs of Z
+    (rows j+1+t*w for t = 0..max_hops-1 tile [j+1, j+1+max_hops*w)
+    contiguously), so a whole sweep is one batched rank-1 update on a
+    (max_hops, w, nrhs) reshape — serial depth n instead of n^2/w.
 
-    def hop_body(tt, carry):
-        j, zp = carry
-        t = max_hops - 1 - tt
-        r0 = j + 1 + t * w
-        v = lax.dynamic_slice(f.vs, (j, t, 0), (1, 1, w))[0, 0].astype(z.dtype)
-        tau = lax.dynamic_slice(f.taus, (j, t), (1, 1))[0, 0].astype(z.dtype)
-        # Z <- H_i^H Z in reverse chronological order: the stage-2 basis is
-        # U = H_1^H H_2^H ... H_N^H (A_tri = U^H A U), so U Z applies the
-        # conj-transposed reflectors last-to-first
-        rows = lax.dynamic_slice(zp, (pad + r0, 0), (w, nrhs))
-        rows = rows - jnp.conj(tau) * jnp.outer(v, matmul(jnp.conj(v)[None, :], rows)[0])
-        zp = lax.dynamic_update_slice(zp, rows, (pad + r0, 0))
-        return j, zp
+    adjoint=False applies the basis U = H_1^H H_2^H ... (reflectors
+    conj-transposed, reverse chronological order); adjoint=True applies
+    U^H (reflectors as-is, chronological order)."""
+    nsweeps, max_hops = vs.shape[0], vs.shape[1]
+    nrhs = z.shape[1]
+    span = max_hops * w
+    zp = jnp.zeros((n + span, nrhs), z.dtype)
+    zp = zp.at[:n].set(z)
 
     def sweep_body(jj, zp):
-        j = (nsweeps - 1) - jj  # reverse sweeps
-        _, zp = lax.fori_loop(0, max_hops, hop_body, (j, zp))
-        return zp
+        j = jj if adjoint else (nsweeps - 1) - jj
+        # hop order within a sweep is irrelevant (disjoint rows)
+        slab = lax.dynamic_slice(zp, (j + 1, 0), (span, nrhs))
+        slab = slab.reshape(max_hops, w, nrhs)
+        vj = lax.dynamic_slice(vs, (j, 0, 0), (1, max_hops, w))[0].astype(z.dtype)
+        tj = lax.dynamic_slice(taus, (j, 0), (1, max_hops))[0].astype(z.dtype)
+        cj = tj if adjoint else jnp.conj(tj)
+        coef = jnp.einsum("hw,hwr->hr", jnp.conj(vj), slab)
+        slab = slab - cj[:, None, None] * vj[:, :, None] * coef[:, None, :]
+        return lax.dynamic_update_slice(zp, slab.reshape(span, nrhs), (j + 1, 0))
 
-    if n > 2:
+    if n > 1:
         zp = lax.fori_loop(0, nsweeps, sweep_body, zp)
-    return zp[pad : pad + n]
+    return zp[:n]
+
+
+def unmtr_hb2st(f: Hb2stFactors, z: Array) -> Array:
+    """Z <- Q Z for the stage-2 Q (src/unmtr_hb2st.cc): the basis is
+    U = H_1^H H_2^H ... (A_tri = U^H A U), so U Z applies conj-transposed
+    reflectors last-to-first, one batched sweep at a time."""
+    return _chase_sweep_apply(f.vs, f.taus, z, f.n, f.w, adjoint=False)
 
 
 # ---------------------------------------------------------------------------

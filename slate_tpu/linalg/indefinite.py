@@ -152,31 +152,11 @@ def _unmtr_he2hb_adj(f1: He2hbFactors, c: Array) -> Array:
 
 
 def _unmtr_hb2st_adj(f2: Hb2stFactors, z: Array) -> Array:
-    """Z <- U^H Z with U = H_1^H ... H_N^H: apply H_i chronologically."""
-    n, w = f2.n, f2.w
-    nsweeps, max_hops = f2.vs.shape[0], f2.vs.shape[1]
-    nrhs = z.shape[1]
-    pad = 2 * w
-    zp = jnp.zeros((n + 2 * pad, nrhs), z.dtype)
-    zp = zp.at[pad : pad + n].set(z)
+    """Z <- U^H Z with U = H_1^H ... H_N^H: apply H_i chronologically
+    (batched per sweep, eig._chase_sweep_apply adjoint path)."""
+    from .eig import _chase_sweep_apply
 
-    def hop_body(t, carry):
-        j, zp = carry
-        r0 = j + 1 + t * w
-        v = lax.dynamic_slice(f2.vs, (j, t, 0), (1, 1, w))[0, 0].astype(z.dtype)
-        tau = lax.dynamic_slice(f2.taus, (j, t), (1, 1))[0, 0].astype(z.dtype)
-        rows = lax.dynamic_slice(zp, (pad + r0, 0), (w, nrhs))
-        rows = rows - tau * jnp.outer(v, matmul(jnp.conj(v)[None, :], rows)[0])
-        zp = lax.dynamic_update_slice(zp, rows, (pad + r0, 0))
-        return j, zp
-
-    def sweep_body(j, zp):
-        _, zp = lax.fori_loop(0, max_hops, hop_body, (j, zp))
-        return zp
-
-    if n > 2:
-        zp = lax.fori_loop(0, nsweeps, sweep_body, zp)
-    return zp[pad : pad + n]
+    return _chase_sweep_apply(f2.vs, f2.taus, z, f2.n, f2.w, adjoint=True)
 
 
 def hetrs_array(f: HetrfFactors, b: Array) -> Tuple[Array, Array]:

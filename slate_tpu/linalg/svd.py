@@ -178,11 +178,18 @@ class Tb2bdFactors(NamedTuple):
 def tb2bd(band: Array, w: int = _SVD_NB):
     """Upper-band (bandwidth w) square matrix -> upper bidiagonal (d, e),
     plus reflectors.  Chases each row's out-of-band tail down the band with
-    alternating right/left Householders (tb2bd.cc wavefront, serialized)."""
+    alternating right/left Householders.
+
+    Wavefront pipelining (reference P7, tb2bd.cc): hop (sweep j, hop t)
+    touches only the 3w x 3w diagonal block at c0 = j + 1 + t*w; scheduling
+    it at time s = 4j + t makes concurrent hops disjoint (spacing 4w-1 >=
+    3w) while preserving sequential order between conflicting hops — ~4n
+    batched gather/update/scatter steps instead of (n-1)*ceil(n/w) serial
+    hops (see eig.hb2st for the schedule proof)."""
     n = band.shape[0]
     dtype = band.dtype
     cplx = jnp.issubdtype(dtype, jnp.complexfloating)
-    pad = 2 * w
+    pad = 4 * w  # dummy block [0, 3w) for idle slots; live windows >= 3w+1
     ap = jnp.zeros((n + 2 * pad, n + 2 * pad), dtype)
     ap = ap.at[pad : pad + n, pad : pad + n].set(band)
     nsweeps = max(n - 1, 1)
@@ -191,42 +198,57 @@ def tb2bd(band: Array, w: int = _SVD_NB):
     ltaus = jnp.zeros((nsweeps, max_hops), dtype)
     rvs = jnp.zeros((nsweeps, max_hops, w), dtype)
     rtaus = jnp.zeros((nsweeps, max_hops), dtype)
+    k_slots = max_hops // 4 + 1
+    islot = jnp.arange(k_slots)
+    w3 = 3 * w
 
-    def hop_body(t, carry):
-        j, ap, lvs, ltaus, rvs, rtaus = carry
-        c0 = j + 1 + t * w  # column window [c0, c0+w)
-        row = jnp.where(t == 0, j, c0 - w)  # row whose tail we eliminate
-        # --- right Householder: eliminate row tail A[row, c0+1 : c0+w] ---
-        nact_r = jnp.clip(n - c0, 0, w)
-        xr = lax.dynamic_slice(ap, (pad + row, pad + c0), (1, w))[0]
-        vr, taur = _larfg_masked(jnp.conj(xr), nact_r)
-        # W <- W G with G s.t. (x G)[1:] = 0:  W - conj(tau) (W v) v^H
-        wnd = lax.dynamic_slice(ap, (pad + c0 - w, pad + c0), (3 * w, w))
-        wnd = wnd - jnp.conj(taur) * jnp.outer(matmul(wnd, vr[:, None])[:, 0], jnp.conj(vr))
-        ap = lax.dynamic_update_slice(ap, wnd, (pad + c0 - w, pad + c0))
-        rvs = lax.dynamic_update_slice(rvs, vr[None, None, :], (j, t, 0))
-        rtaus = lax.dynamic_update_slice(rtaus, taur[None, None], (j, t))
-        # --- left Householder: eliminate column c0 below diag ---
-        nact_l = jnp.clip(n - c0, 0, w)
-        xl = lax.dynamic_slice(ap, (pad + c0, pad + c0), (w, 1))[:, 0]
-        vl, taul = _larfg_masked(xl, nact_l)
-        wnd2 = lax.dynamic_slice(ap, (pad + c0, pad + c0 - w), (w, 3 * w))
-        wnd2 = wnd2 - taul * jnp.outer(vl, matmul(jnp.conj(vl)[None, :], wnd2)[0])
-        ap = lax.dynamic_update_slice(ap, wnd2, (pad + c0, pad + c0 - w))
-        lvs = lax.dynamic_update_slice(lvs, vl[None, None, :], (j, t, 0))
-        ltaus = lax.dynamic_update_slice(ltaus, taul[None, None], (j, t))
-        return j, ap, lvs, ltaus, rvs, rtaus
-
-    def sweep_body(j, carry):
+    def step_body(s, carry):
         ap, lvs, ltaus, rvs, rtaus = carry
-        _, ap, lvs, ltaus, rvs, rtaus = lax.fori_loop(
-            0, max_hops, hop_body, (j, ap, lvs, ltaus, rvs, rtaus)
-        )
+        j = s // 4 - islot
+        t = s - 4 * j
+        c0 = j + 1 + t * w
+        valid = (j >= 0) & (j < n - 1) & (t < max_hops) & (c0 <= n - 1)
+        nact = jnp.where(valid, jnp.clip(n - c0, 0, w), 0)
+        b0 = jnp.where(valid, pad + c0 - w, 0)
+        blocks = jax.vmap(
+            lambda b: lax.dynamic_slice(ap, (b, b), (w3, w3))
+        )(b0)
+        # in-block row whose tail the right reflector eliminates: the first
+        # hop of a sweep reads row j (= c0-1), later hops row c0-w
+        ridx = jnp.where(t == 0, w - 1, 0)
+
+        def one(block, ri, na):
+            # --- right Householder: W <- W G, G s.t. (x G)[1:] = 0 ---
+            xr = lax.dynamic_slice(block, (ri, w), (1, w))[0]
+            vr, taur = _larfg_masked(jnp.conj(xr), na)
+            colb = block[:, w : 2 * w]
+            colb = colb - jnp.conj(taur) * jnp.outer(
+                matmul(colb, vr[:, None])[:, 0], jnp.conj(vr)
+            )
+            block = block.at[:, w : 2 * w].set(colb)
+            # --- left Householder: eliminate column c0 below diag ---
+            xl = block[w : 2 * w, w]
+            vl, taul = _larfg_masked(xl, na)
+            mid = block[w : 2 * w, :]
+            mid = mid - taul * jnp.outer(vl, matmul(jnp.conj(vl)[None, :], mid)[0])
+            block = block.at[w : 2 * w, :].set(mid)
+            return block, vr, taur, vl, taul
+
+        blocks, vrb, taurb, vlb, taulb = jax.vmap(one)(blocks, ridx, nact)
+        idx = b0[:, None] + jnp.arange(w3)[None, :]
+        ap = ap.at[idx[:, :, None], idx[:, None, :]].set(blocks)
+        jw = jnp.where(valid, j, nsweeps)  # shape[0] -> dropped
+        tw = jnp.where(valid, t, 0)
+        rvs = rvs.at[jw, tw].set(vrb, mode="drop")
+        rtaus = rtaus.at[jw, tw].set(taurb, mode="drop")
+        lvs = lvs.at[jw, tw].set(vlb, mode="drop")
+        ltaus = ltaus.at[jw, tw].set(taulb, mode="drop")
         return ap, lvs, ltaus, rvs, rtaus
 
     if n > 1:
+        nsteps = 4 * (n - 2) + max_hops
         ap, lvs, ltaus, rvs, rtaus = lax.fori_loop(
-            0, max(n - 1, 0), sweep_body, (ap, lvs, ltaus, rvs, rtaus)
+            0, nsteps, step_body, (ap, lvs, ltaus, rvs, rtaus)
         )
     at = ap[pad : pad + n, pad : pad + n]
     d = jnp.diagonal(at)
@@ -269,36 +291,14 @@ def unmbr_tb2bd_v(f: Tb2bdFactors, z: Array) -> Array:
 
 
 def _apply_chase(f: Tb2bdFactors, z: Array, left: bool) -> Array:
-    n, w = f.n, f.w
-    nsweeps, max_hops = f.lvs.shape[0], f.lvs.shape[1]
-    nrhs = z.shape[1]
-    pad = 2 * w
-    zp = jnp.zeros((n + 2 * pad, nrhs), z.dtype)
-    zp = zp.at[pad : pad + n].set(z)
+    """Batched sweep application (eig._chase_sweep_apply): left basis
+    applies H^H (conj tau); right applies G = I - conj(tau) v v^H — the
+    same coefficient, so both share the adjoint=False path."""
+    from .eig import _chase_sweep_apply
+
     vs = f.lvs if left else f.rvs
     taus = f.ltaus if left else f.rtaus
-
-    def hop_body(tt, carry):
-        j, zp = carry
-        t = max_hops - 1 - tt
-        c0 = j + 1 + t * w
-        v = lax.dynamic_slice(vs, (j, t, 0), (1, 1, w))[0, 0].astype(z.dtype)
-        tau = lax.dynamic_slice(taus, (j, t), (1, 1))[0, 0].astype(z.dtype)
-        # left basis applies H^H (conj tau); right applies G = I - conj(tau) v v^H
-        coef = jnp.conj(tau)
-        rows = lax.dynamic_slice(zp, (pad + c0, 0), (w, nrhs))
-        rows = rows - coef * jnp.outer(v, matmul(jnp.conj(v)[None, :], rows)[0])
-        zp = lax.dynamic_update_slice(zp, rows, (pad + c0, 0))
-        return j, zp
-
-    def sweep_body(jj, zp):
-        j = (nsweeps - 1) - jj
-        _, zp = lax.fori_loop(0, max_hops, hop_body, (j, zp))
-        return zp
-
-    if n > 1:
-        zp = lax.fori_loop(0, nsweeps, sweep_body, zp)
-    return zp[pad : pad + n]
+    return _chase_sweep_apply(vs, taus, z, f.n, f.w, adjoint=False)
 
 
 # ---------------------------------------------------------------------------
