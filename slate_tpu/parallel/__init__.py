@@ -10,6 +10,14 @@ from .dist_lu import getrf_nopiv_dist, getrf_tntpiv_dist, permute_rows_dist
 from .dist_trsm import trsm_dist, trsm_dist_right
 from .dist_qr import DistQR, geqrf_dist, unmqr_dist
 from .dist_aux import herk_dist, norm_dist
+from .dist_twostage import (
+    DistTwoStage,
+    ge2tb_dist,
+    he2hb_dist,
+    unmbr_ge2tb_u_dist,
+    unmbr_ge2tb_v_dist,
+    unmtr_he2hb_dist,
+)
 from .drivers import (
     gemm_mesh,
     gesv_nopiv_mesh,
@@ -18,8 +26,10 @@ from .drivers import (
     geqrf_mesh,
     getrf_nopiv_mesh,
     getrf_tntpiv_mesh,
+    heev_mesh,
     posv_mesh,
     potrf_mesh,
+    svd_mesh,
 )
 
 __all__ = [
@@ -56,4 +66,12 @@ __all__ = [
     "getrf_tntpiv_mesh",
     "posv_mesh",
     "potrf_mesh",
+    "DistTwoStage",
+    "he2hb_dist",
+    "ge2tb_dist",
+    "unmtr_he2hb_dist",
+    "unmbr_ge2tb_u_dist",
+    "unmbr_ge2tb_v_dist",
+    "heev_mesh",
+    "svd_mesh",
 ]

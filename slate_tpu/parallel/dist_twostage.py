@@ -1,0 +1,395 @@
+"""Distributed stage-1 two-stage reductions: he2hb and ge2tb on the mesh.
+
+TPU-native analogue of the reference's distributed stage-1 kernels
+(``src/he2hb.cc:207-604``: panel QR on the grid column + distributed
+two-sided block update via he2hb_{hemm,her2k,trmm,gemm} internal ops, and
+``src/ge2tb.cc``: alternating distributed QR/LQ panels).  Stage 2 (the
+band -> tridiagonal / bidiagonal bulge chase) stays a single-program
+wavefront kernel (linalg.eig.hb2st / linalg.svd.tb2bd) on the gathered
+band — the band is (n, nb), tiny next to the O(n^2) matrix, which matches
+the reference's placement of hb2st/tb2bd on the node that owns the band.
+
+Design: the panel factorization is REPLICATED, the trailing update is
+DISTRIBUTED.  Per panel k every device receives the full (m, nb) panel
+column (one masked psum along 'q' + one all_gather along 'p', m * nb
+elements) and runs the same offset-pivot panel QR — the panel is O(m nb^2)
+flops, negligible next to the O(n^2 nb) trailing update, and replicating
+it deletes the reference's panel-rank round trips (he2hb.cc:238-287).
+The two-sided update B -= W V^H + V W^H runs on the local tile stacks
+with W/V sliced by each device's global row/column ids: Y = A V is a
+local flat gemm + psum over 'q', the W~ = Y T - 1/2 V (T^H V^H Y T)
+algebra is replicated (m x nb), and the rank-2nb update is two local
+outer products.  Reflectors are stored SHARDED by mesh row ('p') so the
+distributed back-transform (unmtr_he2hb on a DistMatrix of eigenvectors)
+runs with one psum per panel and no reflector gathers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..linalg.eig import _he2hb_panel_count
+from ..linalg.qr import _larft_v, _panel_qr_offset
+from .comm import PRECISE, bcast_from_col, bcast_from_row, local_indices, shard_map
+from .dist import DistMatrix
+from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
+
+
+def _to_global_rows(x_loc: jax.Array, nparts: int, nb: int, axis_name: str):
+    """All-gather a per-device row slice (cyclic tile order) into the full
+    GLOBAL flat row order: gathered slot r holds logical tiles {i : i %
+    nparts == r} at slot i // nparts, so a (slot, r) transpose linearizes
+    to logical tile index i = slot * nparts + r."""
+    mfl, w = x_loc.shape
+    mtl = mfl // nb
+    ag = lax.all_gather(x_loc, axis_name, axis=0)  # (nparts, mfl, w)
+    ag = ag.reshape(nparts, mtl, nb, w).transpose(1, 0, 2, 3)
+    return ag.reshape(mtl * nparts * nb, w)
+
+
+class DistTwoStage(NamedTuple):
+    """Stage-1 factors: reflectors sharded along one mesh axis, compact-WY
+    accumulators replicated."""
+
+    band: DistMatrix
+    vq: jax.Array  # (K, p * mfl, nb) — global rows, sharded over 'p'
+    tq: jax.Array  # (K, nb, nb) replicated
+    vl: jax.Array  # ge2tb only: (K, q * nfl, nb) — A-cols, sharded over 'q'
+    tl: jax.Array  # ge2tb only: (K, nb, nb)
+
+
+# ---------------------------------------------------------------------------
+# he2hb: full Hermitian -> band over the mesh (src/he2hb.cc)
+# ---------------------------------------------------------------------------
+
+
+def he2hb_dist(a: DistMatrix) -> DistTwoStage:
+    """Reduce the full Hermitian DistMatrix (both triangles stored) to a
+    Hermitian band of bandwidth nb; Q panels sharded over mesh rows."""
+    p, q = mesh_shape(a.mesh)
+    if a.m != a.n:
+        raise ValueError("he2hb_dist needs a square matrix")
+    nsteps = _he2hb_panel_count(a.n, a.nb)
+    bt, vs, ts = _he2hb_jit(a.tiles, a.mesh, p, q, a.n, a.nb, nsteps)
+    band = DistMatrix(tiles=bt, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh)
+    return DistTwoStage(band, vs, ts, vs[:0], ts[:0])
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
+def _he2hb_jit(at, mesh, p, q, n_true, nb, nsteps):
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(t_loc):
+        mtl, ntl, _, _ = t_loc.shape
+        dtype = t_loc.dtype
+        r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
+        mfl, nfl = mtl * nb, ntl * nb
+        rg = (i_log[:, None] * nb + jnp.arange(nb)[None, :]).reshape(-1)
+        cg = (j_log[:, None] * nb + jnp.arange(nb)[None, :]).reshape(-1)
+        a = jnp.transpose(t_loc, (0, 2, 1, 3)).reshape(mfl, nfl)
+        mglob = mtl * p * nb
+        grows = jnp.arange(mglob)
+
+        def step(k, carry):
+            a, vqs, tqs = carry
+            j0 = k * nb
+            c0 = j0 + nb
+            kc, kr = k // q, k // p
+            mine_c, mine_r = c == k % q, r == k % p
+
+            # full panel column, global row order, replicated
+            pcol = lax.dynamic_slice(a, (0, kc * nb), (mfl, nb))
+            pcol = bcast_from_col(jnp.where(mine_c, pcol, 0), k % q)
+            gpan = _to_global_rows(pcol, p, nb, ROW_AXIS)
+            masked = jnp.where(((grows >= c0) & (grows < n_true))[:, None], gpan, 0)
+            r_a, v, tau = _panel_qr_offset(masked, c0)
+            t = _larft_v(v, tau)
+
+            # write [history above c0 | R; 0] into the panel column + mirror
+            newpan = jnp.where((grows >= c0)[:, None], r_a, gpan)
+            a = jnp.where(
+                mine_c,
+                lax.dynamic_update_slice(a, newpan[rg], (0, kc * nb)),
+                a,
+            )
+            rowblk = lax.dynamic_slice(a, (kr * nb, 0), (nb, nfl))
+            mirr = jnp.conj(newpan[cg]).T  # (nb, nfl)
+            rowblk_new = jnp.where((cg >= c0)[None, :], mirr, rowblk)
+            a = jnp.where(
+                mine_r,
+                lax.dynamic_update_slice(a, rowblk_new, (kr * nb, 0)),
+                a,
+            )
+
+            # two-sided trailing update (he2hb.cc:207-604 algebra):
+            # Y = A V (local gemm + psum over 'q'), W~ replicated, then
+            # A -= W~ V^H + V W~^H on the local stack
+            v_rows, v_cols = v[rg], v[cg]
+            y_part = jnp.einsum("rc,ci->ri", a, v_cols, precision=PRECISE)
+            y = lax.psum(y_part, COL_AXIS)
+            y = jnp.where((rg >= c0)[:, None], y, 0).astype(dtype)
+            yg = _to_global_rows(y, p, nb, ROW_AXIS)
+            wmat = jnp.einsum("ri,ij->rj", yg, t, precision=PRECISE)
+            x = jnp.einsum(
+                "ji,jk->ik", jnp.conj(t),
+                jnp.einsum("ri,rj->ij", jnp.conj(v), wmat, precision=PRECISE),
+                precision=PRECISE,
+            )
+            wt = (wmat - 0.5 * jnp.einsum("ri,ij->rj", v, x, precision=PRECISE)).astype(dtype)
+            wt_rows, wt_cols = wt[rg], wt[cg]
+            upd = jnp.einsum("ri,ci->rc", wt_rows, jnp.conj(v_cols), precision=PRECISE)
+            upd = upd + jnp.einsum(
+                "ri,ci->rc", v_rows, jnp.conj(wt_cols), precision=PRECISE
+            )
+            a = a - upd.astype(dtype)
+            return a, vqs.at[k].set(v[rg]), tqs.at[k].set(t)
+
+        vqs0 = jnp.zeros((max(nsteps, 1), mfl, nb), dtype)
+        tqs0 = jnp.zeros((max(nsteps, 1), nb, nb), dtype)
+        if nsteps:
+            a, vqs, tqs = lax.fori_loop(0, nsteps, step, (a, vqs0, tqs0))
+        else:
+            vqs, tqs = vqs0, tqs0
+        t_out = jnp.transpose(a.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
+        return t_out, vqs, tqs
+
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=(spec, P(None, ROW_AXIS), P()),
+        check_vma=False,
+    )(at)
+
+
+def unmtr_he2hb_dist(f: DistTwoStage, z: DistMatrix, adjoint: bool = False) -> DistMatrix:
+    """Z <- Q Z (or Q^H Z) for the distributed stage-1 Q: one psum along
+    'p' per panel, reflectors consumed from their sharded storage
+    (src/unmtr_he2hb.cc)."""
+    p, q = mesh_shape(z.mesh)
+    if f.band.mt != z.mt or f.band.nb != z.nb:
+        raise ValueError("unmtr_he2hb_dist operand mismatch")
+    zt = _apply_row_panels_jit(f.vq, f.tq, z.tiles, z.mesh, p, q, adjoint)
+    return DistMatrix(tiles=zt, m=z.m, n=z.n, nb=z.nb, mesh=z.mesh)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6))
+def _apply_row_panels_jit(vqs, tqs, zt, mesh, p, q, adjoint):
+    spec = P(ROW_AXIS, COL_AXIS)
+    nsteps = vqs.shape[0]
+
+    def kernel(vq_loc, tq, z_loc):
+        mtl, ntl, nb, _ = z_loc.shape
+        mfl, nfl = mtl * nb, ntl * nb
+        z = jnp.transpose(z_loc, (0, 2, 1, 3)).reshape(mfl, nfl)
+        dtype = z.dtype
+
+        def body(i, z):
+            k = i if adjoint else nsteps - 1 - i
+            v = vq_loc[k]
+            t = jnp.conj(tq[k]).T if adjoint else tq[k]
+            w1 = lax.psum(
+                jnp.einsum("ri,rc->ic", jnp.conj(v), z, precision=PRECISE),
+                ROW_AXIS,
+            )
+            upd = jnp.einsum("ri,ij,jc->rc", v, t, w1, precision=PRECISE)
+            return z - upd.astype(dtype)
+
+        z = lax.fori_loop(0, nsteps, body, z)
+        return jnp.transpose(z.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
+
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(None, ROW_AXIS), P(), spec),
+        out_specs=spec,
+        check_vma=False,
+    )(vqs, tqs, zt)
+
+
+# ---------------------------------------------------------------------------
+# ge2tb: general -> upper triangular band over the mesh (src/ge2tb.cc)
+# ---------------------------------------------------------------------------
+
+
+def ge2tb_dist(a: DistMatrix) -> DistTwoStage:
+    """Reduce a general (m >= n) DistMatrix to an upper triangular band of
+    bandwidth nb via alternating distributed QR/LQ panels; U-side
+    reflectors sharded over 'p', V-side over 'q'."""
+    p, q = mesh_shape(a.mesh)
+    if a.m < a.n:
+        raise ValueError(f"ge2tb_dist requires m >= n, got {a.m}x{a.n}")
+    nblocks = -(-a.n // a.nb)
+    bt, vqs, tqs, vls, tls = _ge2tb_jit(
+        a.tiles, a.mesh, p, q, a.m, a.n, a.nb, nblocks
+    )
+    band = DistMatrix(tiles=bt, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh)
+    return DistTwoStage(band, vqs, tqs, vls, tls)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
+def _ge2tb_jit(at, mesh, p, q, m_true, n_true, nb, nblocks):
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(t_loc):
+        mtl, ntl, _, _ = t_loc.shape
+        dtype = t_loc.dtype
+        r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
+        mfl, nfl = mtl * nb, ntl * nb
+        rg = (i_log[:, None] * nb + jnp.arange(nb)[None, :]).reshape(-1)
+        cg = (j_log[:, None] * nb + jnp.arange(nb)[None, :]).reshape(-1)
+        a = jnp.transpose(t_loc, (0, 2, 1, 3)).reshape(mfl, nfl)
+        grows = jnp.arange(mtl * p * nb)
+        gcols = jnp.arange(ntl * q * nb)
+
+        def step(k, carry):
+            a, vqs, tqs, vls, tls = carry
+            j0 = k * nb
+            j1 = j0 + nb
+            kc, kr = k // q, k // p
+            mine_c, mine_r = c == k % q, r == k % p
+
+            # ---- QR panel: eliminate below-diagonal of block column k ----
+            pcol = lax.dynamic_slice(a, (0, kc * nb), (mfl, nb))
+            pcol = bcast_from_col(jnp.where(mine_c, pcol, 0), k % q)
+            gpan = _to_global_rows(pcol, p, nb, ROW_AXIS)
+            masked = jnp.where(((grows >= j0) & (grows < m_true))[:, None], gpan, 0)
+            r_a, vq, tauq = _panel_qr_offset(masked, j0)
+            tq = _larft_v(vq, tauq)
+            # left trailing update on cols >= j1: A -= Vq T^H (Vq^H A)
+            vq_rows = vq[rg]
+            w1 = lax.psum(
+                jnp.einsum("ri,rc->ic", jnp.conj(vq_rows), a, precision=PRECISE),
+                ROW_AXIS,
+            )
+            upd = jnp.einsum(
+                "ri,ij,jc->rc", vq_rows, jnp.conj(tq).T, w1, precision=PRECISE
+            ).astype(dtype)
+            a = a - jnp.where((cg >= j1)[None, :], upd, 0)
+            newpan = jnp.where((grows >= j0)[:, None], r_a, gpan)
+            a = jnp.where(
+                mine_c,
+                lax.dynamic_update_slice(a, newpan[rg], (0, kc * nb)),
+                a,
+            )
+
+            # ---- LQ panel on block row k (QR of its conj transpose) ----
+            lq_active = j1 < n_true - 1
+            rowblk = lax.dynamic_slice(a, (kr * nb, 0), (nb, nfl))
+            rowb = bcast_from_row(jnp.where(mine_r, rowblk, 0), k % p)
+            # to global col order: gather the (nfl, nb) transpose by cols
+            growb = _to_global_rows(jnp.conj(rowb).T, q, nb, COL_AXIS)  # (nglob, nb)
+            maskedh = jnp.where(
+                ((gcols >= j1) & lq_active)[:, None], growb, 0
+            )
+            l_a, vl, taul = _panel_qr_offset(maskedh, j1)
+            tl = _larft_v(vl, taul)
+            vl = vl * jnp.asarray(lq_active, dtype)
+            tl = tl * jnp.asarray(lq_active, dtype)
+            # right trailing update on rows >= j1: A -= (A Vl) Tl Vl^H
+            vl_cols = vl[cg]
+            w2 = lax.psum(
+                jnp.einsum("rc,ci->ri", a, vl_cols, precision=PRECISE), COL_AXIS
+            )
+            upd2 = jnp.einsum(
+                "ri,ij,cj->rc", w2, tl, jnp.conj(vl_cols), precision=PRECISE
+            ).astype(dtype)
+            a = a - jnp.where((rg >= j1)[:, None], upd2, 0)
+            newrow = jnp.where(
+                ((cg >= j1) & lq_active)[None, :], jnp.conj(l_a[cg]).T, rowblk
+            )
+            a = jnp.where(
+                mine_r,
+                lax.dynamic_update_slice(a, newrow, (kr * nb, 0)),
+                a,
+            )
+            return (
+                a,
+                vqs.at[k].set(vq[rg]),
+                tqs.at[k].set(tq),
+                vls.at[k].set(vl[cg]),
+                tls.at[k].set(tl),
+            )
+
+        vqs0 = jnp.zeros((nblocks, mfl, nb), dtype)
+        tqs0 = jnp.zeros((nblocks, nb, nb), dtype)
+        vls0 = jnp.zeros((nblocks, nfl, nb), dtype)
+        tls0 = jnp.zeros((nblocks, nb, nb), dtype)
+        a, vqs, tqs, vls, tls = lax.fori_loop(
+            0, nblocks, step, (a, vqs0, tqs0, vls0, tls0)
+        )
+        t_out = jnp.transpose(a.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
+        return t_out, vqs, tqs, vls, tls
+
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=(spec, P(None, ROW_AXIS), P(), P(None, COL_AXIS), P()),
+        check_vma=False,
+    )(at)
+
+
+def unmbr_ge2tb_u_dist(f: DistTwoStage, z: DistMatrix, adjoint: bool = False) -> DistMatrix:
+    """Z <- Q Z for the stage-1 U factor (src/unmbr_ge2tb.cc U side) —
+    identical panel-apply loop to unmtr_he2hb_dist."""
+    p, q = mesh_shape(z.mesh)
+    if f.band.mt != z.mt or f.band.nb != z.nb:
+        raise ValueError("unmbr_ge2tb_u_dist operand mismatch")
+    zt = _apply_row_panels_jit(f.vq, f.tq, z.tiles, z.mesh, p, q, adjoint)
+    return DistMatrix(tiles=zt, m=z.m, n=z.n, nb=z.nb, mesh=z.mesh)
+
+
+def unmbr_ge2tb_v_dist(f: DistTwoStage, z: DistMatrix) -> DistMatrix:
+    """Z <- P Z for the stage-1 V factor: the reflectors live in A's
+    COLUMN space (sharded over 'q') while Z's rows are sharded over 'p',
+    so each panel is re-gathered to global order (n * nb elements) and
+    sliced by Z's row ids — one all_gather + one psum per panel."""
+    p, q = mesh_shape(z.mesh)
+    if f.band.nt * f.band.nb != z.mt * z.nb or f.band.nb != z.nb:
+        raise ValueError("unmbr_ge2tb_v_dist operand mismatch")
+    zt = _apply_col_panels_jit(f.vl, f.tl, z.tiles, z.mesh, p, q)
+    return DistMatrix(tiles=zt, m=z.m, n=z.n, nb=z.nb, mesh=z.mesh)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _apply_col_panels_jit(vls, tls, zt, mesh, p, q):
+    spec = P(ROW_AXIS, COL_AXIS)
+    nsteps = vls.shape[0]
+
+    def kernel(vl_loc, tl, z_loc):
+        mtl, ntl, nb, _ = z_loc.shape
+        mfl, nfl = mtl * nb, ntl * nb
+        z = jnp.transpose(z_loc, (0, 2, 1, 3)).reshape(mfl, nfl)
+        dtype = z.dtype
+        _, _, i_log, _ = local_indices(p, q, mtl, ntl)
+        rg = (i_log[:, None] * nb + jnp.arange(nb)[None, :]).reshape(-1)
+
+        def body(i, z):
+            k = nsteps - 1 - i
+            gvl = _to_global_rows(vl_loc[k], q, nb, COL_AXIS)
+            v = gvl[jnp.minimum(rg, gvl.shape[0] - 1)]
+            v = jnp.where((rg < gvl.shape[0])[:, None], v, 0)
+            w1 = lax.psum(
+                jnp.einsum("ri,rc->ic", jnp.conj(v), z, precision=PRECISE),
+                ROW_AXIS,
+            )
+            upd = jnp.einsum("ri,ij,jc->rc", v, tl[k], w1, precision=PRECISE)
+            return z - upd.astype(dtype)
+
+        z = lax.fori_loop(0, nsteps, body, z)
+        return jnp.transpose(z.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
+
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(None, COL_AXIS), P(), spec),
+        out_specs=spec,
+        check_vma=False,
+    )(vls, tls, zt)

@@ -105,6 +105,66 @@ def gels_mesh(
     return to_dense(xd), info
 
 
+def heev_mesh(
+    a: jax.Array, mesh: Mesh, nb: int = 64, want_vectors: bool = True
+):
+    """Distributed Hermitian eigensolver (src/heev.cc with a grid): stage 1
+    (he2hb, the O(n^3) reduction) and the stage-1 back-transform run on the
+    mesh; the band-to-tridiagonal chase + divide & conquer run as
+    single-program wavefront kernels on the gathered (n, nb)-band."""
+    from ..linalg.eig import hb2st, unmtr_hb2st
+    from ..linalg.tridiag import stedc, sterf
+    from .dist_twostage import he2hb_dist, unmtr_he2hb_dist
+
+    n = a.shape[0]
+    cplx = jnp.issubdtype(a.dtype, jnp.complexfloating)
+    f = he2hb_dist(from_dense(a, mesh, nb))
+    band = to_dense(f.band)
+    # the distributed two-sided update is Hermitian in exact arithmetic;
+    # shave the O(eps * nsteps) rounding asymmetry before the band chase
+    band = 0.5 * (band + (jnp.conj(band).T if cplx else band.T))
+    d, e, f2, phases = hb2st(band, nb)
+    if not want_vectors:
+        return sterf(d, e)
+    w, ztri = stedc(d, e)
+    z = ztri.astype(a.dtype)
+    if cplx:
+        z = phases[:, None] * z
+    z = unmtr_hb2st(f2, z)
+    zd = unmtr_he2hb_dist(f, from_dense(z, mesh, nb))
+    return w, to_dense(zd)
+
+
+def svd_mesh(
+    a: jax.Array, mesh: Mesh, nb: int = 64, want_vectors: bool = True
+):
+    """Distributed SVD (src/svd.cc with a grid): ge2tb and both stage-1
+    back-transforms on the mesh, band chase + GK/stedc solve single-program
+    (see heev_mesh)."""
+    from ..linalg.svd import bdsqr, tb2bd, unmbr_tb2bd_u, unmbr_tb2bd_v
+    from .dist_twostage import ge2tb_dist, unmbr_ge2tb_u_dist, unmbr_ge2tb_v_dist
+
+    m, n = a.shape
+    dtype = a.dtype
+    if m < n:
+        if not want_vectors:
+            return svd_mesh(jnp.conj(a).T, mesh, nb, False)
+        u, s, vh = svd_mesh(jnp.conj(a).T, mesh, nb, True)
+        return jnp.conj(vh).T, s, jnp.conj(u).T
+    f = ge2tb_dist(from_dense(a, mesh, nb))
+    band = to_dense(f.band)[:n, :n]
+    d, e, f2, pu, pv = tb2bd(band, nb)
+    if not want_vectors:
+        return bdsqr(d, e, want_vectors=False)
+    s, ub, vb = bdsqr(d, e, want_vectors=True)
+    u = unmbr_tb2bd_u(f2, pu[:, None] * ub.astype(dtype))
+    u_full = jnp.zeros((m, n), dtype).at[:n].set(u)
+    ud = unmbr_ge2tb_u_dist(f, from_dense(u_full, mesh, nb))
+    v = unmbr_tb2bd_v(f2, pv[:, None] * vb.astype(dtype))
+    vd = unmbr_ge2tb_v_dist(f, from_dense(v, mesh, nb))
+    return to_dense(ud), s, jnp.conj(to_dense(vd)).T
+
+
 def getrf_tntpiv_mesh(
     a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
 ) -> Tuple[DistMatrix, jax.Array, jax.Array]:

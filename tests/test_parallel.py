@@ -390,3 +390,78 @@ def test_posv_self_check_fully_distributed(rng):
     resid = float(norm_dist(Norm.Fro, rd)) / float(norm_dist(Norm.Fro, bd))
     assert int(info) == 0
     assert resid < 1e-12
+
+
+def test_heev_mesh(rng):
+    from slate_tpu.parallel import heev_mesh
+
+    n = 96
+    a = _rand(rng, n, n)
+    a = (a + a.T) / 2
+    w, z = heev_mesh(a, mesh24(), nb=16)
+    an, zn, wn = np.asarray(a), np.asarray(z), np.asarray(w)
+    wref = np.linalg.eigvalsh(an)
+    eps = np.finfo(np.float64).eps
+    assert np.abs(np.sort(wn) - wref).max() < 50 * n * eps * max(1, np.abs(wref).max())
+    assert np.abs(an @ zn - zn * wn).max() < 50 * n * eps * max(1, np.abs(wref).max())
+    assert np.abs(zn.T @ zn - np.eye(n)).max() < 50 * n * eps
+    # values-only path
+    w2 = heev_mesh(a, mesh24(), nb=16, want_vectors=False)
+    assert np.abs(np.sort(np.asarray(w2)) - wref).max() < 50 * n * eps * max(
+        1, np.abs(wref).max()
+    )
+
+
+def test_heev_mesh_complex(rng):
+    from slate_tpu.parallel import heev_mesh
+
+    n = 64
+    a = _rand(rng, n, n, np.complex128)
+    a = (a + jnp.conj(a).T) / 2
+    w, z = heev_mesh(a, mesh22(), nb=16)
+    an, zn, wn = np.asarray(a), np.asarray(z), np.asarray(w)
+    wref = np.linalg.eigvalsh(an)
+    eps = np.finfo(np.float64).eps
+    scale = max(1, np.abs(wref).max())
+    assert np.abs(np.sort(wn) - wref).max() < 50 * n * eps * scale
+    assert np.abs(an @ zn - zn * wn).max() < 50 * n * eps * scale
+    assert np.abs(zn.conj().T @ zn - np.eye(n)).max() < 50 * n * eps
+
+
+@pytest.mark.parametrize("shape", [(80, 64), (64, 96), (100, 100)])
+def test_svd_mesh(rng, shape):
+    from slate_tpu.parallel import svd_mesh
+
+    m, n = shape
+    a = _rand(rng, m, n)
+    u, s, vh = svd_mesh(a, mesh24(), nb=16)
+    an, un, sn, vn = np.asarray(a), np.asarray(u), np.asarray(s), np.asarray(vh)
+    sref = np.linalg.svd(an, compute_uv=False)
+    k = min(m, n)
+    eps = np.finfo(np.float64).eps
+    scale = max(1, sref.max())
+    assert np.abs(sn - sref).max() < 50 * k * eps * scale
+    assert np.abs(an - (un * sn) @ vn).max() < 50 * k * eps * scale
+    assert np.abs(un.conj().T @ un - np.eye(un.shape[1])).max() < 50 * k * eps
+    assert np.abs(vn @ vn.conj().T - np.eye(vn.shape[0])).max() < 50 * k * eps
+    svals = svd_mesh(a, mesh24(), nb=16, want_vectors=False)
+    assert np.abs(np.asarray(svals) - sref).max() < 50 * k * eps * scale
+
+
+def test_he2hb_dist_band_structure(rng):
+    """Stage-1 output really is banded and orthogonally similar to A."""
+    from slate_tpu.parallel import from_dense, he2hb_dist, to_dense
+
+    n, nb = 64, 16
+    a = _rand(rng, n, n)
+    a = (a + a.T) / 2
+    f = he2hb_dist(from_dense(a, mesh24(), nb))
+    band = np.asarray(to_dense(f.band))
+    # outside the band: zero
+    ii, jj = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+    out = np.abs(ii - jj) > nb
+    assert np.abs(band[out]).max() < 1e-12
+    # same spectrum
+    wref = np.linalg.eigvalsh(np.asarray(a))
+    wband = np.linalg.eigvalsh(0.5 * (band + band.T))
+    assert np.abs(wref - wband).max() < 1e-11
