@@ -25,6 +25,7 @@ from .lu import (
     getrf_scan_array,
     getrf_tntpiv_array,
     getri_array,
+    getri_oop_array,
     getrs_array,
 )
 from .refine import (
@@ -57,11 +58,12 @@ from .norms import (
     pocondest,
     trcondest,
 )
-from .tridiag import stedc, steqr, sterf
+from .tridiag import stedc, stedc_vals, steqr, sterf
 from .eig import (
     He2hbFactors,
     he2hb,
     heev_array,
+    heev_staged,
     hegst_array,
     hegv_array,
     hb2st,
@@ -73,6 +75,7 @@ from .svd import (
     bdsqr,
     ge2tb,
     svd_array,
+    svd_staged,
     tb2bd,
     unmbr_ge2tb_u,
     unmbr_ge2tb_v,

@@ -183,38 +183,32 @@ class Hb2stFactors(NamedTuple):
     n: int
 
 
-def hb2st(band: Array, w: int = _EIG_NB):
-    """Hermitian band (bandwidth w, dense storage) -> real tridiagonal
-    (d, e) + reflectors for the back-transform.  Returns
-    (d, e_real, factors, phases); eigvec lifting: z_band =
-    phases * unmtr_hb2st(factors, z_tridiag).
+def _wavefront_chase(ap, n, w, nsweeps, max_hops, one, facs):
+    """Shared wavefront scheduling harness for the bulge chases (hb2st and
+    svd.tb2bd): hop (sweep j, hop t) touches only the 3w x 3w diagonal
+    block at r0 = j + 1 + t*w, and two hops conflict iff their r0 differ
+    by < 3w.  Scheduling hop (j, t) at time s = 4j + t places concurrent
+    hops exactly 4w-1 >= 3w apart (disjoint) and executes every
+    conflicting pair in sequential order, so a chase runs in ~4n batched
+    steps instead of nsweeps * max_hops serial hops — each step one
+    gather of K ~ max_hops/4 disjoint blocks, one vmapped block update
+    (``one``), one scatter.
 
-    Wavefront pipelining (reference P7, hb2st.cc:170-281 taskloop): hop
-    (sweep j, hop t) touches only the 3w x 3w diagonal block at
-    r0 = j + 1 + t*w, and two hops conflict iff their r0 differ by < 3w.
-    Scheduling hop (j, t) at time s = 4j + t places concurrent hops exactly
-    4w-1 >= 3w apart (disjoint) and executes every conflicting pair in
-    sequential order, so the chase runs in ~4n batched steps instead of
-    (n-2) * ceil(n/w) serial hops — each step one gather of K ~ n/(4w)
-    disjoint blocks, a batched pair of rank-1 updates, one scatter."""
-    n = band.shape[0]
-    dtype = band.dtype
-    cplx = jnp.issubdtype(dtype, jnp.complexfloating)
-    # pad 4w: real windows start at >= pad+1-w = 3w+1, so the dummy block
-    # [0, 3w) used by idle wavefront slots never overlaps a live window.
-    pad = 4 * w
-    ap = jnp.zeros((n + 2 * pad, n + 2 * pad), dtype)
-    ap = ap.at[pad : pad + n, pad : pad + n].set(band)
-    max_hops = max(1, -(-(n - 1) // w))
-    nsweeps = max(n - 2, 1)
-    vs = jnp.zeros((max(n - 1, 1), max_hops, w), dtype)
-    taus = jnp.zeros((max(n - 1, 1), max_hops), dtype)
+    ``ap`` must be padded by 4w on each side: idle wavefront slots park on
+    the dummy block [0, 3w), which live windows (start >= 3w+1) never
+    touch; idle updates are identities (nact = 0 -> tau = 0), so their
+    duplicate scatter writes all carry the same zero values.  ``one``
+    receives (block, idx0, nact) where idx0 is the in-block row/column of
+    the vector being eliminated (w-1 on a sweep's first hop, else 0) and
+    returns (block, *per_hop_factors); factor rows for idle slots are
+    dropped via an out-of-bounds row index."""
     k_slots = max_hops // 4 + 1
     islot = jnp.arange(k_slots)
     w3 = 3 * w
+    pad = 4 * w
 
     def step_body(s, carry):
-        ap, vs, taus = carry
+        ap, *fs = carry
         j = s // 4 - islot
         t = s - 4 * j
         r0 = j + 1 + t * w
@@ -224,37 +218,61 @@ def hb2st(band: Array, w: int = _EIG_NB):
         blocks = jax.vmap(
             lambda b: lax.dynamic_slice(ap, (b, b), (w3, w3))
         )(b0)
-        # in-block column of the vector being eliminated: the first hop of a
-        # sweep reads column j (= r0-1), later hops column r0-w
-        cidx = jnp.where(t == 0, w - 1, 0)
-
-        def one(block, ci, na):
-            x = lax.dynamic_slice(block, (w, ci), (w, 1))[:, 0]
-            v, tau = _larfg_masked(x, na)
-            # left: H applied to rows [r0, r0+w) (block rows [w, 2w))
-            mid = block[w : 2 * w, :]
-            mid = mid - tau * jnp.outer(v, matmul(jnp.conj(v)[None, :], mid)[0])
-            block = block.at[w : 2 * w, :].set(mid)
-            # right: A H^H on cols [r0, r0+w) (block cols [w, 2w))
-            colb = block[:, w : 2 * w]
-            colb = colb - jnp.conj(tau) * jnp.outer(
-                matmul(colb, v[:, None])[:, 0], jnp.conj(v)
-            )
-            block = block.at[:, w : 2 * w].set(colb)
-            return block, v, tau
-
-        blocks, vb, taub = jax.vmap(one)(blocks, cidx, nact)
+        idx0 = jnp.where(t == 0, w - 1, 0)
+        blocks, *vals = jax.vmap(one)(blocks, idx0, nact)
         idx = b0[:, None] + jnp.arange(w3)[None, :]
         ap = ap.at[idx[:, :, None], idx[:, None, :]].set(blocks)
-        jw = jnp.where(valid, j, vs.shape[0])  # out-of-bounds -> dropped
+        jw = jnp.where(valid, j, fs[0].shape[0])  # out-of-bounds -> dropped
         tw = jnp.where(valid, t, 0)
-        vs = vs.at[jw, tw].set(vb, mode="drop")
-        taus = taus.at[jw, tw].set(taub, mode="drop")
-        return ap, vs, taus
+        fs = [f.at[jw, tw].set(v, mode="drop") for f, v in zip(fs, vals)]
+        return (ap, *fs)
+
+    nsteps = 4 * (nsweeps - 1) + max_hops
+    return lax.fori_loop(0, nsteps, step_body, (ap, *facs))
+
+
+def hb2st(band: Array, w: int = _EIG_NB):
+    """Hermitian band (bandwidth w, dense storage) -> real tridiagonal
+    (d, e) + reflectors for the back-transform.  Returns
+    (d, e_real, factors, phases); eigvec lifting: z_band =
+    phases * unmtr_hb2st(factors, z_tridiag).
+
+    Wavefront pipelining (reference P7, hb2st.cc:170-281 taskloop): see
+    _wavefront_chase for the schedule; per hop the in-block update is one
+    left Householder on rows [r0, r0+w) and its mirrored right
+    application."""
+    n = band.shape[0]
+    dtype = band.dtype
+    cplx = jnp.issubdtype(dtype, jnp.complexfloating)
+    pad = 4 * w
+    ap = jnp.zeros((n + 2 * pad, n + 2 * pad), dtype)
+    ap = ap.at[pad : pad + n, pad : pad + n].set(band)
+    max_hops = max(1, -(-(n - 1) // w))
+    nsweeps = max(n - 2, 1)
+    vs = jnp.zeros((max(n - 1, 1), max_hops, w), dtype)
+    taus = jnp.zeros((max(n - 1, 1), max_hops), dtype)
+
+    # in-block column of the vector being eliminated (idx0): the first hop
+    # of a sweep reads column j (= r0-1), later hops column r0-w
+    def one(block, ci, na):
+        x = lax.dynamic_slice(block, (w, ci), (w, 1))[:, 0]
+        v, tau = _larfg_masked(x, na)
+        # left: H applied to rows [r0, r0+w) (block rows [w, 2w))
+        mid = block[w : 2 * w, :]
+        mid = mid - tau * jnp.outer(v, matmul(jnp.conj(v)[None, :], mid)[0])
+        block = block.at[w : 2 * w, :].set(mid)
+        # right: A H^H on cols [r0, r0+w) (block cols [w, 2w))
+        colb = block[:, w : 2 * w]
+        colb = colb - jnp.conj(tau) * jnp.outer(
+            matmul(colb, v[:, None])[:, 0], jnp.conj(v)
+        )
+        block = block.at[:, w : 2 * w].set(colb)
+        return block, v, tau
 
     if n > 2:
-        nsteps = 4 * (nsweeps - 1) + max_hops
-        ap, vs, taus = lax.fori_loop(0, nsteps, step_body, (ap, vs, taus))
+        ap, vs, taus = _wavefront_chase(
+            ap, n, w, nsweeps, max_hops, one, (vs, taus)
+        )
     at = ap[pad : pad + n, pad : pad + n]
     d = jnp.real(jnp.diagonal(at))
     e = jnp.diagonal(at, -1)
@@ -347,6 +365,41 @@ def heev_array(
         z = phases[:, None] * z
     z = unmtr_hb2st(f2, z)
     z = unmtr_he2hb(f1, z)
+    return w, z
+
+
+def heev_staged(
+    a: Array,
+    want_vectors: bool = True,
+    method: MethodEig = MethodEig.DC,
+    nb: int = _EIG_NB,
+):
+    """heev with each phase dispatched as its OWN XLA program (jit per
+    stage) rather than one fused program.  Numerically identical to
+    heev_array; use it at large n: the reference's heev is likewise a
+    sequence of phase barriers (he2hb | hb2st | solver | back-transforms,
+    src/heev.cc), and a single fused program for all phases exceeds the
+    TPU runtime's per-program ceiling near n = 8192 (worker kernel fault;
+    each phase alone runs fine — tools/northstar_sweep.py finding)."""
+    from .tridiag import stedc_vals as _vals
+
+    n = a.shape[0]
+    if n == 1:
+        return heev_array(a, want_vectors, method, nb)
+    f1 = jax.jit(he2hb, static_argnums=1)(a, nb)
+    d, e, f2, phases = jax.jit(hb2st, static_argnums=1)(f1.band, nb)
+    if not want_vectors:
+        return jax.jit(_vals)(d, e)
+    solver = stedc if method == MethodEig.DC else steqr
+    w, ztri = jax.jit(solver)(d, e)
+    z = ztri.astype(a.dtype)
+    if jnp.issubdtype(a.dtype, jnp.complexfloating):
+        z = phases[:, None] * z
+    # factor-tuple ints (n, w) shape the apply kernels -> pass static
+    z = jax.jit(_chase_sweep_apply, static_argnums=(3, 4, 5))(
+        f2.vs, f2.taus, z, n, nb, False
+    )
+    z = jax.jit(unmtr_he2hb)(He2hbFactors(f1.band, f1.v, f1.t, nb), z)
     return w, z
 
 

@@ -470,6 +470,15 @@ def getri_array(f: LUFactors) -> jax.Array:
     return x[:, jnp.argsort(f.perm)]
 
 
+def getri_oop_array(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Out-of-place inverse (src/getriOOP.cc): factor A and solve
+    A X = I without forming triangular inverses — the reference's
+    workspace-matrix variant.  Returns (A^-1, info)."""
+    f = getrf_array(a)
+    eye = jnp.eye(a.shape[0], dtype=a.dtype)
+    return getrs_array(f, eye), f.info
+
+
 # object-level drivers -------------------------------------------------------
 
 

@@ -180,16 +180,16 @@ def tb2bd(band: Array, w: int = _SVD_NB):
     plus reflectors.  Chases each row's out-of-band tail down the band with
     alternating right/left Householders.
 
-    Wavefront pipelining (reference P7, tb2bd.cc): hop (sweep j, hop t)
-    touches only the 3w x 3w diagonal block at c0 = j + 1 + t*w; scheduling
-    it at time s = 4j + t makes concurrent hops disjoint (spacing 4w-1 >=
-    3w) while preserving sequential order between conflicting hops — ~4n
-    batched gather/update/scatter steps instead of (n-1)*ceil(n/w) serial
-    hops (see eig.hb2st for the schedule proof)."""
+    Wavefront pipelining (reference P7, tb2bd.cc): the schedule and
+    gather/scatter harness are eig._wavefront_chase; per hop the in-block
+    update is one right Householder eliminating a row tail followed by one
+    left Householder eliminating the created column bulge."""
+    from .eig import _wavefront_chase
+
     n = band.shape[0]
     dtype = band.dtype
     cplx = jnp.issubdtype(dtype, jnp.complexfloating)
-    pad = 4 * w  # dummy block [0, 3w) for idle slots; live windows >= 3w+1
+    pad = 4 * w
     ap = jnp.zeros((n + 2 * pad, n + 2 * pad), dtype)
     ap = ap.at[pad : pad + n, pad : pad + n].set(band)
     nsweeps = max(n - 1, 1)
@@ -198,57 +198,29 @@ def tb2bd(band: Array, w: int = _SVD_NB):
     ltaus = jnp.zeros((nsweeps, max_hops), dtype)
     rvs = jnp.zeros((nsweeps, max_hops, w), dtype)
     rtaus = jnp.zeros((nsweeps, max_hops), dtype)
-    k_slots = max_hops // 4 + 1
-    islot = jnp.arange(k_slots)
-    w3 = 3 * w
 
-    def step_body(s, carry):
-        ap, lvs, ltaus, rvs, rtaus = carry
-        j = s // 4 - islot
-        t = s - 4 * j
-        c0 = j + 1 + t * w
-        valid = (j >= 0) & (j < n - 1) & (t < max_hops) & (c0 <= n - 1)
-        nact = jnp.where(valid, jnp.clip(n - c0, 0, w), 0)
-        b0 = jnp.where(valid, pad + c0 - w, 0)
-        blocks = jax.vmap(
-            lambda b: lax.dynamic_slice(ap, (b, b), (w3, w3))
-        )(b0)
-        # in-block row whose tail the right reflector eliminates: the first
-        # hop of a sweep reads row j (= c0-1), later hops row c0-w
-        ridx = jnp.where(t == 0, w - 1, 0)
-
-        def one(block, ri, na):
-            # --- right Householder: W <- W G, G s.t. (x G)[1:] = 0 ---
-            xr = lax.dynamic_slice(block, (ri, w), (1, w))[0]
-            vr, taur = _larfg_masked(jnp.conj(xr), na)
-            colb = block[:, w : 2 * w]
-            colb = colb - jnp.conj(taur) * jnp.outer(
-                matmul(colb, vr[:, None])[:, 0], jnp.conj(vr)
-            )
-            block = block.at[:, w : 2 * w].set(colb)
-            # --- left Householder: eliminate column c0 below diag ---
-            xl = block[w : 2 * w, w]
-            vl, taul = _larfg_masked(xl, na)
-            mid = block[w : 2 * w, :]
-            mid = mid - taul * jnp.outer(vl, matmul(jnp.conj(vl)[None, :], mid)[0])
-            block = block.at[w : 2 * w, :].set(mid)
-            return block, vr, taur, vl, taul
-
-        blocks, vrb, taurb, vlb, taulb = jax.vmap(one)(blocks, ridx, nact)
-        idx = b0[:, None] + jnp.arange(w3)[None, :]
-        ap = ap.at[idx[:, :, None], idx[:, None, :]].set(blocks)
-        jw = jnp.where(valid, j, nsweeps)  # shape[0] -> dropped
-        tw = jnp.where(valid, t, 0)
-        rvs = rvs.at[jw, tw].set(vrb, mode="drop")
-        rtaus = rtaus.at[jw, tw].set(taurb, mode="drop")
-        lvs = lvs.at[jw, tw].set(vlb, mode="drop")
-        ltaus = ltaus.at[jw, tw].set(taulb, mode="drop")
-        return ap, lvs, ltaus, rvs, rtaus
+    # idx0 = in-block row whose tail the right reflector eliminates: the
+    # first hop of a sweep reads row j (= c0-1), later hops row c0-w
+    def one(block, ri, na):
+        # --- right Householder: W <- W G, G s.t. (x G)[1:] = 0 ---
+        xr = lax.dynamic_slice(block, (ri, w), (1, w))[0]
+        vr, taur = _larfg_masked(jnp.conj(xr), na)
+        colb = block[:, w : 2 * w]
+        colb = colb - jnp.conj(taur) * jnp.outer(
+            matmul(colb, vr[:, None])[:, 0], jnp.conj(vr)
+        )
+        block = block.at[:, w : 2 * w].set(colb)
+        # --- left Householder: eliminate column c0 below diag ---
+        xl = block[w : 2 * w, w]
+        vl, taul = _larfg_masked(xl, na)
+        mid = block[w : 2 * w, :]
+        mid = mid - taul * jnp.outer(vl, matmul(jnp.conj(vl)[None, :], mid)[0])
+        block = block.at[w : 2 * w, :].set(mid)
+        return block, vr, taur, vl, taul
 
     if n > 1:
-        nsteps = 4 * (n - 2) + max_hops
-        ap, lvs, ltaus, rvs, rtaus = lax.fori_loop(
-            0, nsteps, step_body, (ap, lvs, ltaus, rvs, rtaus)
+        ap, rvs, rtaus, lvs, ltaus = _wavefront_chase(
+            ap, n, w, nsweeps, max_hops, one, (rvs, rtaus, lvs, ltaus)
         )
     at = ap[pad : pad + n, pad : pad + n]
     d = jnp.diagonal(at)
@@ -345,6 +317,34 @@ def bdsqr(d: Array, e: Array, want_vectors: bool = True):
 # ---------------------------------------------------------------------------
 # Driver (src/svd.cc)
 # ---------------------------------------------------------------------------
+
+
+def svd_staged(a: Array, want_vectors: bool = True, nb: int = _SVD_NB):
+    """svd with each phase as its own XLA program (cf. eig.heev_staged:
+    one fused program for ge2tb | tb2bd | solve exceeds the TPU runtime's
+    per-program ceiling near n = 8192, while each phase alone is fine)."""
+    m, n = a.shape
+    if m < n:
+        if not want_vectors:
+            return svd_staged(jnp.conj(a).T, False, nb)
+        u, s, vh = svd_staged(jnp.conj(a).T, True, nb)
+        return jnp.conj(vh).T, s, jnp.conj(u).T
+    f1 = jax.jit(ge2tb, static_argnums=1)(a, nb)
+    band = f1.band[:n, :n]
+    d, e, f2, pu, pv = jax.jit(tb2bd, static_argnums=1)(band, nb)
+    if not want_vectors:
+        return jax.jit(bdsqr, static_argnums=2)(d, e, False)
+    from .eig import _chase_sweep_apply
+
+    s, ub, vb = jax.jit(bdsqr)(d, e)
+    dtype = a.dtype
+    apply = jax.jit(_chase_sweep_apply, static_argnums=(3, 4, 5))
+    u = apply(f2.lvs, f2.ltaus, pu[:, None] * ub.astype(dtype), n, nb, False)
+    u_full = jnp.zeros((m, n), dtype).at[:n].set(u)
+    u_full = jax.jit(unmbr_ge2tb_u)(f1, u_full)
+    v = apply(f2.rvs, f2.rtaus, pv[:, None] * vb.astype(dtype), n, nb, False)
+    v = jax.jit(unmbr_ge2tb_v)(f1, v)
+    return u_full, s, jnp.conj(v).T
 
 
 def svd_array(

@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.matmul import matmul
+
+PRECISE = lax.Precision.HIGHEST
 
 
 def _wilkinson_shift(a, b, c):
@@ -133,10 +134,21 @@ def _steqr_impl(d, e, z: Optional[jax.Array], max_sweeps: int):
     d, e, zz, iters = lax.while_loop(cond, sweep, (d, e, zz, jnp.zeros((), jnp.int32)))
     return d, zz, iters
 
+_STERF_QR_MAX = 256  # above this, QR iteration's serial rotations lose
+
+
 def sterf(d: jax.Array, e: jax.Array, max_sweeps: Optional[int] = None) -> jax.Array:
     """Eigenvalues of the symmetric tridiagonal (d, e) — slate::sterf
-    (QR iteration, no vectors). Returns ascending eigenvalues."""
+    (no vectors). Returns ascending eigenvalues.
+
+    Algorithm choice is a TPU design inversion: small problems run the
+    classic implicit-shift QR iteration (the reference's Pal-Walker-Kahan
+    path); past _STERF_QR_MAX the O(n^2) sequential scalar rotations are
+    latency-bound on the accelerator, so values route to the boundary-row
+    divide & conquer (stedc_vals) whose work is batched."""
     n = d.shape[0]
+    if n > _STERF_QR_MAX and max_sweeps is None:
+        return stedc_vals(d, e)
     ms = max_sweeps if max_sweeps is not None else 30 * n
     w, _, _ = _steqr_impl(d, e, None, ms)
     return jnp.sort(w)
@@ -243,20 +255,23 @@ def _secular_merge(d: jax.Array, z: jax.Array, rho, bisect_iters: int = 70):
     idxs = jnp.arange(n)
 
     # interval of root k: (d_k, next active d) for rho>0, (prev, d_k) rho<0;
-    # outermost root capped by the |rho|*||z||^2 bound
-    if pos:
-        nxt_i = jnp.int32(
-            _suffix_next(idxs.astype(dtype), active, jnp.asarray(n - 1, dtype))
-        )
-        has_nbr = _suffix_next(d, active, big) < big
-        gap = jnp.where(has_nbr, d[nxt_i] - d, absrho * znorm2 + tol)
-    else:
-        prv_i = jnp.int32(
-            _prefix_prev(idxs.astype(dtype), active, jnp.asarray(0, dtype))
-        )
-        has_nbr = _prefix_prev(d, active, -big) > -big
-        gap = jnp.where(has_nbr, d[prv_i] - d, -(absrho * znorm2 + tol))
-    nbr_i = nxt_i if pos else prv_i
+    # outermost root capped by the |rho|*||z||^2 bound.  rho's sign is a
+    # traced value (it is an off-diagonal of the tridiagonal), so both
+    # orientations are computed and selected with where — keeps the whole
+    # merge jittable (stedc under jit; northstar_sweep heev driver).
+    nxt_i = jnp.int32(
+        _suffix_next(idxs.astype(dtype), active, jnp.asarray(n - 1, dtype))
+    )
+    has_nxt = _suffix_next(d, active, big) < big
+    gap_p = jnp.where(has_nxt, d[nxt_i] - d, absrho * znorm2 + tol)
+    prv_i = jnp.int32(
+        _prefix_prev(idxs.astype(dtype), active, jnp.asarray(0, dtype))
+    )
+    has_prv = _prefix_prev(d, active, -big) > -big
+    gap_m = jnp.where(has_prv, d[prv_i] - d, -(absrho * znorm2 + tol))
+    has_nbr = jnp.where(pos, has_nxt, has_prv)
+    gap = jnp.where(pos, gap_p, gap_m)
+    nbr_i = jnp.where(pos, nxt_i, prv_i)
 
     # --- nearest-pole anchoring (laed4): decide the root's half-interval by
     # the secular sign at the midpoint, anchor mu at the closer pole so the
@@ -277,19 +292,19 @@ def _secular_merge(d: jax.Array, z: jax.Array, rho, bisect_iters: int = 70):
     aidx = jnp.where(use_nbr, nbr_i, self_i)
     # mu bracket in anchored coordinates (mu = lambda - d[aidx])
     half = gap * 0.5
-    if pos:
-        lo0 = jnp.where(use_nbr, half - gap, 0.0)  # (-gap/2, 0)
-        hi0 = jnp.where(use_nbr, 0.0, jnp.where(has_nbr, half, gap))
-    else:
-        lo0 = jnp.where(use_nbr, 0.0, jnp.where(has_nbr, half, gap))
-        hi0 = jnp.where(use_nbr, half - gap, 0.0)
-        lo0, hi0 = jnp.minimum(lo0, hi0), jnp.maximum(lo0, hi0)
+    lo0_p = jnp.where(use_nbr, half - gap, 0.0)  # (-gap/2, 0)
+    hi0_p = jnp.where(use_nbr, 0.0, jnp.where(has_nbr, half, gap))
+    lo0_m = jnp.where(use_nbr, 0.0, jnp.where(has_nbr, half, gap))
+    hi0_m = jnp.where(use_nbr, half - gap, 0.0)
+    lo0_m, hi0_m = jnp.minimum(lo0_m, hi0_m), jnp.maximum(lo0_m, hi0_m)
+    lo0 = jnp.where(pos, lo0_p, lo0_m)
+    hi0 = jnp.where(pos, hi0_p, hi0_m)
 
     def bis_body(_, carry):
         lo, hi = carry
         mid = 0.5 * (lo + hi)
         fm = f_at(aidx, mid)
-        go_right = (fm < 0) if pos else (fm > 0)
+        go_right = jnp.where(pos, fm < 0, fm > 0)
         lo = jnp.where(go_right, mid, lo)
         hi = jnp.where(go_right, hi, mid)
         return lo, hi
@@ -363,27 +378,101 @@ _DC_SMALL = 32  # base-case size (reference stedc small-problem cutoff)
 
 def stedc(d: jax.Array, e: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Divide & conquer tridiagonal eigensolver (src/stedc.cc chain).
-    Returns (w ascending, Z).  The merge matmul Q = (Q1 (+) Q2) V runs on
-    the MXU — this is the TPU-preferred vector path (MethodEig::DC default,
-    heev.cc:154)."""
+    Returns (w ascending, Z).
+
+    Level-wise batched tree: the input is padded to N = 2^L * _DC_SMALL
+    with a decoupled block of pad eigenvalues (4 * ||T|| on the diagonal,
+    zero coupling — exact, sorts after every real eigenvalue), the 2^L
+    base problems are one vmapped steqr, and every merge LEVEL is one
+    vmapped secular solve + one batched assembly matmul on the MXU.  The
+    compiled program is O(log n) kernels — the reference's recursive task
+    tree (stedc.cc) would otherwise inline O(n/nb) distinct merges, whose
+    program size is what crashed the TPU runtime at n = 8192 in round 2's
+    first sweep."""
+    w, q, _, _ = _stedc_levels(d, e, want_q=True)
+    return w, q
+
+
+def stedc_vals(d: jax.Array, e: jax.Array) -> jax.Array:
+    """Values-only divide & conquer: the same batched merge tree as stedc,
+    but each subproblem carries only (w, Q[0, :], Q[-1, :]) — the boundary
+    rows are all a parent merge consumes (its z-vector) or produces.  The
+    per-merge cost drops from the O(n^3) assembly matmul to the O(n^2)
+    secular solve + two row-vector products — unlike the QR-iteration
+    sterf, whose O(n^2) SEQUENTIAL scalar rotations are latency-bound on
+    the accelerator."""
+    w, _, _, _ = _stedc_levels(d, e, want_q=False)
+    return w
+
+
+def _stedc_levels(d, e, want_q: bool):
     n = d.shape[0]
+    dtype = d.dtype
     if n <= _DC_SMALL:
-        return steqr(d, e)
-    m = n // 2
-    rho = e[m - 1]
-    d1 = d[:m].at[m - 1].add(-rho)
-    d2 = d[m:].at[0].add(-rho)
-    w1, q1 = stedc(d1, e[: m - 1])
-    w2, q2 = stedc(d2, e[m:])
-    dd = jnp.concatenate([w1, w2])
-    z = jnp.concatenate([q1[-1, :], q2[0, :]])
-    order = jnp.argsort(dd)
-    lam_s, v_s = _secular_merge(dd[order], z[order], rho)
-    # scatter secular rows back and assemble Q = blockdiag(q1,q2) @ V
-    inv = jnp.argsort(order)
-    v = v_s[inv, :]
-    q_top = matmul(q1, v[:m, :]).astype(d.dtype)
-    q_bot = matmul(q2, v[m:, :]).astype(d.dtype)
-    q = jnp.concatenate([q_top, q_bot], axis=0)
-    ord2 = jnp.argsort(lam_s)  # lam_s already ascending up to deflation
-    return lam_s[ord2], q[:, ord2]
+        w, q = steqr(d, e)
+        return w, q, q[0, :], q[-1, :]
+    levels = max(1, -(-n // _DC_SMALL) - 1).bit_length()
+    nblk = 1 << levels
+    N = nblk * _DC_SMALL
+    # decoupled pad: e = 0 at and past the real/pad seam, diagonal at
+    # 4 * ||T||_inf-ish so pad eigenvalues sort strictly last; modest (not
+    # finfo-huge) so deflation tolerances in mixed merges stay O(eps ||T||)
+    scale = jnp.max(jnp.abs(d)) + 2 * (jnp.max(jnp.abs(e)) if n > 1 else 0) + 1
+    big = 4 * scale
+    dp = jnp.concatenate([d, jnp.full((N - n,), 1.0, dtype) * big])
+    ep = jnp.concatenate([e, jnp.zeros((N - 1 - (n - 1),), dtype)])
+    # every block seam is the rank-one coupling of exactly one merge; its
+    # rho is subtracted from the two adjacent diagonal entries up front
+    # (the recursive formulation's d1[-1] -= rho / d2[0] -= rho, flattened)
+    seams = _DC_SMALL * jnp.arange(1, nblk) - 1
+    dp = dp.at[seams].add(-ep[seams]).at[seams + 1].add(-ep[seams])
+
+    # base solves: one vmapped steqr over the 2^L blocks
+    db = dp.reshape(nblk, _DC_SMALL)
+    eb = jnp.concatenate([ep, jnp.zeros((1,), dtype)]).reshape(nblk, _DC_SMALL)
+    eb = eb[:, : _DC_SMALL - 1]
+    w, q = jax.vmap(steqr)(db, eb)
+    top = q[:, 0, :]
+    bot = q[:, -1, :]
+    if not want_q:
+        q = None
+
+    s = _DC_SMALL
+    while s < N:
+        m = N // (2 * s)
+        rho = ep[(2 * jnp.arange(m) + 1) * s - 1]
+        dd = w.reshape(m, 2 * s)
+        z = jnp.concatenate([bot[0::2], top[1::2]], axis=1)
+        order = jnp.argsort(dd, axis=1)
+        dd_s = jnp.take_along_axis(dd, order, axis=1)
+        z_s = jnp.take_along_axis(z, order, axis=1)
+        lam, v_s = jax.vmap(_secular_merge)(dd_s, z_s, rho)
+        inv = jnp.argsort(order, axis=1)
+        v = jnp.take_along_axis(v_s, inv[:, :, None], axis=1)  # child row order
+        ord2 = jnp.argsort(lam, axis=1)
+        lam = jnp.take_along_axis(lam, ord2, axis=1)
+        v = jnp.take_along_axis(v, ord2[:, None, :], axis=2)
+        if want_q:
+            q_top = jnp.einsum(
+                "mij,mjk->mik", q[0::2], v[:, :s, :], precision=PRECISE
+            )
+            q_bot = jnp.einsum(
+                "mij,mjk->mik", q[1::2], v[:, s:, :], precision=PRECISE
+            )
+            q = jnp.concatenate([q_top, q_bot], axis=1).astype(dtype)
+            top = q[:, 0, :]
+            bot = q[:, -1, :]
+        else:
+            top = jnp.einsum(
+                "mj,mjk->mk", top[0::2], v[:, :s, :], precision=PRECISE
+            ).astype(dtype)
+            bot = jnp.einsum(
+                "mj,mjk->mk", bot[1::2], v[:, s:, :], precision=PRECISE
+            ).astype(dtype)
+        w = lam
+        s *= 2
+
+    wv = w.reshape(N)[:n]
+    if want_q:
+        return wv, q[0][:n, :n], None, None
+    return wv, None, None, None

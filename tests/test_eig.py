@@ -21,7 +21,9 @@ def _tridiag(n, seed):
     return d, e, T
 
 
-@pytest.mark.parametrize("n", [1, 2, 5, 60])
+# n=300 exercises the values-only D&C branch (sterf routes past
+# _STERF_QR_MAX to stedc_vals; the QR-iteration branch covers the rest)
+@pytest.mark.parametrize("n", [1, 2, 5, 60, 300])
 def test_sterf(n):
     d, e, T = _tridiag(n, 1)
     w = np.asarray(sterf(jnp.asarray(d), jnp.asarray(e)))
@@ -169,3 +171,19 @@ def test_gtsv_pivoting():
     x, info = gtsv_array(jnp.asarray(dl), jnp.asarray(d), jnp.asarray(du), jnp.asarray(b))
     assert int(info) == 0
     assert np.abs(T @ np.asarray(x) - b).max() < 1e-12
+
+
+def test_heev_staged_matches_fused():
+    from slate_tpu.linalg.eig import heev_staged
+
+    n = 70
+    a = np.asarray(generate("randn", n, n, np.float64, seed=13))
+    a = (a + a.T) / 2
+    w, z = heev_staged(jnp.asarray(a), nb=16)
+    w, z = np.asarray(w), np.asarray(z)
+    wref = np.linalg.eigvalsh(a)
+    assert np.abs(w - wref).max() < 1e-12 * max(1, np.abs(wref).max()) * n
+    assert np.abs(a @ z - z * w).max() < 1e-12 * n
+    assert np.abs(z.T @ z - np.eye(n)).max() < 1e-12 * n
+    wv = np.asarray(heev_staged(jnp.asarray(a), want_vectors=False, nb=16))
+    assert np.abs(np.sort(wv) - wref).max() < 1e-11 * n

@@ -232,3 +232,13 @@ def test_getrf_tntpiv_scan_solve():
     _check_lu(a, f, rtol=1e-12)
     x = np.asarray(getrs_array(f, jnp.asarray(b)))
     assert np.abs(a @ x - b).max() / np.abs(a).max() < 1e-10
+
+
+def test_getri_oop():
+    from slate_tpu.linalg import getri_oop_array
+
+    rng = np.random.default_rng(11)
+    a = rng.standard_normal((96, 96)) + 6 * np.eye(96)
+    ainv, info = getri_oop_array(jnp.asarray(a))
+    assert int(info) == 0
+    assert np.abs(a @ np.asarray(ainv) - np.eye(96)).max() < 1e-11

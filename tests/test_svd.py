@@ -70,3 +70,20 @@ def test_svd_values_only():
     a = np.asarray(generate("rands", 30, 20, np.float64, seed=5))
     s = np.asarray(svd_array(jnp.asarray(a), want_vectors=False, nb=8))
     assert np.abs(s - np.linalg.svd(a, compute_uv=False)).max() < 1e-11
+
+
+def test_svd_staged_matches_fused():
+    from slate_tpu.linalg.svd import svd_staged
+
+    rng = np.random.default_rng(21)
+    for m, n in [(80, 64), (40, 70)]:  # tall + the m<n transpose branch
+        a = rng.standard_normal((m, n))
+        u, s, vh = svd_staged(jnp.asarray(a), nb=16)
+        un, sn, vn = np.asarray(u), np.asarray(s), np.asarray(vh)
+        sref = np.linalg.svd(a, compute_uv=False)
+        k = min(m, n)
+        assert np.abs(sn - sref).max() < 1e-12 * k * max(1, sref.max())
+        assert np.abs(a - (un * sn) @ vn).max() < 1e-12 * k * max(1, sref.max())
+        assert np.abs(un.T @ un - np.eye(un.shape[1])).max() < 1e-12 * k
+        sv = np.asarray(svd_staged(jnp.asarray(a), want_vectors=False, nb=16))
+        assert np.abs(sv - sref).max() < 1e-11 * k

@@ -26,13 +26,17 @@ CASES = [
     ("geqrf", 32768, 900),
     ("geqrf", 16384, 600),
     ("gemm_f32", 16384, 600),
-    # eig/svd stage 2 (hb2st/tb2bd) is a serial bulge chase — O(n^2 w)
-    # sequential window updates; n=8192 crashed the TPU worker after hours
-    # of chase, and svd at 1024 reproducibly faults it.  These are the
-    # honest currently-demonstrated on-chip sizes; the wavefront-pipelined
-    # chase (reference P7) is the path to 8192.
-    ("heev", 1024, 1800),
-    ("svd", 512, 1800),
+    # eig/svd stage 2 (hb2st/tb2bd) is the wavefront-pipelined chase
+    # (reference P7): ~4n batched gather/update/scatter steps, lifting the
+    # demonstrated on-chip sizes from round 1's (1024, 512) to 4096 for
+    # both.  8192 is attempted but currently faults the axon TPU worker
+    # AFTER hb2st completes (every stage passes in isolation, flaky
+    # device-state corruption; each phase also passes on the 8-device CPU
+    # backend) — kept as an honest ok:false row.
+    ("heev", 8192, 3600),
+    ("heev", 4096, 1800),
+    ("svd", 4096, 3600),
+    ("svd", 2048, 1800),
 ]
 
 CHILD = r"""
@@ -102,11 +106,13 @@ elif routine == "gemm_f32":
     t1 = time.perf_counter()
     emit(t1 - t0, 2 * n**3 / (t1 - t0) / 1e9, f"sum={{v:.3e}}", np.isfinite(v))
 elif routine == "heev":
-    from slate_tpu.linalg.eig import heev_array
+    # staged driver: one XLA program per phase (a single fused program
+    # for all phases faults the TPU runtime near n = 8192)
+    from slate_tpu.linalg.eig import heev_staged
     g = jax.random.normal(key, (n, n), jnp.float32)
     a = (g + g.T) / 2
     del g
-    f = jax.jit(lambda x: heev_array(x, want_vectors=False))
+    f = lambda x: heev_staged(x, want_vectors=False)
     t0 = time.perf_counter()
     w = f(a)
     wmax = float(jnp.abs(w).max())
@@ -115,9 +121,9 @@ elif routine == "heev":
     ok = np.isfinite(wmax) and abs(wmax / (2 * np.sqrt(n) * np.sqrt(0.5)) - 1) < 0.2
     emit(t1 - t0, 4 / 3 * n**3 / (t1 - t0) / 1e9, f"wmax={{wmax:.3e}}", ok)
 elif routine == "svd":
-    from slate_tpu.linalg.svd import svd_array
+    from slate_tpu.linalg.svd import svd_staged
     a = jax.random.normal(key, (n, n), jnp.float32)
-    f = jax.jit(lambda x: svd_array(x, want_vectors=False))
+    f = lambda x: svd_staged(x, want_vectors=False)
     t0 = time.perf_counter()
     s = f(a)
     smax = float(s.max())
@@ -129,8 +135,19 @@ elif routine == "svd":
 
 def main():
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    only = None
+    if len(sys.argv) > 2 and sys.argv[1] == "--only":
+        only = set(sys.argv[2].split(","))
+    out = os.path.join(root, "SWEEP_r02.json")
     results = []
+    if only and os.path.exists(out):
+        with open(out) as f:  # keep other routines' existing rows
+            results = [
+                r for r in json.load(f)["results"] if r["routine"] not in only
+            ]
     for routine, n, tmo in CASES:
+        if only and routine not in only:
+            continue
         code = CHILD.format(root=root, routine=routine, n=n)
         try:
             proc = subprocess.run(
@@ -148,7 +165,6 @@ def main():
             results.append({"routine": routine, "n": n, "ok": False,
                             "error": f"timeout>{tmo}s"})
         print(json.dumps(results[-1]), flush=True)
-    out = os.path.join(root, "SWEEP_r02.json")
     with open(out, "w") as f:
         json.dump({"chip": "TPU v5e (1 chip, via tunnel)", "results": results}, f,
                   indent=1)
