@@ -127,6 +127,40 @@ def bench_getrf():
     return 2.0 * N**3 / 3.0 / t / 1e9
 
 
+# f64 factorizations at reduced n: the library's matmul() now routes f64
+# through the Ozaki int8 path (ops/matmul.py dispatch), so DPOTRF/DGETRF
+# run at the split-GEMM rate instead of XLA's f32-pair emulation.  n=4096
+# keeps the tunnel's remote-compile time bounded (the recursion instantiates
+# every Ozaki shape once; measured ~4 min at n=2048).
+N_F64 = 4096
+
+
+def bench_potrf_f64(emulated=False):
+    from slate_tpu.linalg.chol import potrf_array
+    from slate_tpu.ops.matmul import f64_emulation
+
+    n = N_F64
+    g = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.float64)
+    a = (g @ g.T) / n + 2 * jnp.eye(n, dtype=jnp.float64)
+    import contextlib
+
+    ctx = f64_emulation() if emulated else contextlib.nullcontext()
+    with ctx:
+        run = jax.jit(lambda x: jnp.sum(jnp.abs(jnp.diagonal(potrf_array(x)[0]))))
+        t = _timeit(run, a)
+    return n**3 / 3.0 / t / 1e9
+
+
+def bench_getrf_f64():
+    from slate_tpu.linalg.lu import getrf_array
+
+    n = N_F64
+    m = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float64) / 64
+    run = jax.jit(lambda x: jnp.sum(jnp.abs(jnp.diagonal(getrf_array(x).lu))))
+    t = _timeit(run, m)
+    return 2.0 * n**3 / 3.0 / t / 1e9
+
+
 def main():
     from slate_tpu.ops.ozaki import matmul_f64
 
@@ -153,6 +187,9 @@ def main():
         ("gemm_f32_gflops", lambda: bench_gemm(jnp.float32, 32)),
         ("potrf_f32_gflops", bench_potrf),
         ("getrf_f32_gflops", bench_getrf),
+        (f"potrf_f64_gflops_n{N_F64}", bench_potrf_f64),
+        (f"getrf_f64_gflops_n{N_F64}", bench_getrf_f64),
+        (f"potrf_f64_emulated_gflops_n{N_F64}", lambda: bench_potrf_f64(emulated=True)),
     ]:
         _progress(f"extra: {name}")
         try:
@@ -163,6 +200,10 @@ def main():
             _progress(f"extra: {name} failed: {e!r:.200}")
     if isinstance(extras.get("gemm_bf16_gflops"), float):
         extras["bf16_mfu_vs_peak"] = round(extras["gemm_bf16_gflops"] / V5E_BF16_PEAK, 3)
+    po, pe = extras.get(f"potrf_f64_gflops_n{N_F64}"), extras.get(
+        f"potrf_f64_emulated_gflops_n{N_F64}")
+    if isinstance(po, float) and isinstance(pe, float) and pe > 0:
+        extras["potrf_f64_ozaki_vs_emulated"] = round(po / pe, 2)
     if isinstance(extras.get("gemm_int8_gops"), float):
         extras["int8_mfu_vs_peak"] = round(extras["gemm_int8_gops"] / V5E_INT8_PEAK, 3)
         # f64-via-int8 hardware ceiling: int8 attainable / 45 unit-GEMMs

@@ -24,38 +24,45 @@ ArrayLike = Union[Array, BaseMatrix]
 # -- multiply family (simplified_api.hh: multiply / triangular_multiply ...) --
 
 
-def multiply(alpha, a: ArrayLike, b: ArrayLike, beta=0.0, c: Optional[ArrayLike] = None):
-    """C = alpha A B + beta C (slate::multiply -> gemm)."""
+def multiply(alpha, a: ArrayLike, b: ArrayLike, beta=0.0, c: Optional[ArrayLike] = None,
+             opts: Optional[Options] = None):
+    """C = alpha A B + beta C (slate::multiply -> gemm).  Option.Precision
+    in ``opts`` selects the accumulation tier (types.Precision)."""
     if c is None:
         am, bm = blas3._arr(a), blas3._arr(b)
         c = jnp.zeros((am.shape[0], bm.shape[1]), am.dtype)
-    return blas3.gemm(alpha, a, b, beta, c)
+    return blas3.gemm(alpha, a, b, beta, c, opts=opts)
 
 
-def hermitian_multiply(side: Side, alpha, a: ArrayLike, b: ArrayLike, beta=0.0, c=None):
+def hermitian_multiply(side: Side, alpha, a: ArrayLike, b: ArrayLike, beta=0.0, c=None,
+                       opts: Optional[Options] = None):
     if c is None:
         bm = blas3._arr(b)
         c = jnp.zeros_like(bm)
-    return blas3.hemm(side, alpha, a, b, beta, c)
+    return blas3.hemm(side, alpha, a, b, beta, c, opts=opts)
 
 
-def symmetric_multiply(side: Side, alpha, a: ArrayLike, b: ArrayLike, beta=0.0, c=None):
+def symmetric_multiply(side: Side, alpha, a: ArrayLike, b: ArrayLike, beta=0.0, c=None,
+                       opts: Optional[Options] = None):
     if c is None:
         bm = blas3._arr(b)
         c = jnp.zeros_like(bm)
-    return blas3.symm(side, alpha, a, b, beta, c)
+    return blas3.symm(side, alpha, a, b, beta, c, opts=opts)
 
 
-def triangular_multiply(side: Side, alpha, a: ArrayLike, b: ArrayLike):
-    return blas3.trmm(side, alpha, a, b)
+def triangular_multiply(side: Side, alpha, a: ArrayLike, b: ArrayLike,
+                        opts: Optional[Options] = None):
+    return blas3.trmm(side, alpha, a, b, opts=opts)
 
 
-def rank_k_update(alpha, a: ArrayLike, beta, c: ArrayLike, uplo: Optional[Uplo] = None):
-    return blas3.herk(alpha, a, beta, c, uplo)
+def rank_k_update(alpha, a: ArrayLike, beta, c: ArrayLike, uplo: Optional[Uplo] = None,
+                  opts: Optional[Options] = None):
+    return blas3.herk(alpha, a, beta, c, uplo, opts=opts)
 
 
-def rank_2k_update(alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, uplo=None):
-    return blas3.her2k(alpha, a, b, beta, c, uplo)
+def rank_2k_update(alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, uplo=None,
+                   opts: Optional[Options] = None):
+    return blas3.her2k(alpha, a, b, beta, c, uplo, opts=opts)
 
 
 def triangular_solve(side: Side, alpha, a: ArrayLike, b: ArrayLike):

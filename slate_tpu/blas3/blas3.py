@@ -41,7 +41,7 @@ from ..core.matrix import (
     tri_project,
 )
 from ..ops.matmul import matmul
-from ..types import Diag, Op, Side, SlateError, Uplo
+from ..types import Diag, Op, Option, Options, Precision, Side, SlateError, Uplo, get_option
 
 ArrayLike = Union[jax.Array, BaseMatrix]
 
@@ -51,6 +51,21 @@ _NB = 256
 
 def _arr(x: ArrayLike) -> jax.Array:
     return x.array if isinstance(x, BaseMatrix) else jnp.asarray(x)
+
+
+def _mul_prec(opts: Optional[Options], *operands: jax.Array) -> Precision:
+    """Precision tier for multiply-class drivers (gemm/hemm/trmm/...).
+
+    Default: Fast (native MXU) for f32/bf16 data, Highest for f64/complex —
+    matching the reference's vendor-native SGEMM speed while keeping full
+    accuracy where the dtype demands it.  Option.Precision overrides."""
+    p = get_option(opts, Option.Precision, None) if opts else None
+    if p is not None:
+        return p
+    dt = jnp.result_type(*(o.dtype for o in operands))
+    if dt in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        return Precision.Fast
+    return Precision.Highest
 
 
 def _wrap_like(c: ArrayLike, data: jax.Array):
@@ -68,49 +83,58 @@ def _wrap_like(c: ArrayLike, data: jax.Array):
 # ---------------------------------------------------------------------------
 
 
-def gemm_array(alpha, a: jax.Array, b: jax.Array, beta, c: jax.Array) -> jax.Array:
+def gemm_array(
+    alpha, a: jax.Array, b: jax.Array, beta, c: jax.Array,
+    precision: Optional[Precision] = None,
+) -> jax.Array:
     """C := alpha*A@B + beta*C on plain arrays."""
-    ab = matmul(a, b)
+    ab = matmul(a, b, precision=precision)
     return alpha * ab.astype(c.dtype) + beta * c
 
 
-def gemm(alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike):
+def gemm(alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, opts: Optional[Options] = None):
     """slate::gemm (src/gemm.cc:72). Method selection (gemmA vs gemmC,
     method.hh:35-45) is a scheduling choice the XLA partitioner makes from
     shardings; semantics are identical, so one entry point suffices."""
-    return _wrap_like(c, gemm_array(alpha, _arr(a), _arr(b), beta, _arr(c)))
+    aa, bb = _arr(a), _arr(b)
+    return _wrap_like(c, gemm_array(alpha, aa, bb, beta, _arr(c), precision=_mul_prec(opts, aa, bb)))
 
 
-def _side_mul(side: Side, alpha, afull: jax.Array, b: jax.Array, beta, c: jax.Array) -> jax.Array:
-    prod = matmul(afull, b) if side == Side.Left else matmul(b, afull)
+def _side_mul(
+    side: Side, alpha, afull: jax.Array, b: jax.Array, beta, c: jax.Array,
+    precision: Optional[Precision] = None,
+) -> jax.Array:
+    prod = matmul(afull, b, precision=precision) if side == Side.Left else matmul(b, afull, precision=precision)
     return alpha * prod.astype(c.dtype) + beta * c
 
 
-def hemm(side: Side, alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike):
+def hemm(side: Side, alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, opts: Optional[Options] = None):
     """slate::hemm (src/hemm.cc): C := alpha*A*B + beta*C, A Hermitian."""
     am = a if isinstance(a, BaseMatrix) else HermitianMatrix.from_array(a, Uplo.Lower)
     afull = symmetrize(am.data, am.uplo, conj=True)
-    return _wrap_like(c, _side_mul(side, alpha, afull, _arr(b), beta, _arr(c)))
+    bb = _arr(b)
+    return _wrap_like(c, _side_mul(side, alpha, afull, bb, beta, _arr(c), precision=_mul_prec(opts, afull, bb)))
 
 
-def symm(side: Side, alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike):
+def symm(side: Side, alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, opts: Optional[Options] = None):
     """slate::symm (src/symm.cc): A symmetric (not conjugated)."""
     am = a if isinstance(a, BaseMatrix) else SymmetricMatrix.from_array(a, Uplo.Lower)
     afull = symmetrize(am.data, am.uplo, conj=False)
-    return _wrap_like(c, _side_mul(side, alpha, afull, _arr(b), beta, _arr(c)))
+    bb = _arr(b)
+    return _wrap_like(c, _side_mul(side, alpha, afull, bb, beta, _arr(c), precision=_mul_prec(opts, afull, bb)))
 
 
-def _rank_k_update(alpha, a: jax.Array, beta, c: ArrayLike, uplo: Uplo, conj: bool, two_sided_b: Optional[jax.Array] = None):
+def _rank_k_update(alpha, a: jax.Array, beta, c: ArrayLike, uplo: Uplo, conj: bool, two_sided_b: Optional[jax.Array] = None, precision: Optional[Precision] = None):
     cm = c if isinstance(c, BaseMatrix) else None
     cdata = cm.data if cm is not None else jnp.asarray(c)
     at = jnp.conj(a).T if conj else a.T
     if two_sided_b is None:
-        upd = matmul(a, at)
+        upd = matmul(a, at, precision=precision)
         new = alpha * upd.astype(cdata.dtype)
     else:
         bt = jnp.conj(two_sided_b).T if conj else two_sided_b.T
-        upd1 = matmul(a, bt)
-        upd2 = matmul(two_sided_b, at)
+        upd1 = matmul(a, bt, precision=precision)
+        upd2 = matmul(two_sided_b, at, precision=precision)
         new = alpha * upd1.astype(cdata.dtype) + (jnp.conj(alpha) if conj else alpha) * upd2.astype(cdata.dtype)
     full = new + beta * (symmetrize(cdata, uplo, conj) if cm is not None else cdata)
     stored = tri_project(full, uplo)
@@ -125,27 +149,31 @@ def _other(uplo: Uplo) -> Uplo:
     return Uplo.Upper if uplo == Uplo.Lower else Uplo.Lower
 
 
-def herk(alpha, a: ArrayLike, beta, c: ArrayLike, uplo: Optional[Uplo] = None):
+def herk(alpha, a: ArrayLike, beta, c: ArrayLike, uplo: Optional[Uplo] = None, opts: Optional[Options] = None):
     """slate::herk (src/herk.cc): C := alpha*A*A^H + beta*C, C Hermitian."""
     u = uplo or (c.uplo if isinstance(c, BaseMatrix) else Uplo.Lower)
-    return _rank_k_update(alpha, _arr(a), beta, c, u, conj=True)
+    aa = _arr(a)
+    return _rank_k_update(alpha, aa, beta, c, u, conj=True, precision=_mul_prec(opts, aa))
 
 
-def syrk(alpha, a: ArrayLike, beta, c: ArrayLike, uplo: Optional[Uplo] = None):
+def syrk(alpha, a: ArrayLike, beta, c: ArrayLike, uplo: Optional[Uplo] = None, opts: Optional[Options] = None):
     """slate::syrk: C := alpha*A*A^T + beta*C, C symmetric."""
     u = uplo or (c.uplo if isinstance(c, BaseMatrix) else Uplo.Lower)
-    return _rank_k_update(alpha, _arr(a), beta, c, u, conj=False)
+    aa = _arr(a)
+    return _rank_k_update(alpha, aa, beta, c, u, conj=False, precision=_mul_prec(opts, aa))
 
 
-def her2k(alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, uplo: Optional[Uplo] = None):
+def her2k(alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, uplo: Optional[Uplo] = None, opts: Optional[Options] = None):
     """slate::her2k: C := alpha*A*B^H + conj(alpha)*B*A^H + beta*C."""
     u = uplo or (c.uplo if isinstance(c, BaseMatrix) else Uplo.Lower)
-    return _rank_k_update(alpha, _arr(a), beta, c, u, conj=True, two_sided_b=_arr(b))
+    aa = _arr(a)
+    return _rank_k_update(alpha, aa, beta, c, u, conj=True, two_sided_b=_arr(b), precision=_mul_prec(opts, aa))
 
 
-def syr2k(alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, uplo: Optional[Uplo] = None):
+def syr2k(alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, uplo: Optional[Uplo] = None, opts: Optional[Options] = None):
     u = uplo or (c.uplo if isinstance(c, BaseMatrix) else Uplo.Lower)
-    return _rank_k_update(alpha, _arr(a), beta, c, u, conj=False, two_sided_b=_arr(b))
+    aa = _arr(a)
+    return _rank_k_update(alpha, aa, beta, c, u, conj=False, two_sided_b=_arr(b), precision=_mul_prec(opts, aa))
 
 
 # ---------------------------------------------------------------------------
@@ -158,7 +186,8 @@ def _tri_full(a: jax.Array, uplo: Uplo, diag: Diag) -> jax.Array:
 
 
 def trmm_array(
-    side: Side, uplo: Uplo, op: Op, diag: Diag, alpha, a: jax.Array, b: jax.Array
+    side: Side, uplo: Uplo, op: Op, diag: Diag, alpha, a: jax.Array, b: jax.Array,
+    precision: Optional[Precision] = None,
 ) -> jax.Array:
     """B := alpha * op(A) * B (or B*op(A)), A triangular (src/trmm.cc)."""
     t = _tri_full(a, uplo, diag)
@@ -166,13 +195,14 @@ def trmm_array(
         t = t.T
     elif op == Op.ConjTrans:
         t = jnp.conj(t).T
-    prod = matmul(t, b) if side == Side.Left else matmul(b, t)
+    prod = matmul(t, b, precision=precision) if side == Side.Left else matmul(b, t, precision=precision)
     return alpha * prod.astype(b.dtype)
 
 
-def trmm(side: Side, alpha, a: ArrayLike, b: ArrayLike):
+def trmm(side: Side, alpha, a: ArrayLike, b: ArrayLike, opts: Optional[Options] = None):
     am = a if isinstance(a, BaseMatrix) else TriangularMatrix.from_array(a, Uplo.Lower)
-    out = trmm_array(side, am.uplo, am.op, am.diag, alpha, am.data, _arr(b))
+    bb = _arr(b)
+    out = trmm_array(side, am.uplo, am.op, am.diag, alpha, am.data, bb, precision=_mul_prec(opts, am.data, bb))
     return _wrap_like(b, out)
 
 
@@ -250,15 +280,16 @@ def trsm(side: Side, alpha, a: ArrayLike, b: ArrayLike):
 # ---------------------------------------------------------------------------
 
 
-def gbmm(alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike):
+def gbmm(alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, opts: Optional[Options] = None):
     """slate::gbmm: general band * dense. Band stored dense-masked; XLA sees
     the zero pattern only through (kl, ku) metadata at the driver level."""
     am = a if isinstance(a, BaseMatrix) else None
     ad = band_project(_arr(a), am.kl, am.ku) if am is not None and am.kl is not None else _arr(a)
-    return _wrap_like(c, gemm_array(alpha, ad, _arr(b), beta, _arr(c)))
+    bb = _arr(b)
+    return _wrap_like(c, gemm_array(alpha, ad, bb, beta, _arr(c), precision=_mul_prec(opts, ad, bb)))
 
 
-def hbmm(side: Side, alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike):
+def hbmm(side: Side, alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, opts: Optional[Options] = None):
     """slate::hbmm: Hermitian band * dense."""
     am = a if isinstance(a, BaseMatrix) else None
     if am is not None and am.kl is not None:
@@ -267,7 +298,8 @@ def hbmm(side: Side, alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike):
         afull = symmetrize(stored, am.uplo, conj=True)
     else:
         afull = symmetrize(_arr(a), Uplo.Lower, conj=True)
-    return _wrap_like(c, _side_mul(side, alpha, afull, _arr(b), beta, _arr(c)))
+    bb = _arr(b)
+    return _wrap_like(c, _side_mul(side, alpha, afull, bb, beta, _arr(c), precision=_mul_prec(opts, afull, bb)))
 
 
 def tbsm(side: Side, alpha, a: ArrayLike, b: ArrayLike, pivots: Optional[jax.Array] = None):

@@ -220,8 +220,16 @@ def _wavefront_chase(ap, n, w, nsweeps, max_hops, one, facs):
         )(b0)
         idx0 = jnp.where(t == 0, w - 1, 0)
         blocks, *vals = jax.vmap(one)(blocks, idx0, nact)
-        idx = b0[:, None] + jnp.arange(w3)[None, :]
-        ap = ap.at[idx[:, :, None], idx[:, None, :]].set(blocks)
+
+        # write-back: per-slot dynamic_update_slice (blocks on a wavefront
+        # are disjoint; idle slots all rewrite the identical dummy block at
+        # [0, 3w)).  A single giant 2D scatter here kernel-faulted the TPU
+        # runtime at n = 8192 (round-3 finding) — the slot loop lowers to
+        # plain aliased in-place updates instead.
+        def put(i, ap):
+            return lax.dynamic_update_slice(ap, blocks[i], (b0[i], b0[i]))
+
+        ap = lax.fori_loop(0, k_slots, put, ap)
         jw = jnp.where(valid, j, fs[0].shape[0])  # out-of-bounds -> dropped
         tw = jnp.where(valid, t, 0)
         fs = [f.at[jw, tw].set(v, mode="drop") for f, v in zip(fs, vals)]

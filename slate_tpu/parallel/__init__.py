@@ -6,7 +6,12 @@ from .mesh import COL_AXIS, ROW_AXIS, make_mesh, mesh_shape, replicated, tile_sh
 from .dist import DistMatrix, empty_like, from_dense, padded_tiles, redistribute, to_dense
 from .summa import gemm_summa
 from .dist_chol import potrf_dist
-from .dist_lu import getrf_nopiv_dist, getrf_tntpiv_dist, permute_rows_dist
+from .dist_lu import (
+    getrf_nopiv_dist,
+    getrf_pp_dist,
+    getrf_tntpiv_dist,
+    permute_rows_dist,
+)
 from .dist_trsm import trsm_dist, trsm_dist_right
 from .dist_qr import DistQR, geqrf_dist, unmqr_dist
 from .dist_aux import herk_dist, norm_dist
@@ -21,9 +26,11 @@ from .dist_twostage import (
 from .drivers import (
     gemm_mesh,
     gesv_nopiv_mesh,
+    gesv_mesh,
     gesv_tntpiv_mesh,
     gels_mesh,
     geqrf_mesh,
+    getrf_mesh,
     getrf_nopiv_mesh,
     getrf_tntpiv_mesh,
     heev_mesh,
@@ -48,6 +55,7 @@ __all__ = [
     "gemm_summa",
     "potrf_dist",
     "getrf_nopiv_dist",
+    "getrf_pp_dist",
     "getrf_tntpiv_dist",
     "permute_rows_dist",
     "trsm_dist",
@@ -61,7 +69,9 @@ __all__ = [
     "geqrf_mesh",
     "gemm_mesh",
     "gesv_nopiv_mesh",
+    "gesv_mesh",
     "gesv_tntpiv_mesh",
+    "getrf_mesh",
     "getrf_nopiv_mesh",
     "getrf_tntpiv_mesh",
     "posv_mesh",

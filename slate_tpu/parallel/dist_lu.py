@@ -63,32 +63,41 @@ def getrf_nopiv_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array]:
     ), info
 
 
-def _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c, roff=0, coff=0):
+def _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c, roff=0, coff=0, panel_done=False):
     """One right-looking LU tile step (panel solves + bcasts + trailing
     gemm) on the swapped/unswapped local stack.  Shared by the no-pivot
     and tournament kernels; ``roff``/``coff`` shift tile indexing when
-    ``t_loc`` is a trailing view (bucketed caller)."""
+    ``t_loc`` is a trailing view (bucketed caller).  ``panel_done`` skips
+    the diag-tile factor + column solve: the partial-pivot kernel factors
+    the whole panel column itself (internal_getrf.cc's role), leaving only
+    the row solve + trailing update here."""
     nb = t_loc.shape[2]
     dtype = t_loc.dtype
     eye = jnp.eye(nb, dtype=dtype)
     kr, kc = k // p - roff, k // q - coff
-    dtile = bcast_diag_tile(t_loc, k, p, q, nb, roff, coff)
-    luk = _getrf_nopiv_rec(dtile)  # packed L\U, unit L diag implicit
-    ukk = jnp.triu(luk)
-
-    # panel column: L[i,k] = A[i,k] U_kk^{-1}  (i > k)
-    pcol = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)[:, 0]
-    lsolved = lax.linalg.triangular_solve(
-        jnp.broadcast_to(ukk, pcol.shape), pcol,
-        left_side=False, lower=False, transpose_a=False,
-    )
-    below = (i_log > k)[:, None, None]
-    on_d = (i_log == k)[:, None, None]
-    newcol = jnp.where(below, lsolved, jnp.where(on_d, luk, pcol))
     mine_c = (c == k % q)
-    t_loc = lax.dynamic_update_slice_in_dim(
-        t_loc, jnp.where(mine_c, newcol, pcol)[:, None], kc, axis=1
-    )
+    below = (i_log > k)[:, None, None]
+    if panel_done:
+        # diag tile already holds packed L\U from the panel factor
+        luk = bcast_diag_tile(t_loc, k, p, q, nb, roff, coff)
+        pcol = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)[:, 0]
+        newcol = pcol
+    else:
+        dtile = bcast_diag_tile(t_loc, k, p, q, nb, roff, coff)
+        luk = _getrf_nopiv_rec(dtile)  # packed L\U, unit L diag implicit
+        ukk = jnp.triu(luk)
+
+        # panel column: L[i,k] = A[i,k] U_kk^{-1}  (i > k)
+        pcol = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)[:, 0]
+        lsolved = lax.linalg.triangular_solve(
+            jnp.broadcast_to(ukk, pcol.shape), pcol,
+            left_side=False, lower=False, transpose_a=False,
+        )
+        on_d = (i_log == k)[:, None, None]
+        newcol = jnp.where(below, lsolved, jnp.where(on_d, luk, pcol))
+        t_loc = lax.dynamic_update_slice_in_dim(
+            t_loc, jnp.where(mine_c, newcol, pcol)[:, None], kc, axis=1
+        )
 
     # panel row: U[k,j] = L_kk^{-1} A[k,j]  (j > k)
     prow = lax.dynamic_slice_in_dim(t_loc, kr, 1, axis=0)[0]
@@ -284,6 +293,175 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true):
     )(at)
     # every device computes the identical replicated permutation; the
     # out-spec stacks one copy per mesh row — take the first
+    return lut, perm[0], jnp.max(info)
+
+
+# ---------------------------------------------------------------------------
+# Partial-pivot mesh LU (the reference's DEFAULT: src/getrf.cc:23-200 with
+# the panel sub-communicator of internal_getrf.cc:64-110 and the cross-rank
+# row exchanges of internal_swap.cc:136-300)
+# ---------------------------------------------------------------------------
+
+
+def getrf_pp_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array, jax.Array]:
+    """Factor P A = L U with classic partial (per-column argmax) pivoting.
+
+    TPU form of getrf.cc: the panel column block stays in its owning mesh
+    column (replicated across 'q' only as a by-product of the masked-psum
+    bcast); per panel column j the pivot search is a local argmax + one
+    all_gather of (|v|, row-id) candidates over mesh axis 'p' (the panel
+    sub-communicator's MPI max-reduce, internal_getrf.cc:64-110), the
+    in-panel row swap is one masked-psum exchange, and the elimination is
+    a local rank-1 update.  The accumulated nb transpositions then move
+    full rows across shards with the same gather/scatter collective the
+    tournament kernel uses (internal_swap.cc's role), and the step finishes
+    with the shared row-solve + trailing-gemm tail (_nopiv_step).
+
+    Returns (LU DistMatrix, perm over the padded row space, info), same
+    contract as getrf_tntpiv_dist.
+    """
+    p, q = mesh_shape(a.mesh)
+    if a.mt != a.nt:
+        raise ValueError("getrf_pp_dist needs a square tile grid")
+    a.require_diag_pad("getrf_pp_dist")
+    lut, perm, info = _pp_jit(a.tiles, a.mesh, p, q, a.nt, a.m)
+    return (
+        DistMatrix(tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True),
+        perm,
+        info,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
+def _pp_jit(at, mesh, p, q, nt, m_true):
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(t_loc):
+        mtl, ntl, nb, _ = t_loc.shape
+        dtype = t_loc.dtype
+        r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
+        mglob = nt * nb
+        flat_gids = (i_log[:, None] * nb + jnp.arange(nb)[None, :]).reshape(-1)
+        col_ids = jnp.arange(nb)
+
+        def step(k, carry):
+            t_loc, rowperm = carry
+            base = k * nb
+            kc = k // q
+
+            # ---- panel factor with per-column pivoting (getrf panel) ----
+            pcol = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)[:, 0]
+            pan = bcast_from_col(jnp.where(c == k % q, pcol, 0), k % q)
+            flat = pan.reshape(mtl * nb, nb)
+
+            def colstep(j, fc):
+                flat, piv_pos = fc
+                gcol = base + j
+                colv = flat[:, j]
+                active = (flat_gids >= gcol) & (flat_gids < m_true)
+                absv = jnp.where(active, jnp.abs(colv), -1.0)
+                li = jnp.argmax(absv)
+                lv, lgid = absv[li], flat_gids[li]
+                gv = lax.all_gather(lv, ROW_AXIS)  # (p,)
+                gg = lax.all_gather(lgid, ROW_AXIS)
+                maxv = jnp.max(gv)
+                # winner: max |v|; ties -> smallest global row (deterministic,
+                # matches the scan/recursive single-chip tie policy).  No
+                # active candidate (pad column block / gcol >= m_true):
+                # pivot on gcol itself so the identity pad stays intact.
+                piv = jnp.min(jnp.where(gv == maxv, gg, mglob))
+                piv = jnp.where(maxv < 0, gcol, jnp.minimum(piv, mglob - 1))
+                piv_pos = piv_pos.at[j].set(piv)
+
+                # in-panel cross-shard swap rows piv <-> gcol (masked psum)
+                def owner_val(g):
+                    lt = jnp.minimum((g // nb) // p, mtl - 1)
+                    own = ((g // nb) % p == r)
+                    v = flat[lt * nb + g % nb]
+                    return own, lt * nb + g % nb, jnp.where(own, v, 0)
+
+                own_p, idx_p, vp = owner_val(piv)
+                own_g, idx_g, vg = owner_val(gcol)
+                rows2 = lax.psum(jnp.stack([vp, vg]), ROW_AXIS)  # (2, nb)
+                row_piv, row_gcol = rows2[0], rows2[1]
+                flat = flat.at[idx_p].set(jnp.where(own_p, row_gcol, flat[idx_p]))
+                flat = flat.at[idx_g].set(jnp.where(own_g, row_piv, flat[idx_g]))
+
+                # eliminate below gcol: multipliers + rank-1 on cols > j
+                pivval = row_piv[j]
+                safe = jnp.where(pivval == 0, 1.0, pivval).astype(dtype)
+                belowr = flat_gids > gcol
+                mult = jnp.where(belowr, flat[:, j] / safe, 0)
+                flat = flat.at[:, j].set(jnp.where(belowr, mult, flat[:, j]))
+                urow = jnp.where(col_ids > j, row_piv, 0)
+                flat = flat - mult[:, None] * urow[None, :]
+                return flat, piv_pos
+
+            flat, piv_pos = lax.fori_loop(
+                0, nb, colstep, (flat, jnp.zeros((nb,), flat_gids.dtype))
+            )
+
+            # ---- apply the nb transpositions to the full rows (all column
+            # blocks; the panel column is overwritten below) ----
+            ident = jnp.arange(mglob)
+
+            def sim(j, sc):
+                pos2row, rp = sc
+                tgt, cur = base + j, piv_pos[j]
+                r1, r2 = pos2row[tgt], pos2row[cur]
+                pos2row = pos2row.at[tgt].set(r2).at[cur].set(r1)
+                pa_, pb_ = rp[tgt], rp[cur]
+                rp = rp.at[tgt].set(pb_).at[cur].set(pa_)
+                return pos2row, rp
+
+            pos2row, rowperm = lax.fori_loop(0, nb, sim, (ident, rowperm))
+            pos = jnp.concatenate([base + jnp.arange(nb), piv_pos])
+            slot_ok = jnp.concatenate(
+                [jnp.ones(nb, bool), piv_pos >= base + nb]
+            )
+            occ = pos2row[jnp.minimum(pos, mglob - 1)]
+            src = jnp.minimum(occ, mglob - 1)
+            src_t, src_r = src // nb, src % nb
+            own_src = (src_t % p == r) & slot_ok
+            vals = t_loc[jnp.minimum(src_t // p, mtl - 1), :, src_r, :]
+            vals = jnp.where(own_src[:, None, None], vals, 0)
+            rows_data = lax.psum(vals, ROW_AXIS)
+            dst = jnp.minimum(pos, mglob - 1)
+            dst_t, dst_r = dst // nb, dst % nb
+            own_dst = (dst_t % p == r) & slot_ok
+            dst_loc = jnp.where(own_dst, dst_t // p, mtl)  # mtl -> dropped
+            t_loc = t_loc.at[dst_loc, :, dst_r, :].set(
+                rows_data.astype(dtype), mode="drop"
+            )
+
+            # ---- write the factored panel into the owning column ----
+            newcol = flat.reshape(mtl, nb, nb)
+            pcol_now = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)[:, 0]
+            t_loc = lax.dynamic_update_slice_in_dim(
+                t_loc,
+                jnp.where(c == k % q, newcol, pcol_now)[:, None],
+                kc,
+                axis=1,
+            )
+
+            # ---- shared tail: row solve + trailing update ----
+            return (
+                _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c, panel_done=True),
+                rowperm,
+            )
+
+        rowperm0 = jnp.arange(mglob)
+        t_loc, rowperm = lax.fori_loop(0, nt, step, (t_loc, rowperm0))
+        info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
+        return t_loc, rowperm[None], info[None, None]
+
+    lut, perm, info = shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=(spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS)),
+        check_vma=False,
+    )(at)
     return lut, perm[0], jnp.max(info)
 
 

@@ -21,7 +21,12 @@ from jax.sharding import Mesh
 from ..types import Diag, Op, Uplo
 from .dist import DistMatrix, from_dense, to_dense
 from .dist_chol import potrf_dist
-from .dist_lu import getrf_nopiv_dist, getrf_tntpiv_dist, permute_rows_dist
+from .dist_lu import (
+    getrf_nopiv_dist,
+    getrf_pp_dist,
+    getrf_tntpiv_dist,
+    permute_rows_dist,
+)
 from .dist_qr import geqrf_dist, unmqr_dist
 from .dist_trsm import trsm_dist
 from .summa import gemm_summa
@@ -179,6 +184,28 @@ def gesv_tntpiv_mesh(
     """Distributed general solve with tournament pivoting
     (src/gesv.cc with MethodLU::CALU): factor, permute B, two trsm sweeps."""
     lu, perm, info = getrf_tntpiv_mesh(a, mesh, nb)
+    bd = from_dense(b, mesh, nb)
+    pb = permute_rows_dist(bd, perm)
+    y = trsm_dist(lu, pb, Uplo.Lower, Op.NoTrans, Diag.Unit)
+    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans)
+    return to_dense(x), info
+
+
+def getrf_mesh(
+    a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
+) -> Tuple[DistMatrix, jax.Array, jax.Array]:
+    """Distributed partial-pivot LU — the reference's default getrf
+    (src/getrf.cc:23-200): P A = L U with per-column argmax pivoting.
+    Returns (LU, perm over the padded row space, info)."""
+    return getrf_pp_dist(from_dense(a, mesh, nb, diag_pad_one=True))
+
+
+def gesv_mesh(
+    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
+) -> Tuple[jax.Array, jax.Array]:
+    """Distributed general solve with partial pivoting (src/gesv.cc
+    default MethodLU::PartialPiv): factor, permute B, two trsm sweeps."""
+    lu, perm, info = getrf_mesh(a, mesh, nb)
     bd = from_dense(b, mesh, nb)
     pb = permute_rows_dist(bd, perm)
     y = trsm_dist(lu, pb, Uplo.Lower, Op.NoTrans, Diag.Unit)

@@ -132,6 +132,34 @@ class MethodSVD(enum.Enum):
     DC = "DC"
 
 
+class Precision(enum.Enum):
+    """Accumulation-precision tier for BLAS-3 (Option.Precision).
+
+    The reference always runs vendor-native full-precision BLAS
+    (internal_gemm.cc:634); on TPU the MXU offers a speed/accuracy ladder,
+    so the tier is a first-class option.  Measured on v5e, n=1024 N(0,1)
+    operands, max relative error vs f64:
+
+    - ``Fast``: native MXU rate — single-pass bf16 for f32 data (~2^-8,
+      78-103 TF/s), 6-slice Ozaki for f64 (~2^-33, 1.5x Highest's rate).
+    - ``High``: 3-pass bf16x3 for f32 (~2^-16, ~43 TF/s); f64 unchanged
+      (full Ozaki — there is no meaningful middle tier on the int8 path).
+    - ``Highest``: full precision for the dtype — 6-pass bf16x9 for f32
+      (~2^-22.5, ~25 TF/s), 9-slice int8 Ozaki for f64 (true f64, ~3e-15).
+    - ``Emulated``: opt out of the int8 Ozaki f64 path entirely and use
+      XLA's f32-pair f64 emulation (~1.3 TF/s; debugging escape hatch).
+
+    Factorizations default to Highest; multiply-class drivers (gemm, hemm,
+    trmm, ...) default to Fast for f32/bf16 inputs and Highest for
+    f64/complex128 — pass Option.Precision to override either way.
+    """
+
+    Fast = "fast"
+    High = "high"
+    Highest = "highest"
+    Emulated = "emulated"
+
+
 def select_gemm_method(m: int, n: int, k: int) -> MethodGemm:
     """Heuristic from method.hh:35-45: tiny output panel -> stationary-A."""
     if n <= max(m, k) // 4:
@@ -173,6 +201,7 @@ class Option(enum.Enum):
     PrintVerbose = "print_verbose"
     PrintPrecision = "print_precision"
     Depth = "depth"  # RBT butterfly depth
+    Precision = "precision"  # BLAS-3 accumulation tier (Precision enum)
 
 
 Options = Mapping[Union[Option, str], Any]

@@ -152,12 +152,21 @@ def run_gesv_mesh(n, dtype, rng, check, grid):
     return err, t, 2 * n**3 / 3 / t / 1e9, int(info) == 0 and err < 100 * _eps(dtype)
 
 
-def run_gemm(n, dtype, rng, check):
+def run_gemm(n, dtype, rng, check, precision=None):
+    """Times the gemm driver at its default tier (Fast for f32/bf16 — the
+    native-MXU rate, matching the reference's vendor SGEMM — Highest/Ozaki
+    for f64), or at an explicit --precision tier.  The --check gate uses a
+    tier-aware tolerance: Fast is single-pass bf16 (~2^-8 relative on
+    N(0,1) data), High is bf16x3 (~2^-16), Highest is ~f32 (3-eps style)."""
     import jax.numpy as jnp
+    from slate_tpu.blas3.blas3 import _mul_prec
     from slate_tpu.ops.matmul import matmul
+    from slate_tpu.types import Precision
 
     a, b = _rand(rng, n, n, dtype), _rand(rng, n, n, dtype)
-    c, t = _time(matmul, jnp.asarray(a), jnp.asarray(b))
+    aj, bj = jnp.asarray(a), jnp.asarray(b)
+    prec = precision or _mul_prec(None, aj, bj)
+    c, t = _time(lambda x, y: matmul(x, y, precision=prec), aj, bj)
     gflops = 2 * n**3 / t / 1e9
     err = 0.0
     if check:
@@ -165,7 +174,14 @@ def run_gemm(n, dtype, rng, check):
         lhs = np.asarray(c) @ x
         rhs = a @ (b @ x)
         err = np.abs(lhs - rhs).max() / (np.abs(rhs).max() + 1e-30)
-    return err, t, gflops, err < 100 * n * _eps(dtype)
+    # documented tier tolerances (measured v5e, types.Precision docstring):
+    # input-rounding dominated for Fast/High, 3-eps style for Highest
+    tier_eps = {Precision.Fast: 2.0**-8, Precision.High: 2.0**-16}
+    if dtype in (np.float64, np.complex128):  # Ozaki dispatch dtypes only
+        tier_eps[Precision.Fast] = 2.0**-33  # 6-slice Ozaki
+        tier_eps[Precision.High] = 0.0
+    tol = max(100 * n * _eps(dtype), 16 * tier_eps.get(prec, 0.0))
+    return err, t, gflops, err < tol
 
 
 def run_potrf(n, dtype, rng, check):
@@ -310,6 +326,10 @@ def main(argv=None):
     ap.add_argument("--grid", default="",
                     help="PxQ mesh grid: run the distributed variants "
                          "(gemm/posv/gesv) over a device mesh")
+    ap.add_argument("--precision", default="",
+                    choices=["", "fast", "high", "highest", "emulated"],
+                    help="BLAS-3 accumulation tier for gemm (types.Precision); "
+                         "empty = driver default (fast for s, highest for d/z)")
     ap.add_argument("--ref", default="n", choices=["y", "n"],
                     help="also run scipy/LAPACK and report the comparison "
                          "(reference tester's ScaLAPACK ref mode)")
@@ -343,6 +363,12 @@ def main(argv=None):
                     err, t, gflops, ok = MESH_ROUTINES[routine](
                         n, dtype, rng, check, args.grid)
                     rname = routine + "@" + args.grid
+                elif routine == "gemm" and args.precision:
+                    from slate_tpu.types import Precision
+
+                    err, t, gflops, ok = run_gemm(
+                        n, dtype, rng, check, Precision(args.precision))
+                    rname = routine + ":" + args.precision
                 else:
                     err, t, gflops, ok = ROUTINES[routine](n, dtype, rng, check)
                     rname = routine

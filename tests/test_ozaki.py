@@ -48,3 +48,54 @@ def test_matmul_f64_zero_rows_and_fast_variant():
 def test_matmul_f64_rejects_f32():
     with pytest.raises(TypeError):
         matmul_f64(jnp.ones((4, 4), jnp.float32), jnp.ones((4, 4), jnp.float32))
+
+
+def test_matmul_c128_karatsuba():
+    from slate_tpu.ops.ozaki import matmul_c128
+
+    rng = np.random.default_rng(2)
+    a = rng.standard_normal((48, 96)) + 1j * rng.standard_normal((48, 96))
+    b = rng.standard_normal((96, 32)) + 1j * rng.standard_normal((96, 32))
+    c = np.asarray(matmul_c128(jnp.asarray(a), jnp.asarray(b)))
+    ref = a @ b
+    assert np.abs(c - ref).max() / np.abs(ref).max() < 1e-13
+
+
+def test_matmul_dispatch_precision_tiers(monkeypatch):
+    """matmul() routes f64/c128 through the Ozaki path when the default
+    device is a TPU, and through jnp.matmul otherwise; tiers map to XLA
+    precisions for f32."""
+    import importlib
+
+    mm = importlib.import_module("slate_tpu.ops.matmul")
+    from slate_tpu.types import Precision
+
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((32, 40))
+    b = rng.standard_normal((40, 24))
+    ref = a @ b
+    # CPU default (tests pin jax_default_device=cpu): native f64 path
+    c = np.asarray(mm.matmul(jnp.asarray(a), jnp.asarray(b)))
+    assert np.abs(c - ref).max() / np.abs(ref).max() < 1e-14
+    # force the "TPU default" branch: the Ozaki kernels are pure XLA and
+    # run (slowly) on CPU too, so the dispatch itself is testable hermetically.
+    # Shapes must clear the 256^3 size gate to route to Ozaki.
+    monkeypatch.setattr(mm, "_tpu_is_default", lambda: True)
+    monkeypatch.setattr(mm, "_use_pallas", lambda *_: False)
+    A = rng.standard_normal((256, 256))
+    B = rng.standard_normal((256, 256))
+    REF = A @ B
+    c = np.asarray(mm.matmul(jnp.asarray(A), jnp.asarray(B)))
+    assert np.abs(c - REF).max() / np.abs(REF).max() < 1e-13
+    c6 = np.asarray(mm.matmul(jnp.asarray(A), jnp.asarray(B), precision=Precision.Fast))
+    assert np.abs(c6 - REF).max() / np.abs(REF).max() < 1e-8
+    ce = np.asarray(mm.matmul(jnp.asarray(A), jnp.asarray(B), precision=Precision.Emulated))
+    assert np.abs(ce - REF).max() / np.abs(REF).max() < 1e-14
+    # below the gate: falls through to jnp.matmul even on "TPU"
+    csmall = np.asarray(mm.matmul(jnp.asarray(a), jnp.asarray(b)))
+    assert np.abs(csmall - ref).max() / np.abs(ref).max() < 1e-14
+    ac = jnp.asarray(A + 1j * A[::-1])
+    bc = jnp.asarray(B - 1j * B)
+    cc = np.asarray(mm.matmul(ac, bc))
+    refc = np.asarray(ac) @ np.asarray(bc)
+    assert np.abs(cc - refc).max() / np.abs(refc).max() < 1e-12

@@ -465,3 +465,198 @@ def test_he2hb_dist_band_structure(rng):
     wref = np.linalg.eigvalsh(np.asarray(a))
     wband = np.linalg.eigvalsh(0.5 * (band + band.T))
     assert np.abs(wref - wband).max() < 1e-11
+
+
+# ---------------------------------------------------------------------------
+# partial-pivot mesh LU (src/getrf.cc default; VERDICT r2 missing item 1)
+# ---------------------------------------------------------------------------
+
+
+def _check_pp_factor(a, lu, perm, n):
+    lud, perm = np.asarray(to_dense(lu)), np.asarray(perm)
+    l = np.tril(lud, -1) + np.eye(n)
+    u = np.triu(lud)
+    ap = np.pad(np.asarray(a), ((0, perm.shape[0] - n), (0, 0)))[perm][:n]
+    assert np.abs(ap - l @ u).max() < 1e-12
+    assert sorted(perm.tolist()) == list(range(perm.shape[0]))
+    # partial pivoting invariant: |L| <= 1 everywhere
+    assert np.abs(l).max() <= 1.0 + 1e-14
+
+
+def test_getrf_pp_mesh_factor(rng):
+    from slate_tpu.parallel import getrf_mesh
+
+    mesh = mesh24()
+    n, nb = 64, 16
+    a = _rand(rng, n, n)
+    lu, perm, info = getrf_mesh(a, mesh, nb=nb)
+    assert int(info) == 0
+    _check_pp_factor(a, lu, perm, n)
+
+
+def test_getrf_pp_mesh_matches_lapack_pivots(rng):
+    # same pivot choices as scipy's LAPACK getrf on a matrix with distinct
+    # column maxima (no ties): the mesh partial pivot IS partial pivoting
+    import scipy.linalg as sla
+    from slate_tpu.parallel import getrf_mesh
+
+    mesh = mesh22()
+    n, nb = 48, 16
+    a = np.asarray(_rand(rng, n, n))
+    lu, perm, info = getrf_mesh(jnp.asarray(a), mesh, nb=nb)
+    assert int(info) == 0
+    lud = np.asarray(to_dense(lu))
+    lu_ref, piv = sla.lu_factor(a)
+    np.testing.assert_allclose(lud[:n, :n], lu_ref, rtol=0, atol=1e-11)
+
+
+def test_gesv_pp_mesh_zero_leading_pivot(rng):
+    from slate_tpu.parallel import gesv_mesh
+
+    mesh = mesh24()
+    n, nb = 64, 16
+    a = np.asarray(_rand(rng, n, n)).copy()
+    a[0, 0] = 0.0
+    a[1, 0] = 5.0
+    b = np.asarray(_rand(rng, n, 2))
+    x, info = gesv_mesh(jnp.asarray(a), jnp.asarray(b), mesh, nb=nb)
+    x = np.asarray(x)
+    assert int(info) == 0
+    assert np.isfinite(x).all()
+    resid = np.abs(a @ x - b).max() / (np.abs(a).max() * np.abs(x).max() * n)
+    assert resid < 1e-13, resid
+
+
+def test_gesv_pp_mesh_near_singular_column(rng):
+    from slate_tpu.parallel import gesv_mesh
+
+    mesh = mesh24()
+    n, nb = 64, 16
+    a = np.asarray(_rand(rng, n, n)).copy()
+    a[:, 0] = 0.0
+    a[40, 0] = 3.0  # the single viable pivot lives deep in another shard
+    b = np.asarray(_rand(rng, n, 2))
+    x, info = gesv_mesh(jnp.asarray(a), jnp.asarray(b), mesh, nb=nb)
+    x = np.asarray(x)
+    assert int(info) == 0
+    resid = np.abs(a @ x - b).max() / (np.abs(a).max() * np.abs(x).max() * n)
+    assert resid < 1e-13, resid
+
+
+def test_getrf_pp_mesh_singular_info(rng):
+    from slate_tpu.parallel import getrf_mesh
+
+    mesh = mesh22()
+    n, nb = 32, 16
+    a = np.asarray(_rand(rng, n, n)).copy()
+    a[:, 5] = 0.0  # exactly singular: U[5,5] = 0 after elimination
+    lu, perm, info = getrf_mesh(jnp.asarray(a), mesh, nb=nb)
+    assert int(info) == 6  # 1-based first zero pivot
+
+
+# ---------------------------------------------------------------------------
+# mesh BLAS-3 fill: hemm/symm, trmm, her2k/syr2k (VERDICT r2 missing item 3)
+# ---------------------------------------------------------------------------
+
+
+def test_transpose_dist(rng):
+    from slate_tpu.parallel.dist_blas3 import transpose_dist
+
+    mesh = mesh24()
+    a = _rand(rng, 80, 48, np.complex128)
+    d = from_dense(a, mesh, nb=16)
+    out = np.asarray(to_dense(transpose_dist(d, conj=True)))
+    np.testing.assert_allclose(out, np.asarray(a).conj().T, atol=0)
+
+
+@pytest.mark.parametrize("uplo", [Uplo.Lower, Uplo.Upper])
+@pytest.mark.parametrize("conj", [True, False])
+def test_hemm_symm_dist_left(rng, uplo, conj):
+    from slate_tpu.parallel.dist_blas3 import hemm_summa
+    from slate_tpu.types import Side
+
+    mesh = mesh24()
+    n, nrhs, nb = 64, 32, 16
+    g = np.asarray(_rand(rng, n, n, np.complex128))
+    herm = (g + g.conj().T) / 2 if conj else (g + g.T) / 2
+    b = np.asarray(_rand(rng, n, nrhs, np.complex128))
+    # poison the dead triangle: the kernel must never read it
+    stored = herm.copy()
+    dead = np.triu(np.ones((n, n), bool), 1) if uplo == Uplo.Lower else np.tril(np.ones((n, n), bool), -1)
+    stored[dead] = 1e6
+    ad = from_dense(jnp.asarray(stored), mesh, nb)
+    bd = from_dense(jnp.asarray(b), mesh, nb)
+    out = np.asarray(to_dense(hemm_summa(Side.Left, 2.0, ad, bd, uplo=uplo, conj=conj)))
+    ref = 2.0 * herm @ b
+    assert np.abs(out - ref).max() / np.abs(ref).max() < 1e-12
+
+
+def test_hemm_dist_right(rng):
+    from slate_tpu.parallel.dist_blas3 import hemm_summa
+    from slate_tpu.types import Side
+
+    mesh = mesh22()
+    n, mr, nb = 48, 32, 16
+    g = np.asarray(_rand(rng, n, n, np.complex128))
+    herm = (g + g.conj().T) / 2
+    b = np.asarray(_rand(rng, mr, n, np.complex128))
+    ad = from_dense(jnp.asarray(herm), mesh, nb)
+    bd = from_dense(jnp.asarray(b), mesh, nb)
+    out = np.asarray(to_dense(hemm_summa(Side.Right, 1.5, ad, bd)))
+    ref = 1.5 * b @ herm
+    assert np.abs(out - ref).max() / np.abs(ref).max() < 1e-12
+
+
+@pytest.mark.parametrize("op", [Op.NoTrans, Op.Trans, Op.ConjTrans])
+@pytest.mark.parametrize("uplo", [Uplo.Lower, Uplo.Upper])
+def test_trmm_dist_left(rng, op, uplo):
+    from slate_tpu.parallel.dist_blas3 import trmm_dist
+    from slate_tpu.types import Side
+
+    mesh = mesh24()
+    n, nrhs, nb = 64, 16, 16
+    a = np.asarray(_rand(rng, n, n, np.complex128))
+    t = np.tril(a) if uplo == Uplo.Lower else np.triu(a)
+    b = np.asarray(_rand(rng, n, nrhs, np.complex128))
+    ad = from_dense(jnp.asarray(a), mesh, nb)  # full stored; kernel masks
+    bd = from_dense(jnp.asarray(b), mesh, nb)
+    out = np.asarray(to_dense(trmm_dist(Side.Left, uplo, op, Diag.NonUnit, 1.0, ad, bd)))
+    opt = {Op.NoTrans: t, Op.Trans: t.T, Op.ConjTrans: t.conj().T}[op]
+    ref = opt @ b
+    assert np.abs(out - ref).max() / np.abs(ref).max() < 1e-12
+
+
+def test_trmm_dist_unit_and_right(rng):
+    from slate_tpu.parallel.dist_blas3 import trmm_dist
+    from slate_tpu.types import Side
+
+    mesh = mesh22()
+    n, mr, nb = 48, 32, 16
+    a = np.asarray(_rand(rng, n, n))
+    t = np.tril(a, -1) + np.eye(n)
+    b = np.asarray(_rand(rng, mr, n))
+    ad = from_dense(jnp.asarray(a), mesh, nb)
+    bd = from_dense(jnp.asarray(b), mesh, nb)
+    out = np.asarray(to_dense(trmm_dist(Side.Right, Uplo.Lower, Op.NoTrans, Diag.Unit, 1.0, ad, bd)))
+    ref = b @ t
+    assert np.abs(out - ref).max() / np.abs(ref).max() < 1e-12
+
+
+@pytest.mark.parametrize("conj", [True, False])
+def test_her2k_syr2k_dist(rng, conj):
+    from slate_tpu.parallel.dist_blas3 import her2k_dist
+    from slate_tpu.parallel import norm_dist
+
+    mesh = mesh24()
+    n, k, nb = 64, 48, 16
+    a = np.asarray(_rand(rng, n, k, np.complex128))
+    b = np.asarray(_rand(rng, n, k, np.complex128))
+    ad = from_dense(jnp.asarray(a), mesh, nb)
+    bd = from_dense(jnp.asarray(b), mesh, nb)
+    alpha = 1.0 + (0.5j if conj else 0.0)
+    out = np.asarray(to_dense(her2k_dist(alpha, ad, bd, conj=conj, full=True)))
+    if conj:
+        ref = alpha * a @ b.conj().T + np.conj(alpha) * b @ a.conj().T
+    else:
+        ref = alpha * a @ b.T + alpha * b @ a.T
+    assert np.abs(out - ref).max() / np.abs(ref).max() < 1e-12
