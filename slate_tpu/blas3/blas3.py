@@ -61,7 +61,7 @@ def _mul_prec(opts: Optional[Options], *operands: jax.Array) -> Precision:
     accuracy where the dtype demands it.  Option.Precision overrides."""
     p = get_option(opts, Option.Precision, None) if opts else None
     if p is not None:
-        return p
+        return Precision(p)  # coerce "fast"-style string values to the enum
     dt = jnp.result_type(*(o.dtype for o in operands))
     if dt in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
         return Precision.Fast

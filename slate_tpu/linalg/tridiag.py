@@ -145,7 +145,12 @@ def sterf(d: jax.Array, e: jax.Array, max_sweeps: Optional[int] = None) -> jax.A
     classic implicit-shift QR iteration (the reference's Pal-Walker-Kahan
     path); past _STERF_QR_MAX the O(n^2) sequential scalar rotations are
     latency-bound on the accelerator, so values route to the boundary-row
-    divide & conquer (stedc_vals) whose work is batched."""
+    divide & conquer (stedc_vals) whose work is batched.
+
+    Passing ``max_sweeps`` FORCES the QR-iteration path at any n (D&C has
+    no sweep budget to bound) — it selects the algorithm, not just the
+    iteration cap.  Leave it None unless you specifically want bounded QR
+    iteration."""
     n = d.shape[0]
     if n > _STERF_QR_MAX and max_sweeps is None:
         return stedc_vals(d, e)
@@ -448,10 +453,16 @@ def _stedc_levels(d, e, want_q: bool):
         z_s = jnp.take_along_axis(z, order, axis=1)
         lam, v_s = jax.vmap(_secular_merge)(dd_s, z_s, rho)
         inv = jnp.argsort(order, axis=1)
-        v = jnp.take_along_axis(v_s, inv[:, :, None], axis=1)  # child row order
-        ord2 = jnp.argsort(lam, axis=1)
-        lam = jnp.take_along_axis(lam, ord2, axis=1)
-        v = jnp.take_along_axis(v, ord2[:, None, :], axis=2)
+        # permutations as vmapped small-index row gathers: take_along_axis
+        # with a broadcast (m, 2s, 2s) index tensor kernel-faults the TPU
+        # runtime at N = 16384 (round-3 finding, same class as the hb2st
+        # scatter) and wastes a gigabyte of index data
+        v = jax.vmap(lambda vm, im: vm[im])(v_s, inv)  # child row order
+        # NOTE: eigencolumns stay in sorted-POLE root order here (almost,
+        # but not exactly, ascending when deflation interleaves); parents
+        # re-sort their poles anyway, and the driver sorts (w, Q) once at
+        # the end — the per-level physical column sort the recursive
+        # formulation needs is dropped.
         if want_q:
             q_top = jnp.einsum(
                 "mij,mjk->mik", q[0::2], v[:, :s, :], precision=PRECISE
@@ -472,7 +483,9 @@ def _stedc_levels(d, e, want_q: bool):
         w = lam
         s *= 2
 
-    wv = w.reshape(N)[:n]
+    wv = w.reshape(N)
+    order = jnp.argsort(wv)
     if want_q:
-        return wv, q[0][:n, :n], None, None
-    return wv, None, None, None
+        qf = q[0][:, order[:n]]
+        return wv[order][:n], qf[:n, :], None, None
+    return wv[order][:n], None, None, None

@@ -6,6 +6,14 @@ from .mesh import COL_AXIS, ROW_AXIS, make_mesh, mesh_shape, replicated, tile_sh
 from .dist import DistMatrix, empty_like, from_dense, padded_tiles, redistribute, to_dense
 from .summa import gemm_summa
 from .dist_chol import potrf_dist
+from .dist_blas3 import (
+    hemm_summa,
+    her2k_dist,
+    syr2k_dist,
+    transpose_dist,
+    trmm_dist,
+)
+from .dist_stedc import stedc_dist
 from .dist_lu import (
     getrf_nopiv_dist,
     getrf_pp_dist,
@@ -54,6 +62,12 @@ __all__ = [
     "to_dense",
     "gemm_summa",
     "potrf_dist",
+    "hemm_summa",
+    "her2k_dist",
+    "syr2k_dist",
+    "transpose_dist",
+    "trmm_dist",
+    "stedc_dist",
     "getrf_nopiv_dist",
     "getrf_pp_dist",
     "getrf_tntpiv_dist",

@@ -1,0 +1,342 @@
+"""Distributed tridiagonal divide & conquer (stedc) over the mesh.
+
+TPU-native analogue of the reference's distributed stedc chain
+(``src/stedc.cc:16-150``, merge/deflate/secular across ranks
+``src/stedc_merge.cc`` / ``src/stedc_secular.cc`` / ``src/stedc_deflate.cc``)
+— round-2 VERDICT item 6: the single-chip level tree (linalg.tridiag)
+holds the O(n^2) eigenvector matrix and runs every assembly matmul on one
+device; here both are sharded so no device ever materializes more than
+O(n^2 / p) of Z.
+
+Layout invariants (per level, merge width 2s, m merges):
+- eigenvalues ``w`` and all O(n)-sized merge vectors (z, deflation
+  rotations, active masks, converged roots) are REPLICATED — they are
+  cheap and every device needs them;
+- the eigenvector stack ``q_loc`` holds, per merge block, MY row shard
+  with FULL columns: shape (m, 2s/p, s_child_cols) built recursively as
+  [child0's shard; child1's shard], so block row 0 lives on mesh row 0 and
+  block row 2s-1 on mesh row p-1 (the boundary rows a parent merge needs);
+- secular ROOTS are sharded over the mesh column axis (my roots = a
+  (2s/q)-wide slice), so the O((2s)^2) bisection/zhat tensors are
+  (2s/q, 2s) per device; converged roots all_gather back to replicated
+  vectors (O(2s) bytes — the only per-iteration-free collective);
+- the per-merge assembly is the block-diagonal product
+  [Q0; Q1] @ V -> my rows x my root columns, followed by ONE all_gather
+  along the column axis to restore the full-column invariant.
+
+Column order: children arrive in arbitrary eigen-column order and each
+merge sorts poles internally (take_along_axis, as linalg.tridiag does);
+eigencolumns are NEVER physically sorted between levels — the final
+(w, Z) is sorted once at the end by the caller on the sharded array.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..linalg.tridiag import _DC_SMALL, steqr
+from .comm import local_indices, shard_map
+from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
+
+
+def stedc_dist(d: jax.Array, e: jax.Array, mesh) -> Tuple[jax.Array, jax.Array]:
+    """Eigen-decomposition of the symmetric tridiagonal (d, e) with the
+    merge tree sharded over ``mesh``.  Returns (w ascending, Z) where Z is
+    a global (n, n) array row-sharded over the mesh row axis (each device
+    holds n/p rows; columns replicated across the mesh column axis after
+    the final gather).  Math follows linalg.tridiag._stedc_levels."""
+    p, q = mesh_shape(mesh)
+    n = d.shape[0]
+    if n <= max(_DC_SMALL, 2) or _DC_SMALL % p or (2 * _DC_SMALL) % q:
+        # tiny problem or mesh does not divide the tree: replicated solve
+        from ..linalg.tridiag import stedc
+
+        w, z = stedc(d, e)
+        return w, z
+    dtype = d.dtype
+    levels = max(1, -(-n // _DC_SMALL) - 1).bit_length()
+    nblk = 1 << levels
+    N = nblk * _DC_SMALL
+    scale = jnp.max(jnp.abs(d)) + 2 * (jnp.max(jnp.abs(e)) if n > 1 else 0) + 1
+    big = 4 * scale
+    dp = jnp.concatenate([d, jnp.full((N - n,), 1.0, dtype) * big])
+    ep = jnp.concatenate([e, jnp.zeros((N - 1 - (n - 1),), dtype)])
+    seams = _DC_SMALL * jnp.arange(1, nblk) - 1
+    dp = dp.at[seams].add(-ep[seams]).at[seams + 1].add(-ep[seams])
+
+    w, z = _stedc_dist_jit(dp, ep, mesh, p, q, N, levels)
+    # Undo the deterministic row interleave of the recursive
+    # [child0-shard; child1-shard] stacking: device row r's local rows of
+    # the final block are ids_r = U_l (s_l + ids_{l-1}) — a function of r
+    # alone, computed here and inverted as one row gather.
+    import numpy as _np
+
+    rp0 = _DC_SMALL // p
+    rows_global = []
+    for r_ in range(p):
+        ids = _np.arange(r_ * rp0, (r_ + 1) * rp0)
+        s_ = _DC_SMALL
+        while s_ < N:
+            ids = _np.concatenate([ids, s_ + ids])
+            s_ *= 2
+        rows_global.append(ids)
+    perm_rows = _np.concatenate(rows_global)  # stacked-row j holds global row perm_rows[j]
+    inv = _np.argsort(perm_rows)
+    z = z[jnp.asarray(inv)]
+    order = jnp.argsort(w[:n])
+    return w[:n][order], z[:n, :n][:, order]
+
+
+def _secular_roots_shard(dd, zf, rho, active, kidx, bisect_iters=70):
+    """Converged roots for MY root indices ``kidx`` of diag(dd) + rho z z^T
+    (dd ascending, full length nn = 2s; zf the deflation-rotated z).
+    Sharded restriction of linalg.tridiag._secular_merge's root finder:
+    every (nn x nn) tensor becomes (kloc x nn).  Returns (mu, aidx) for my
+    roots."""
+    nn = dd.shape[0]
+    dtype = dd.dtype
+    tiny = jnp.finfo(dtype).tiny
+    absrho = jnp.abs(rho)
+    zz2 = jnp.where(active, zf * zf, 0.0)
+    znorm2 = jnp.sum(zf * zf)
+    eps = jnp.finfo(dtype).eps
+    tol = 8.0 * eps * (absrho * znorm2 + jnp.max(jnp.abs(dd)) + tiny)
+    pos = rho >= 0
+    big = jnp.asarray(jnp.finfo(dtype).max / 4, dtype)
+    idxs = jnp.arange(nn)
+
+    from ..linalg.tridiag import _prefix_prev, _suffix_next
+
+    nxt_i = jnp.int32(_suffix_next(idxs.astype(dtype), active, jnp.asarray(nn - 1, dtype)))
+    has_nxt = _suffix_next(dd, active, big) < big
+    gap_p = jnp.where(has_nxt, dd[nxt_i] - dd, absrho * znorm2 + tol)
+    prv_i = jnp.int32(_prefix_prev(idxs.astype(dtype), active, jnp.asarray(0, dtype)))
+    has_prv = _prefix_prev(dd, active, -big) > -big
+    gap_m = jnp.where(has_prv, dd[prv_i] - dd, -(absrho * znorm2 + tol))
+    has_nbr = jnp.where(pos, has_nxt, has_prv)
+    gap_full = jnp.where(pos, gap_p, gap_m)
+    nbr_full = jnp.where(pos, nxt_i, prv_i)
+
+    # restrict to my roots
+    gap = gap_full[kidx]
+    nbr_i = nbr_full[kidx]
+    has_nbr_k = has_nbr[kidx]
+    self_i = kidx
+
+    def f_at(anchor_idx, mu):
+        dan = dd[None, :] - dd[anchor_idx][:, None]  # (kloc, nn)
+        den = dan - mu[:, None]
+        den = jnp.where(den == 0, tiny, den)
+        return 1.0 + rho * jnp.sum(zz2[None, :] / den, axis=1)
+
+    fmid = f_at(self_i, gap * 0.5)
+    far = fmid < 0
+    use_nbr = far & has_nbr_k
+    aidx = jnp.where(use_nbr, nbr_i, self_i)
+    half = gap * 0.5
+    lo0_p = jnp.where(use_nbr, half - gap, 0.0)
+    hi0_p = jnp.where(use_nbr, 0.0, jnp.where(has_nbr_k, half, gap))
+    lo0_m = jnp.where(use_nbr, 0.0, jnp.where(has_nbr_k, half, gap))
+    hi0_m = jnp.where(use_nbr, half - gap, 0.0)
+    lo0_m, hi0_m = jnp.minimum(lo0_m, hi0_m), jnp.maximum(lo0_m, hi0_m)
+    lo0 = jnp.where(pos, lo0_p, lo0_m)
+    hi0 = jnp.where(pos, hi0_p, hi0_m)
+
+    def bis_body(_, carry):
+        lo, hi = carry
+        mid = 0.5 * (lo + hi)
+        fm = f_at(aidx, mid)
+        go_right = jnp.where(pos, fm < 0, fm > 0)
+        lo = jnp.where(go_right, mid, lo)
+        hi = jnp.where(go_right, hi, mid)
+        return lo, hi
+
+    lo, hi = lax.fori_loop(0, bisect_iters, bis_body, (lo0, hi0))
+    mu = 0.5 * (lo + hi)
+
+    dan_full = dd[None, :] - dd[aidx][:, None]
+    not_anchor = idxs[None, :] != aidx[:, None]
+    zz2_anch = zz2[aidx]
+
+    def fp_body(_, mu):
+        den = dan_full - mu[:, None]
+        den = jnp.where(den == 0, tiny, den)
+        other = jnp.sum(jnp.where(not_anchor, zz2[None, :] / den, 0.0), axis=1)
+        g = rho * zz2_anch / (1.0 + rho * other)
+        ok = jnp.isfinite(g) & (g > lo) & (g < hi)
+        return jnp.where(ok, g, mu)
+
+    mu = lax.fori_loop(0, 25, fp_body, mu)
+    act_k = active[kidx]
+    mu = jnp.where(act_k, mu, 0.0)
+    aidx = jnp.where(act_k, aidx, self_i)
+    return mu, aidx
+
+
+def _zhat_shard(dd, zf, rho, active, lam_anch_d, mu_all, kidx):
+    """|zhat| for MY pole indices kidx (Gu-Eisenstat inverse-eigenvalue
+    formula), using the replicated converged roots.  lam_anch_d[j] =
+    dd[aidx_j] (anchor pole value of root j)."""
+    nn = dd.shape[0]
+    dtype = dd.dtype
+    tiny = jnp.finfo(dtype).tiny
+    absrho = jnp.abs(rho)
+    idxs = jnp.arange(nn)
+    dk = dd[kidx]  # (kloc,)
+    D = dd[None, :] - dk[:, None]  # (kloc, nn): d_j - d_k
+    Dsafe = jnp.where(D == 0, 1.0, D)
+    lamd = (lam_anch_d[None, :] - dk[:, None]) + mu_all[None, :]  # lam_j - d_k
+    offk = idxs[None, :] != kidx[:, None]
+    act_j = active[None, :] & offk
+    ratio = jnp.where(act_j, lamd / Dsafe, 1.0)
+    prod = jnp.prod(jnp.abs(ratio), axis=1)
+    lamk_dk = lamd[jnp.arange(kidx.shape[0]), kidx]  # lam_k - d_k per my pole
+    zhat = jnp.sign(zf[kidx]) * jnp.sqrt(prod * jnp.abs(lamk_dk) / jnp.maximum(absrho, tiny))
+    return jnp.where(active[kidx], zhat, 0.0)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
+def _stedc_dist_jit(dp, ep, mesh, p, q, N, levels):
+    S = _DC_SMALL
+
+    def kernel(dp, ep):
+        r = lax.axis_index(ROW_AXIS)
+        c = lax.axis_index(COL_AXIS)
+        dtype = dp.dtype
+        nblk = N // S
+        # replicated base solves (cheap: nblk batches of S^3)
+        db = dp.reshape(nblk, S)
+        eb = jnp.concatenate([ep, jnp.zeros((1,), dtype)]).reshape(nblk, S)[:, : S - 1]
+        w, qb = jax.vmap(steqr)(db, eb)
+        rows_per = S // p
+        q_loc = lax.dynamic_slice_in_dim(qb, r * rows_per, rows_per, axis=1)
+
+        s = S
+        while s < N:
+            m = N // (2 * s)
+            kloc = (2 * s) // q
+            rho = ep[(2 * jnp.arange(m) + 1) * s - 1]
+            dd = w.reshape(m, 2 * s)
+            qp = q_loc.reshape(m, 2, rows_per, s)
+            # boundary rows -> replicated z (psum over the row axis)
+            bot = lax.psum(jnp.where(r == p - 1, qp[:, 0, -1, :], 0), ROW_AXIS)
+            top = lax.psum(jnp.where(r == 0, qp[:, 1, 0, :], 0), ROW_AXIS)
+            z = jnp.concatenate([bot, top], axis=1)  # (m, 2s)
+            order = jnp.argsort(dd, axis=1)
+            dd_s = jnp.take_along_axis(dd, order, axis=1)
+            z_s = jnp.take_along_axis(z, order, axis=1)
+
+            # replicated deflation (Givens near-equal poles + negligible-z)
+            def deflate(dd1, z1, rho1):
+                nn = dd1.shape[0]
+                eps = jnp.finfo(dtype).eps
+                tiny = jnp.finfo(dtype).tiny
+                absrho = jnp.abs(rho1)
+                tol = 8.0 * eps * (absrho * jnp.sum(z1 * z1) + jnp.max(jnp.abs(dd1)) + tiny)
+
+                def body(t, carry):
+                    z1, cs_a, sn_a = carry
+                    i = nn - 2 - t
+                    close = jnp.abs(dd1[i + 1] - dd1[i]) <= tol
+                    zi, zi1 = z1[i], z1[i + 1]
+                    both = (jnp.abs(zi1) > 0) & close
+                    rr = jnp.hypot(zi, zi1)
+                    rs = jnp.where(rr == 0, 1.0, rr)
+                    cc = jnp.where(both, zi / rs, 1.0)
+                    ss = jnp.where(both, zi1 / rs, 0.0)
+                    z1 = z1.at[i].set(jnp.where(both, rr, zi))
+                    z1 = z1.at[i + 1].set(jnp.where(both, 0.0, zi1))
+                    return z1, cs_a.at[i].set(cc), sn_a.at[i].set(ss)
+
+                z1, cs_a, sn_a = lax.fori_loop(
+                    0, nn - 1, body,
+                    (z1, jnp.ones((nn - 1,), dtype), jnp.zeros((nn - 1,), dtype)),
+                )
+                active = absrho * jnp.abs(z1) > tol
+                return z1, cs_a, sn_a, active
+
+            zf, cs_a, sn_a, active = jax.vmap(deflate)(dd_s, z_s, rho)
+
+            # sharded root finding for my column slice of roots
+            kidx = c * kloc + jnp.arange(kloc)
+            mu_k, aidx_k = jax.vmap(
+                lambda dd1, z1, r1, a1: _secular_roots_shard(dd1, z1, r1, a1, kidx)
+            )(dd_s, zf, rho, active)
+            mu_all = _col_allgather(mu_k, q)      # (m, 2s) replicated
+            aidx_all = _col_allgather(aidx_k, q)  # (m, 2s)
+            lam_anch_d = jnp.take_along_axis(dd_s, aidx_all, axis=1)
+            lam = lam_anch_d + mu_all  # (m, 2s) new eigenvalues (root order)
+
+            # sharded zhat over my pole slice, gathered to replicated
+            zh_k = jax.vmap(
+                lambda dd1, z1, r1, a1, la1, mu1: _zhat_shard(dd1, z1, r1, a1, la1, mu1, kidx)
+            )(dd_s, zf, rho, active, lam_anch_d, mu_all)
+            zhat = _col_allgather(zh_k, q)  # (m, 2s)
+
+            # eigenvector columns for MY roots: (m, 2s, kloc)
+            tiny = jnp.finfo(dtype).tiny
+            den = (dd_s[:, :, None] - lam_anch_d[:, None, kidx]) - mu_all[:, None, kidx]
+            den = jnp.where(den == 0, tiny, den)
+            v = zhat[:, :, None] / den
+            act_k = active[:, kidx]  # (m, kloc)
+            v = jnp.where(act_k[:, None, :], v, 0.0)
+            nrm = jnp.sqrt(jnp.sum(v * v, axis=1))
+            v = v / jnp.where(nrm == 0, 1.0, nrm)[:, None, :]
+            # deflated roots keep their (rotated) basis vector e_k
+            ek = (jnp.arange(2 * s)[None, :, None] == kidx[None, None, :]).astype(dtype)
+            v = v + jnp.where(act_k[:, None, :], 0.0, 1.0) * ek
+
+            # undo deflation rotations on v's ROWS (ascending, local)
+            def rot_all(vm, cs_m, sn_m):
+                def rb(i, vm):
+                    cc, ss = cs_m[i], sn_m[i]
+                    r0 = lax.dynamic_slice_in_dim(vm, i, 1, axis=0)[0]
+                    r1 = lax.dynamic_slice_in_dim(vm, i + 1, 1, axis=0)[0]
+                    n0 = cc * r0 - ss * r1
+                    n1 = ss * r0 + cc * r1
+                    vm = lax.dynamic_update_slice_in_dim(vm, n0[None], i, axis=0)
+                    return lax.dynamic_update_slice_in_dim(vm, n1[None], i + 1, axis=0)
+
+                return lax.fori_loop(0, vm.shape[0] - 1, rb, vm)
+
+            v = jax.vmap(rot_all)(v, cs_a, sn_a)
+            # back to child row order
+            inv = jnp.argsort(order, axis=1)
+            v = jnp.take_along_axis(v, inv[:, :, None], axis=1)
+
+            # block-diagonal assembly on my rows x my root columns
+            qn_top = jnp.einsum("mrj,mjk->mrk", qp[:, 0], v[:, :s, :])
+            qn_bot = jnp.einsum("mrj,mjk->mrk", qp[:, 1], v[:, s:, :])
+            qn = jnp.concatenate([qn_top, qn_bot], axis=1)  # (m, 2rows, kloc)
+            q_loc = lax.all_gather(qn, COL_AXIS, axis=3, tiled=False)
+            # (m, 2rows, kloc, q) -> (m, 2rows, 2s) in device-column order
+            q_loc = jnp.moveaxis(q_loc, 3, 2).reshape(m, 2 * rows_per, 2 * s)
+            w = lam.reshape(-1)
+            rows_per *= 2
+            s *= 2
+
+        # q_loc: (1, N/p, N) my rows, full cols
+        return w[None], q_loc[0][None]
+
+    w, z = shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=(P(ROW_AXIS), P(ROW_AXIS, None)),
+        check_vma=False,
+    )(dp, ep)
+    # w was emitted once per mesh row (replicated): take the first copy
+    return w.reshape(p, -1)[0], z.reshape(N, N)
+
+
+def _col_allgather(x, q):
+    """all_gather shards along the mesh column axis back to the full
+    (m, 2s) replicated vector, preserving device-column order."""
+    g = lax.all_gather(x, COL_AXIS, axis=2, tiled=False)  # (m, kloc, q)
+    return jnp.moveaxis(g, 2, 1).reshape(x.shape[0], -1)

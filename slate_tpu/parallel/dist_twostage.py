@@ -119,7 +119,11 @@ def _he2hb_jit(at, mesh, p, q, n_true, nb, nsteps):
                 a,
             )
             rowblk = lax.dynamic_slice(a, (kr * nb, 0), (nb, nfl))
-            mirr = jnp.conj(newpan[cg]).T  # (nb, nfl)
+            # mask the cg gather explicitly: on meshes where padded global
+            # cols exceed padded global rows, cg indexes past newpan's rows
+            # and JAX clamps silently — zero those tiles so pad stays zero
+            cg_ok = (cg < mglob)[:, None]
+            mirr = jnp.conj(jnp.where(cg_ok, newpan[jnp.minimum(cg, mglob - 1)], 0)).T
             rowblk_new = jnp.where((cg >= c0)[None, :], mirr, rowblk)
             a = jnp.where(
                 mine_r,
@@ -130,7 +134,8 @@ def _he2hb_jit(at, mesh, p, q, n_true, nb, nsteps):
             # two-sided trailing update (he2hb.cc:207-604 algebra):
             # Y = A V (local gemm + psum over 'q'), W~ replicated, then
             # A -= W~ V^H + V W~^H on the local stack
-            v_rows, v_cols = v[rg], v[cg]
+            v_rows = v[rg]
+            v_cols = jnp.where(cg_ok, v[jnp.minimum(cg, mglob - 1)], 0)
             y_part = jnp.einsum("rc,ci->ri", a, v_cols, precision=PRECISE)
             y = lax.psum(y_part, COL_AXIS)
             y = jnp.where((rg >= c0)[:, None], y, 0).astype(dtype)
@@ -142,7 +147,8 @@ def _he2hb_jit(at, mesh, p, q, n_true, nb, nsteps):
                 precision=PRECISE,
             )
             wt = (wmat - 0.5 * jnp.einsum("ri,ij->rj", v, x, precision=PRECISE)).astype(dtype)
-            wt_rows, wt_cols = wt[rg], wt[cg]
+            wt_rows = wt[rg]
+            wt_cols = jnp.where(cg_ok, wt[jnp.minimum(cg, mglob - 1)], 0)
             upd = jnp.einsum("ri,ci->rc", wt_rows, jnp.conj(v_cols), precision=PRECISE)
             upd = upd + jnp.einsum(
                 "ri,ci->rc", v_rows, jnp.conj(wt_cols), precision=PRECISE

@@ -111,14 +111,25 @@ def gels_mesh(
 
 
 def heev_mesh(
-    a: jax.Array, mesh: Mesh, nb: int = 64, want_vectors: bool = True
+    a: jax.Array, mesh: Mesh, nb: int = 64, want_vectors: bool = True,
+    distributed_solver: bool = True,
 ):
     """Distributed Hermitian eigensolver (src/heev.cc with a grid): stage 1
     (he2hb, the O(n^3) reduction) and the stage-1 back-transform run on the
-    mesh; the band-to-tridiagonal chase + divide & conquer run as
-    single-program wavefront kernels on the gathered (n, nb)-band."""
+    mesh; the band-to-tridiagonal chase runs as a single-program wavefront
+    kernel on the gathered (n, nb)-band; the tridiagonal divide & conquer
+    runs with its merge tree SHARDED over the mesh (dist_stedc — the
+    reference's distributed stedc.cc/stedc_merge.cc), so no device holds
+    more than O(n^2/p) of the eigenvector matrix during the solve.
+
+    Known replication (cf. reference unmtr_hb2st.cc, which distributes
+    this): the stage-2 back-transform (unmtr_hb2st) applies the bulge-chase
+    reflectors to Z as one program — under jit the row-sharded Z from the
+    distributed solver is re-partitioned by GSPMD, but the reflector family
+    itself (O(n^2) floats) is replicated, as is the band."""
     from ..linalg.eig import hb2st, unmtr_hb2st
     from ..linalg.tridiag import stedc, sterf
+    from .dist_stedc import stedc_dist
     from .dist_twostage import he2hb_dist, unmtr_he2hb_dist
 
     n = a.shape[0]
@@ -131,7 +142,10 @@ def heev_mesh(
     d, e, f2, phases = hb2st(band, nb)
     if not want_vectors:
         return sterf(d, e)
-    w, ztri = stedc(d, e)
+    if distributed_solver:
+        w, ztri = stedc_dist(d, e, mesh)
+    else:
+        w, ztri = stedc(d, e)
     z = ztri.astype(a.dtype)
     if cplx:
         z = phases[:, None] * z

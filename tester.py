@@ -360,6 +360,10 @@ def main(argv=None):
             for n in _parse_dims(args.dim):
                 dtype = _DTYPES[prefix]
                 if args.grid and routine in MESH_ROUTINES:
+                    if args.precision:
+                        print(f"note: --precision {args.precision} ignored for "
+                              f"mesh routine {routine}@{args.grid} (mesh kernels "
+                              f"run their documented fixed tiers)", file=sys.stderr)
                     err, t, gflops, ok = MESH_ROUTINES[routine](
                         n, dtype, rng, check, args.grid)
                     rname = routine + "@" + args.grid

@@ -660,3 +660,74 @@ def test_her2k_syr2k_dist(rng, conj):
     else:
         ref = alpha * a @ b.T + alpha * b @ a.T
     assert np.abs(out - ref).max() / np.abs(ref).max() < 1e-12
+
+
+def test_svd_mesh_complex(rng):
+    # ADVICE r2: the complex path through ge2tb_dist's LQ conjugation and
+    # the pu/pv phase handling in the mesh driver was untested
+    from slate_tpu.parallel import svd_mesh
+
+    m, n = 72, 56
+    a = _rand(rng, m, n, np.complex128)
+    u, s, vh = svd_mesh(a, mesh22(), nb=16)
+    an, un, sn, vn = np.asarray(a), np.asarray(u), np.asarray(s), np.asarray(vh)
+    sref = np.linalg.svd(an, compute_uv=False)
+    k = min(m, n)
+    eps = np.finfo(np.float64).eps
+    scale = max(1, sref.max())
+    assert np.abs(sn - sref).max() < 50 * k * eps * scale
+    assert np.abs(an - (un * sn) @ vn).max() < 50 * k * eps * scale
+    assert np.abs(un.conj().T @ un - np.eye(un.shape[1])).max() < 50 * k * eps
+    assert np.abs(vn @ vn.conj().T - np.eye(vn.shape[0])).max() < 50 * k * eps
+
+
+def test_stedc_dist(rng):
+    # VERDICT r2 item 6: the D&C merge tree sharded over the mesh — secular
+    # roots over the column axis, eigenvector rows over the row axis
+    from slate_tpu.parallel.dist_stedc import stedc_dist
+
+    n = 200  # pads to N=256: exercises pad-block merges too
+    d = np.asarray(_rand(rng, n, 1))[:, 0]
+    e = np.asarray(_rand(rng, n - 1, 1))[:, 0]
+    w, z = stedc_dist(jnp.asarray(d), jnp.asarray(e), mesh24())
+    w, z = np.asarray(w), np.asarray(z)
+    T = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    wref = np.linalg.eigvalsh(T)
+    eps = np.finfo(np.float64).eps
+    scale = max(1, np.abs(wref).max())
+    assert np.abs(w - wref).max() < 50 * n * eps * scale
+    assert np.abs(T @ z - z * w).max() < 50 * n * eps * scale
+    assert np.abs(z.T @ z - np.eye(n)).max() < 50 * n * eps
+
+
+def test_stedc_dist_deflation_heavy(rng):
+    # repeated eigenvalues force the Givens-deflation path across shards
+    from slate_tpu.parallel.dist_stedc import stedc_dist
+
+    n = 128
+    d = np.repeat(np.arange(n // 4), 4).astype(np.float64)
+    e = np.full(n - 1, 1e-3)
+    w, z = stedc_dist(jnp.asarray(d), jnp.asarray(e), mesh24())
+    w, z = np.asarray(w), np.asarray(z)
+    T = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    wref = np.linalg.eigvalsh(T)
+    eps = np.finfo(np.float64).eps
+    assert np.abs(w - wref).max() < 100 * n * eps * max(1, np.abs(wref).max())
+    assert np.abs(T @ z - z * w).max() < 100 * n * eps * max(1, np.abs(wref).max())
+    assert np.abs(z.T @ z - np.eye(n)).max() < 100 * n * eps
+
+
+def test_heev_mesh_distributed_solver(rng):
+    from slate_tpu.parallel import heev_mesh
+
+    n = 96
+    a = _rand(rng, n, n)
+    a = (a + a.T) / 2
+    w, z = heev_mesh(a, mesh24(), nb=16)
+    an, zn, wn = np.asarray(a), np.asarray(z), np.asarray(w)
+    wref = np.linalg.eigvalsh(an)
+    eps = np.finfo(np.float64).eps
+    scale = max(1, np.abs(wref).max())
+    assert np.abs(np.sort(wn) - wref).max() < 50 * n * eps * scale
+    assert np.abs(an @ zn - zn * wn).max() < 50 * n * eps * scale
+    assert np.abs(zn.T @ zn - np.eye(n)).max() < 50 * n * eps
