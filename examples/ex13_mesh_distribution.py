@@ -14,3 +14,23 @@ g = rng.standard_normal((n, n)); a = jnp.asarray(g @ g.T + n * np.eye(n))
 xt = rng.standard_normal((n, 4))
 x, info = posv_mesh(a, jnp.asarray(np.asarray(a) @ xt), mesh, nb=16)
 print("mesh:", dict(mesh.shape), "info:", int(info), "err:", np.abs(np.asarray(x) - xt).max())
+
+# --- non-uniform block sizes + GridOrder (reference ex13 proper) ---
+from slate_tpu.parallel import (
+    from_dense_nonuniform, gemm_summa, to_dense_nonuniform, from_dense, to_dense,
+)
+from slate_tpu.types import GridOrder
+
+rowsz = [16, 8, 24, 16, 8, 24]      # ragged row tiling (sums to 96)
+colsz = [8, 24, 16, 8, 24, 16]
+a2 = jnp.asarray(rng.standard_normal((96, 96)))
+b2 = jnp.asarray(rng.standard_normal((96, 96)))
+ad = from_dense_nonuniform(a2, mesh, rowsz, colsz)
+bd = from_dense_nonuniform(b2, mesh, colsz, rowsz)  # B tiled by A's col sizes
+cd = gemm_summa(1.0, ad, bd)
+c = to_dense_nonuniform(cd, rowsz, rowsz)
+print("non-uniform gemm err:", float(jnp.abs(c - a2 @ b2).max()))
+
+mesh_col = make_mesh(2, 4, devices=devs, order=GridOrder.Col)
+x2 = to_dense(from_dense(a2, mesh_col, 16))
+print("GridOrder.Col roundtrip exact:", bool(jnp.all(x2 == a2)))

@@ -399,3 +399,17 @@ def pdgemm(m, n, k, alpha, pa, pdesca, pb, pdescb, beta, pc, pdescc) -> int:
     flat = _tview(pc, (int(desc[3]) * lld,), np.float64)
     flat.reshape(int(desc[3]), lld).T[:m, :n] = np.asarray(out)
     return 0
+
+
+def scalapack_call(routine, tchar, *ptrs):
+    """Entry for the ScaLAPACK drop-in symbols (scalapack_api_generated.cc);
+    bodies live in slate_tpu.scalapack_bridge."""
+    from .scalapack_bridge import scalapack_call as _impl
+
+    return _impl(routine, tchar, *ptrs)
+
+
+def scalapack_call_ret(routine, tchar, *ptrs):
+    from .scalapack_bridge import scalapack_call_ret as _impl
+
+    return _impl(routine, tchar, *ptrs)

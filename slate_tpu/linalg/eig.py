@@ -398,8 +398,15 @@ def heev_staged(
     d, e, f2, phases = jax.jit(hb2st, static_argnums=1)(f1.band, nb)
     if not want_vectors:
         return jax.jit(_vals)(d, e)
-    solver = stedc if method == MethodEig.DC else steqr
-    w, ztri = jax.jit(solver)(d, e)
+    if method == MethodEig.DC:
+        from .tridiag import _STEDC_STAGE_ABOVE, stedc_staged
+
+        if n > _STEDC_STAGE_ABOVE:
+            w, ztri = stedc_staged(d, e)  # one dispatch per merge level
+        else:
+            w, ztri = jax.jit(stedc)(d, e)
+    else:
+        w, ztri = jax.jit(steqr)(d, e)
     z = ztri.astype(a.dtype)
     if jnp.issubdtype(a.dtype, jnp.complexfloating):
         z = phases[:, None] * z

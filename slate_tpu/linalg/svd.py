@@ -30,7 +30,7 @@ from jax import lax
 
 from ..ops.matmul import matmul
 from .eig import _larfg_masked
-from .tridiag import stedc, sterf
+from .tridiag import _STEDC_STAGE_ABOVE, stedc, stedc_staged, sterf
 
 Array = jax.Array
 
@@ -303,7 +303,12 @@ def bdsqr(d: Array, e: Array, want_vectors: bool = True):
     if not want_vectors:
         w = sterf(gk_d, gk_e)
         return jnp.flip(jnp.maximum(w[n:], 0.0))
-    w, z = stedc(gk_d, gk_e)
+    if 2 * n > _STEDC_STAGE_ABOVE:
+        # level-staged dispatch (no-op under an outer jit, where the
+        # stages inline; call bdsqr eagerly to benefit — svd_staged does)
+        w, z = stedc_staged(gk_d, gk_e)
+    else:
+        w, z = stedc(gk_d, gk_e)
     # positive eigenvalues ascending are the last n; descend for SVD order
     sel = jnp.arange(2 * n - 1, n - 1, -1)
     s = jnp.maximum(w[sel], 0.0)
@@ -336,7 +341,11 @@ def svd_staged(a: Array, want_vectors: bool = True, nb: int = _SVD_NB):
         return jax.jit(bdsqr, static_argnums=2)(d, e, False)
     from .eig import _chase_sweep_apply
 
-    s, ub, vb = jax.jit(bdsqr)(d, e)
+    if 2 * n > _STEDC_STAGE_ABOVE:
+        # eager: bdsqr internally level-stages its stedc at this scale
+        s, ub, vb = bdsqr(d, e)
+    else:
+        s, ub, vb = jax.jit(bdsqr)(d, e)
     dtype = a.dtype
     apply = jax.jit(_chase_sweep_apply, static_argnums=(3, 4, 5))
     u = apply(f2.lvs, f2.ltaus, pu[:, None] * ub.astype(dtype), n, nb, False)

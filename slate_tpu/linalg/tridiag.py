@@ -19,6 +19,7 @@ TPU design notes:
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -378,6 +379,248 @@ def _secular_merge(d: jax.Array, z: jax.Array, rho, bisect_iters: int = 70):
     return lam, v
 
 
+def _secular_roots_shard(dd, zf, rho, active, kidx, bisect_iters=70):
+    """Converged roots for MY root indices ``kidx`` of diag(dd) + rho z z^T
+    (dd ascending, full length nn = 2s; zf the deflation-rotated z).
+    Sharded restriction of linalg.tridiag._secular_merge's root finder:
+    every (nn x nn) tensor becomes (kloc x nn).  Returns (mu, aidx) for my
+    roots."""
+    nn = dd.shape[0]
+    dtype = dd.dtype
+    tiny = jnp.finfo(dtype).tiny
+    absrho = jnp.abs(rho)
+    zz2 = jnp.where(active, zf * zf, 0.0)
+    znorm2 = jnp.sum(zf * zf)
+    eps = jnp.finfo(dtype).eps
+    tol = 8.0 * eps * (absrho * znorm2 + jnp.max(jnp.abs(dd)) + tiny)
+    pos = rho >= 0
+    big = jnp.asarray(jnp.finfo(dtype).max / 4, dtype)
+    idxs = jnp.arange(nn)
+
+    nxt_i = jnp.int32(_suffix_next(idxs.astype(dtype), active, jnp.asarray(nn - 1, dtype)))
+    has_nxt = _suffix_next(dd, active, big) < big
+    gap_p = jnp.where(has_nxt, dd[nxt_i] - dd, absrho * znorm2 + tol)
+    prv_i = jnp.int32(_prefix_prev(idxs.astype(dtype), active, jnp.asarray(0, dtype)))
+    has_prv = _prefix_prev(dd, active, -big) > -big
+    gap_m = jnp.where(has_prv, dd[prv_i] - dd, -(absrho * znorm2 + tol))
+    has_nbr = jnp.where(pos, has_nxt, has_prv)
+    gap_full = jnp.where(pos, gap_p, gap_m)
+    nbr_full = jnp.where(pos, nxt_i, prv_i)
+
+    # restrict to my roots
+    gap = gap_full[kidx]
+    nbr_i = nbr_full[kidx]
+    has_nbr_k = has_nbr[kidx]
+    self_i = kidx
+
+    def f_at(anchor_idx, mu):
+        dan = dd[None, :] - dd[anchor_idx][:, None]  # (kloc, nn)
+        den = dan - mu[:, None]
+        den = jnp.where(den == 0, tiny, den)
+        return 1.0 + rho * jnp.sum(zz2[None, :] / den, axis=1)
+
+    fmid = f_at(self_i, gap * 0.5)
+    far = fmid < 0
+    use_nbr = far & has_nbr_k
+    aidx = jnp.where(use_nbr, nbr_i, self_i)
+    half = gap * 0.5
+    lo0_p = jnp.where(use_nbr, half - gap, 0.0)
+    hi0_p = jnp.where(use_nbr, 0.0, jnp.where(has_nbr_k, half, gap))
+    lo0_m = jnp.where(use_nbr, 0.0, jnp.where(has_nbr_k, half, gap))
+    hi0_m = jnp.where(use_nbr, half - gap, 0.0)
+    lo0_m, hi0_m = jnp.minimum(lo0_m, hi0_m), jnp.maximum(lo0_m, hi0_m)
+    lo0 = jnp.where(pos, lo0_p, lo0_m)
+    hi0 = jnp.where(pos, hi0_p, hi0_m)
+
+    def bis_body(_, carry):
+        lo, hi = carry
+        mid = 0.5 * (lo + hi)
+        fm = f_at(aidx, mid)
+        go_right = jnp.where(pos, fm < 0, fm > 0)
+        lo = jnp.where(go_right, mid, lo)
+        hi = jnp.where(go_right, hi, mid)
+        return lo, hi
+
+    lo, hi = lax.fori_loop(0, bisect_iters, bis_body, (lo0, hi0))
+    mu = 0.5 * (lo + hi)
+
+    dan_full = dd[None, :] - dd[aidx][:, None]
+    not_anchor = idxs[None, :] != aidx[:, None]
+    zz2_anch = zz2[aidx]
+
+    def fp_body(_, mu):
+        den = dan_full - mu[:, None]
+        den = jnp.where(den == 0, tiny, den)
+        other = jnp.sum(jnp.where(not_anchor, zz2[None, :] / den, 0.0), axis=1)
+        g = rho * zz2_anch / (1.0 + rho * other)
+        ok = jnp.isfinite(g) & (g > lo) & (g < hi)
+        return jnp.where(ok, g, mu)
+
+    mu = lax.fori_loop(0, 25, fp_body, mu)
+    act_k = active[kidx]
+    mu = jnp.where(act_k, mu, 0.0)
+    aidx = jnp.where(act_k, aidx, self_i)
+    return mu, aidx
+
+
+def _zhat_shard(dd, zf, rho, active, lam_anch_d, mu_all, kidx):
+    """|zhat| for MY pole indices kidx (Gu-Eisenstat inverse-eigenvalue
+    formula), using the replicated converged roots.  lam_anch_d[j] =
+    dd[aidx_j] (anchor pole value of root j)."""
+    nn = dd.shape[0]
+    dtype = dd.dtype
+    tiny = jnp.finfo(dtype).tiny
+    absrho = jnp.abs(rho)
+    idxs = jnp.arange(nn)
+    dk = dd[kidx]  # (kloc,)
+    D = dd[None, :] - dk[:, None]  # (kloc, nn): d_j - d_k
+    Dsafe = jnp.where(D == 0, 1.0, D)
+    lamd = (lam_anch_d[None, :] - dk[:, None]) + mu_all[None, :]  # lam_j - d_k
+    offk = idxs[None, :] != kidx[:, None]
+    act_j = active[None, :] & offk
+    ratio = jnp.where(act_j, lamd / Dsafe, 1.0)
+    prod = jnp.prod(jnp.abs(ratio), axis=1)
+    lamk_dk = lamd[jnp.arange(kidx.shape[0]), kidx]  # lam_k - d_k per my pole
+    zhat = jnp.sign(zf[kidx]) * jnp.sqrt(prod * jnp.abs(lamk_dk) / jnp.maximum(absrho, tiny))
+    return jnp.where(active[kidx], zhat, 0.0)
+
+
+def _vmap1(fn):
+    """vmap that bypasses batching when the leading dim is 1.
+
+    Round-3 chip finding: jax.vmap over the merge internals (deflation
+    fori + dynamic updates + the big gathers) lowers to a kernel that
+    faults the TPU worker at nn = 16384 even for batch size 1, while the
+    identical unbatched program runs fine — the top merge level always has
+    m = 1, so bypassing there is both the fix and free."""
+    batched = jax.vmap(fn)
+
+    def call(*args):
+        if args[0].shape[0] == 1:
+            out = fn(*(a[0] for a in args))
+            if isinstance(out, tuple):
+                return tuple(o[None] for o in out)
+            return out[None]
+        return batched(*args)
+
+    return call
+
+
+def _deflate_z(d: jax.Array, z: jax.Array, rho):
+    """Deflation pre-pass shared by the chunked/sharded merges: Givens-
+    rotate near-equal poles (zeroing the second z entry) and mask
+    negligible-z components.  Returns (z_rotated, cs, sn, active)."""
+    n = d.shape[0]
+    dtype = d.dtype
+    eps = jnp.finfo(dtype).eps
+    tiny = jnp.finfo(dtype).tiny
+    absrho = jnp.abs(rho)
+    tol = 8.0 * eps * (absrho * jnp.sum(z * z) + jnp.max(jnp.abs(d)) + tiny)
+
+    def body(t, carry):
+        z, cs_a, sn_a = carry
+        i = n - 2 - t
+        close = jnp.abs(d[i + 1] - d[i]) <= tol
+        zi, zi1 = z[i], z[i + 1]
+        both = (jnp.abs(zi1) > 0) & close
+        r = jnp.hypot(zi, zi1)
+        rs = jnp.where(r == 0, 1.0, r)
+        c = jnp.where(both, zi / rs, 1.0)
+        s = jnp.where(both, zi1 / rs, 0.0)
+        z = z.at[i].set(jnp.where(both, r, zi))
+        z = z.at[i + 1].set(jnp.where(both, 0.0, zi1))
+        return z, cs_a.at[i].set(c), sn_a.at[i].set(s)
+
+    z, cs_a, sn_a = lax.fori_loop(
+        0, n - 1, body, (z, jnp.ones((n - 1,), dtype), jnp.zeros((n - 1,), dtype))
+    )
+    active = absrho * jnp.abs(z) > tol
+    return z, cs_a, sn_a, active
+
+
+# Above this merge width, the single-program merge runs in root-column
+# chunks: the monolithic form keeps several (2s)^2 tensors live at once and
+# exhausts device memory near 2s = 16384 (round-3 chip finding — every
+# piece passes in isolation, the fused whole kills the worker).
+_CHUNK_AT = 16384
+_CHUNK_COLS = 2048
+
+
+def _merge_chunked(dd_s, z_s, rho, s, q_pair, inv):
+    """One merge level evaluated in root-column chunks with bounded peak
+    memory: deflation + root finding + zhat as vector passes, then per
+    chunk the (2s x cols) eigenvector slab, its deflation rotations, the
+    child-order row unsort, and the block-diagonal assembly write.  Shapes:
+    dd_s/z_s (m, 2s) sorted-pole; q_pair (m, 2, s_rows, s); inv (m, 2s).
+    Returns (lam (m, 2s), q_new (m, 2*s_rows, 2s))."""
+    m, nn = dd_s.shape
+    dtype = dd_s.dtype
+    tiny = jnp.finfo(dtype).tiny
+    zf, cs_a, sn_a, active = _vmap1(_deflate_z)(dd_s, z_s, rho)
+
+    nch = max(1, nn // _CHUNK_COLS)
+    cols = nn // nch
+    # pass 1: converged roots, chunk by chunk
+    mus, aidxs = [], []
+    for ci in range(nch):
+        kidx = ci * cols + jnp.arange(cols)
+        mu_c, aidx_c = _vmap1(
+            lambda d1, z1, r1, a1: _secular_roots_shard(d1, z1, r1, a1, kidx)
+        )(dd_s, zf, rho, active)
+        mus.append(mu_c)
+        aidxs.append(aidx_c)
+    mu_all = jnp.concatenate(mus, axis=1)
+    aidx_all = jnp.concatenate(aidxs, axis=1)
+    lam_anch_d = jnp.take_along_axis(dd_s, aidx_all, axis=1)
+    lam = lam_anch_d + mu_all
+
+    # pass 2: zhat, pole chunk by pole chunk
+    zhs = []
+    for ci in range(nch):
+        kidx = ci * cols + jnp.arange(cols)
+        zh_c = _vmap1(
+            lambda d1, z1, r1, a1, la1, mu1: _zhat_shard(d1, z1, r1, a1, la1, mu1, kidx)
+        )(dd_s, zf, rho, active, lam_anch_d, mu_all)
+        zhs.append(zh_c)
+    zhat = jnp.concatenate(zhs, axis=1)
+
+    # pass 3: eigenvector slab + assembly per chunk
+    srows = q_pair.shape[2]
+    q_new = jnp.zeros((m, 2 * srows, nn), dtype)
+    for ci in range(nch):
+        kidx = ci * cols + jnp.arange(cols)
+        den = (dd_s[:, :, None] - lam_anch_d[:, None, kidx]) - mu_all[:, None, kidx]
+        den = jnp.where(den == 0, tiny, den)
+        v = zhat[:, :, None] / den  # (m, nn, cols)
+        act_k = active[:, kidx]
+        v = jnp.where(act_k[:, None, :], v, 0.0)
+        nrm = jnp.sqrt(jnp.sum(v * v, axis=1))
+        v = v / jnp.where(nrm == 0, 1.0, nrm)[:, None, :]
+        ek = (jnp.arange(nn)[None, :, None] == kidx[None, None, :]).astype(dtype)
+        v = v + jnp.where(act_k[:, None, :], 0.0, 1.0) * ek
+
+        def rot_all(vm, cs_m, sn_m):
+            def rb(i, vm):
+                cc, ss = cs_m[i], sn_m[i]
+                r0 = lax.dynamic_slice_in_dim(vm, i, 1, axis=0)[0]
+                r1 = lax.dynamic_slice_in_dim(vm, i + 1, 1, axis=0)[0]
+                n0 = cc * r0 - ss * r1
+                n1 = ss * r0 + cc * r1
+                vm = lax.dynamic_update_slice_in_dim(vm, n0[None], i, axis=0)
+                return lax.dynamic_update_slice_in_dim(vm, n1[None], i + 1, axis=0)
+
+            return lax.fori_loop(0, vm.shape[0] - 1, rb, vm)
+
+        v = _vmap1(rot_all)(v, cs_a, sn_a)
+        v = _vmap1(lambda vm, im: vm[im])(v, inv)  # child row order
+        qt = jnp.einsum("mrj,mjk->mrk", q_pair[:, 0], v[:, :s, :], precision=PRECISE)
+        qb = jnp.einsum("mrj,mjk->mrk", q_pair[:, 1], v[:, s:, :], precision=PRECISE)
+        q_new = lax.dynamic_update_slice(
+            q_new, jnp.concatenate([qt, qb], axis=1).astype(dtype), (0, 0, ci * cols)
+        )
+    return lam, q_new
+
+
 _DC_SMALL = 32  # base-case size (reference stedc small-problem cutoff)
 
 
@@ -417,31 +660,23 @@ def _stedc_levels(d, e, want_q: bool):
         w, q = steqr(d, e)
         return w, q, q[0, :], q[-1, :]
     levels = max(1, -(-n // _DC_SMALL) - 1).bit_length()
-    nblk = 1 << levels
-    N = nblk * _DC_SMALL
-    # decoupled pad: e = 0 at and past the real/pad seam, diagonal at
-    # 4 * ||T||_inf-ish so pad eigenvalues sort strictly last; modest (not
-    # finfo-huge) so deflation tolerances in mixed merges stay O(eps ||T||)
-    scale = jnp.max(jnp.abs(d)) + 2 * (jnp.max(jnp.abs(e)) if n > 1 else 0) + 1
-    big = 4 * scale
-    dp = jnp.concatenate([d, jnp.full((N - n,), 1.0, dtype) * big])
-    ep = jnp.concatenate([e, jnp.zeros((N - 1 - (n - 1),), dtype)])
-    # every block seam is the rank-one coupling of exactly one merge; its
-    # rho is subtracted from the two adjacent diagonal entries up front
-    # (the recursive formulation's d1[-1] -= rho / d2[0] -= rho, flattened)
-    seams = _DC_SMALL * jnp.arange(1, nblk) - 1
-    dp = dp.at[seams].add(-ep[seams]).at[seams + 1].add(-ep[seams])
+    N = (1 << levels) * _DC_SMALL
+    w, q, ep = _stedc_base(d, e, N)
 
-    # base solves: one vmapped steqr over the 2^L blocks
-    db = dp.reshape(nblk, _DC_SMALL)
-    eb = jnp.concatenate([ep, jnp.zeros((1,), dtype)]).reshape(nblk, _DC_SMALL)
-    eb = eb[:, : _DC_SMALL - 1]
-    w, q = jax.vmap(steqr)(db, eb)
+    if want_q:
+        # vectors path: shared per-level body (_merge_level_q) — the same
+        # function stedc_staged dispatches one level at a time
+        s = _DC_SMALL
+        while s < N:
+            w, q = _merge_level_q(w, q, ep, s, N)
+            s *= 2
+        wv = w.reshape(N)
+        order = jnp.argsort(wv)
+        return wv[order][:n], q[0][:, order[:n]][:n, :], None, None
+
+    # boundary-row path: each subproblem carries only (w, top, bot)
     top = q[:, 0, :]
     bot = q[:, -1, :]
-    if not want_q:
-        q = None
-
     s = _DC_SMALL
     while s < N:
         m = N // (2 * s)
@@ -451,41 +686,93 @@ def _stedc_levels(d, e, want_q: bool):
         order = jnp.argsort(dd, axis=1)
         dd_s = jnp.take_along_axis(dd, order, axis=1)
         z_s = jnp.take_along_axis(z, order, axis=1)
-        lam, v_s = jax.vmap(_secular_merge)(dd_s, z_s, rho)
         inv = jnp.argsort(order, axis=1)
-        # permutations as vmapped small-index row gathers: take_along_axis
-        # with a broadcast (m, 2s, 2s) index tensor kernel-faults the TPU
-        # runtime at N = 16384 (round-3 finding, same class as the hb2st
-        # scatter) and wastes a gigabyte of index data
-        v = jax.vmap(lambda vm, im: vm[im])(v_s, inv)  # child row order
-        # NOTE: eigencolumns stay in sorted-POLE root order here (almost,
-        # but not exactly, ascending when deflation interleaves); parents
-        # re-sort their poles anyway, and the driver sorts (w, Q) once at
-        # the end — the per-level physical column sort the recursive
-        # formulation needs is dropped.
-        if want_q:
-            q_top = jnp.einsum(
-                "mij,mjk->mik", q[0::2], v[:, :s, :], precision=PRECISE
-            )
-            q_bot = jnp.einsum(
-                "mij,mjk->mik", q[1::2], v[:, s:, :], precision=PRECISE
-            )
-            q = jnp.concatenate([q_top, q_bot], axis=1).astype(dtype)
-            top = q[:, 0, :]
-            bot = q[:, -1, :]
-        else:
-            top = jnp.einsum(
-                "mj,mjk->mk", top[0::2], v[:, :s, :], precision=PRECISE
-            ).astype(dtype)
-            bot = jnp.einsum(
-                "mj,mjk->mk", bot[1::2], v[:, s:, :], precision=PRECISE
-            ).astype(dtype)
+        lam, v_s = _vmap1(_secular_merge)(dd_s, z_s, rho)
+        v = _vmap1(lambda vm, im: vm[im])(v_s, inv)  # child row order
+        # eigencolumns stay in sorted-pole root order (parents re-sort
+        # their poles; one global argsort at the end)
+        top = jnp.einsum(
+            "mj,mjk->mk", top[0::2], v[:, :s, :], precision=PRECISE
+        ).astype(dtype)
+        bot = jnp.einsum(
+            "mj,mjk->mk", bot[1::2], v[:, s:, :], precision=PRECISE
+        ).astype(dtype)
         w = lam
         s *= 2
 
     wv = w.reshape(N)
     order = jnp.argsort(wv)
-    if want_q:
-        qf = q[0][:, order[:n]]
-        return wv[order][:n], qf[:n, :], None, None
     return wv[order][:n], None, None, None
+
+
+# Fused stedc-with-vectors is validated on chip up to N = 8192; at
+# N = 16384 the single program kills the TPU worker even though every
+# level runs fine as its own dispatch (round-3 finding) — so large
+# problems run the level loop staged, one XLA program per merge level.
+_STEDC_STAGE_ABOVE = 8192
+
+
+def _stedc_base(d, e, N):
+    n = d.shape[0]
+    dtype = d.dtype
+    nblk = N // _DC_SMALL
+    scale = jnp.max(jnp.abs(d)) + 2 * (jnp.max(jnp.abs(e)) if n > 1 else 0) + 1
+    big = 4 * scale
+    dp = jnp.concatenate([d, jnp.full((N - n,), 1.0, dtype) * big])
+    ep = jnp.concatenate([e, jnp.zeros((N - 1 - (n - 1),), dtype)])
+    seams = _DC_SMALL * jnp.arange(1, nblk) - 1
+    dp = dp.at[seams].add(-ep[seams]).at[seams + 1].add(-ep[seams])
+    db = dp.reshape(nblk, _DC_SMALL)
+    eb = jnp.concatenate([ep, jnp.zeros((1,), dtype)]).reshape(nblk, _DC_SMALL)
+    eb = eb[:, : _DC_SMALL - 1]
+    w, q = jax.vmap(steqr)(db, eb)
+    return w, q, ep
+
+
+def _merge_level_q(w, q, ep, s, N):
+    """One merge level with the eigenvector stack carried — the single
+    source of truth for the vectors path: _stedc_levels inlines it into
+    the fused program and stedc_staged dispatches it per level."""
+    dtype = q.dtype
+    m = N // (2 * s)
+    rho = ep[(2 * jnp.arange(m) + 1) * s - 1]
+    dd = w.reshape(m, 2 * s)
+    top = q[:, 0, :]
+    bot = q[:, -1, :]
+    z = jnp.concatenate([bot[0::2], top[1::2]], axis=1)
+    order = jnp.argsort(dd, axis=1)
+    dd_s = jnp.take_along_axis(dd, order, axis=1)
+    z_s = jnp.take_along_axis(z, order, axis=1)
+    inv = jnp.argsort(order, axis=1)
+    if 2 * s >= _CHUNK_AT:
+        lam, qn = _merge_chunked(
+            dd_s, z_s, rho, s, q.reshape(m, 2, q.shape[1], q.shape[2]), inv
+        )
+        return lam, qn.astype(dtype)
+    lam, v_s = _vmap1(_secular_merge)(dd_s, z_s, rho)
+    v = _vmap1(lambda vm, im: vm[im])(v_s, inv)
+    q_top = jnp.einsum("mij,mjk->mik", q[0::2], v[:, :s, :], precision=PRECISE)
+    q_bot = jnp.einsum("mij,mjk->mik", q[1::2], v[:, s:, :], precision=PRECISE)
+    return lam, jnp.concatenate([q_top, q_bot], axis=1).astype(dtype)
+
+
+_stedc_base_jit = jax.jit(_stedc_base, static_argnames=("N",))
+_stedc_level_jit = jax.jit(_merge_level_q, static_argnames=("s", "N"))
+
+
+def stedc_staged(d: jax.Array, e: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """stedc with each merge level as its own XLA dispatch — numerically
+    identical to stedc; the large-n driver path (cf. eig.heev_staged)."""
+    n = d.shape[0]
+    if n <= _STEDC_STAGE_ABOVE:
+        return stedc(d, e)
+    levels = max(1, -(-n // _DC_SMALL) - 1).bit_length()
+    N = (1 << levels) * _DC_SMALL
+    w, q, ep = _stedc_base_jit(d, e, N)
+    s = _DC_SMALL
+    while s < N:
+        w, q = _stedc_level_jit(w, q, ep, s, N)
+        s *= 2
+    wv = w.reshape(N)
+    order = jnp.argsort(wv)
+    return wv[order][:n], q[0][:, order[:n]][:n, :]

@@ -3,7 +3,16 @@ gemm, distributed Cholesky/LU/trsm — XLA collectives over ICI replacing the
 reference's MPI backend (SURVEY §2.6)."""
 
 from .mesh import COL_AXIS, ROW_AXIS, make_mesh, mesh_shape, replicated, tile_sharding
-from .dist import DistMatrix, empty_like, from_dense, padded_tiles, redistribute, to_dense
+from .dist import (
+    DistMatrix,
+    empty_like,
+    from_dense,
+    from_dense_nonuniform,
+    padded_tiles,
+    redistribute,
+    to_dense,
+    to_dense_nonuniform,
+)
 from .summa import gemm_summa
 from .dist_chol import potrf_dist
 from .dist_blas3 import (
@@ -35,7 +44,9 @@ from .drivers import (
     gemm_mesh,
     gesv_nopiv_mesh,
     gesv_mesh,
+    gesv_mixed_mesh,
     gesv_tntpiv_mesh,
+    getri_mesh,
     gels_mesh,
     geqrf_mesh,
     getrf_mesh,
@@ -43,6 +54,8 @@ from .drivers import (
     getrf_tntpiv_mesh,
     heev_mesh,
     posv_mesh,
+    posv_mixed_mesh,
+    potri_mesh,
     potrf_mesh,
     svd_mesh,
 )
@@ -57,9 +70,11 @@ __all__ = [
     "DistMatrix",
     "empty_like",
     "from_dense",
+    "from_dense_nonuniform",
     "padded_tiles",
     "redistribute",
     "to_dense",
+    "to_dense_nonuniform",
     "gemm_summa",
     "potrf_dist",
     "hemm_summa",
@@ -84,11 +99,15 @@ __all__ = [
     "gemm_mesh",
     "gesv_nopiv_mesh",
     "gesv_mesh",
+    "gesv_mixed_mesh",
+    "getri_mesh",
     "gesv_tntpiv_mesh",
     "getrf_mesh",
     "getrf_nopiv_mesh",
     "getrf_tntpiv_mesh",
     "posv_mesh",
+    "posv_mixed_mesh",
+    "potri_mesh",
     "potrf_mesh",
     "DistTwoStage",
     "he2hb_dist",

@@ -140,6 +140,75 @@ def empty_like(d: DistMatrix, m: Optional[int] = None, n: Optional[int] = None) 
     return DistMatrix(tiles=t, m=m, n=n, nb=d.nb, mesh=d.mesh)
 
 
+# ---------------------------------------------------------------------------
+# Non-uniform block sizes (func.hh:39-203 parity; exercised by ref ex13)
+# ---------------------------------------------------------------------------
+
+
+def from_dense_nonuniform(
+    a: jax.Array,
+    mesh: Mesh,
+    row_sizes,
+    col_sizes,
+) -> DistMatrix:
+    """Distribute with PER-INDEX tile sizes (reference func.hh non-uniform
+    block-size lambdas, ex13): non-uniform tile (i, j) of size
+    (row_sizes[i], col_sizes[j]) keeps the reference's ownership rule
+    (i % p, j % q) and is embedded top-left into a uniform
+    max(sizes)-square padded tile — the TPU-idiomatic canonicalization
+    (static shapes; XLA cannot trace ragged tiles).  The zero embedding is
+    exact for multiply-class ops and norms: gemm's tile products align
+    because row k of B and column k of A pad identically; factorizations
+    require uniform tiling (interior pad would make diag tiles singular) —
+    use redistribute()/from_dense for those.
+
+    Returns a DistMatrix with nb = max of all sizes and the logical
+    (m, n) = sums of sizes; recover the dense array with
+    ``to_dense_nonuniform(d, row_sizes, col_sizes)``."""
+    import numpy as _np
+
+    row_sizes = [int(x) for x in row_sizes]
+    col_sizes = [int(x) for x in col_sizes]
+    m, n = a.shape
+    if sum(row_sizes) != m or sum(col_sizes) != n:
+        raise ValueError(
+            f"non-uniform sizes must tile the matrix exactly: "
+            f"sum(rows)={sum(row_sizes)} vs m={m}, sum(cols)={sum(col_sizes)} vs n={n}"
+        )
+    nb = max(row_sizes + col_sizes)
+    mt = _round_up(max(1, len(row_sizes)), _pad_grid(mesh))
+    nt = _round_up(max(1, len(col_sizes)), _pad_grid(mesh))
+    roff = _np.concatenate([[0], _np.cumsum(row_sizes)])
+    coff = _np.concatenate([[0], _np.cumsum(col_sizes)])
+    # assemble on host (one device transfer), not per-tile .at[].set
+    th = _np.zeros((mt, nt, nb, nb), _np.asarray(a).dtype)
+    ah = _np.asarray(a)
+    for i, mb in enumerate(row_sizes):
+        for j, nbj in enumerate(col_sizes):
+            th[i, j, :mb, :nbj] = ah[roff[i] : roff[i] + mb, coff[j] : coff[j] + nbj]
+    t = to_cyclic(jnp.asarray(th), *mesh_shape(mesh))
+    t = jax.device_put(t, tile_sharding(mesh))
+    return DistMatrix(tiles=t, m=m, n=n, nb=nb, mesh=mesh, diag_pad=False)
+
+
+def to_dense_nonuniform(d: DistMatrix, row_sizes, col_sizes) -> jax.Array:
+    """Gather a from_dense_nonuniform matrix back to dense (m, n)."""
+    import numpy as _np
+
+    row_sizes = [int(x) for x in row_sizes]
+    col_sizes = [int(x) for x in col_sizes]
+    t = from_cyclic(d.tiles, *mesh_shape(d.mesh))
+    roff = _np.concatenate([[0], _np.cumsum(row_sizes)])
+    coff = _np.concatenate([[0], _np.cumsum(col_sizes)])
+    out = jnp.zeros((d.m, d.n), d.dtype)
+    for i, mb in enumerate(row_sizes):
+        for j, nbj in enumerate(col_sizes):
+            out = out.at[roff[i] : roff[i] + mb, coff[j] : coff[j] + nbj].set(
+                t[i, j, :mb, :nbj]
+            )
+    return out
+
+
 def redistribute(d: DistMatrix, mesh: Mesh, nb: Optional[int] = None) -> DistMatrix:
     """Re-distribute between layouts (src/redistribute.cc analogue),
     entirely on device: the cyclic-order permutation + one device_put that

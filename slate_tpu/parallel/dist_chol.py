@@ -78,9 +78,13 @@ def _potrf_jit(at, mesh, p, q, nt):
 
             def step(k, view):
                 kc = k // q - coff
-                lkk = lax.linalg.cholesky(
-                    bcast_diag_tile(view, k, p, q, nb, roff, coff)
-                )
+                dtile = bcast_diag_tile(view, k, p, q, nb, roff, coff)
+                # bf16 inputs: the LAPACK-kernel base case has no bf16
+                # variant on any backend — factor the diag tile in f32
+                if dtype == jnp.bfloat16:
+                    lkk = lax.linalg.cholesky(dtile.astype(jnp.float32)).astype(dtype)
+                else:
+                    lkk = lax.linalg.cholesky(dtile)
                 pcol = lax.dynamic_slice_in_dim(view, kc, 1, axis=1)[:, 0]
                 lkk_h = jnp.conj(lkk).T if cplx else lkk.T
                 solved = lax.linalg.triangular_solve(

@@ -40,7 +40,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..linalg.tridiag import _DC_SMALL, steqr
+from ..linalg.tridiag import _DC_SMALL, _secular_roots_shard, _zhat_shard, steqr
 from .comm import local_indices, shard_map
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 
@@ -91,114 +91,6 @@ def stedc_dist(d: jax.Array, e: jax.Array, mesh) -> Tuple[jax.Array, jax.Array]:
     z = z[jnp.asarray(inv)]
     order = jnp.argsort(w[:n])
     return w[:n][order], z[:n, :n][:, order]
-
-
-def _secular_roots_shard(dd, zf, rho, active, kidx, bisect_iters=70):
-    """Converged roots for MY root indices ``kidx`` of diag(dd) + rho z z^T
-    (dd ascending, full length nn = 2s; zf the deflation-rotated z).
-    Sharded restriction of linalg.tridiag._secular_merge's root finder:
-    every (nn x nn) tensor becomes (kloc x nn).  Returns (mu, aidx) for my
-    roots."""
-    nn = dd.shape[0]
-    dtype = dd.dtype
-    tiny = jnp.finfo(dtype).tiny
-    absrho = jnp.abs(rho)
-    zz2 = jnp.where(active, zf * zf, 0.0)
-    znorm2 = jnp.sum(zf * zf)
-    eps = jnp.finfo(dtype).eps
-    tol = 8.0 * eps * (absrho * znorm2 + jnp.max(jnp.abs(dd)) + tiny)
-    pos = rho >= 0
-    big = jnp.asarray(jnp.finfo(dtype).max / 4, dtype)
-    idxs = jnp.arange(nn)
-
-    from ..linalg.tridiag import _prefix_prev, _suffix_next
-
-    nxt_i = jnp.int32(_suffix_next(idxs.astype(dtype), active, jnp.asarray(nn - 1, dtype)))
-    has_nxt = _suffix_next(dd, active, big) < big
-    gap_p = jnp.where(has_nxt, dd[nxt_i] - dd, absrho * znorm2 + tol)
-    prv_i = jnp.int32(_prefix_prev(idxs.astype(dtype), active, jnp.asarray(0, dtype)))
-    has_prv = _prefix_prev(dd, active, -big) > -big
-    gap_m = jnp.where(has_prv, dd[prv_i] - dd, -(absrho * znorm2 + tol))
-    has_nbr = jnp.where(pos, has_nxt, has_prv)
-    gap_full = jnp.where(pos, gap_p, gap_m)
-    nbr_full = jnp.where(pos, nxt_i, prv_i)
-
-    # restrict to my roots
-    gap = gap_full[kidx]
-    nbr_i = nbr_full[kidx]
-    has_nbr_k = has_nbr[kidx]
-    self_i = kidx
-
-    def f_at(anchor_idx, mu):
-        dan = dd[None, :] - dd[anchor_idx][:, None]  # (kloc, nn)
-        den = dan - mu[:, None]
-        den = jnp.where(den == 0, tiny, den)
-        return 1.0 + rho * jnp.sum(zz2[None, :] / den, axis=1)
-
-    fmid = f_at(self_i, gap * 0.5)
-    far = fmid < 0
-    use_nbr = far & has_nbr_k
-    aidx = jnp.where(use_nbr, nbr_i, self_i)
-    half = gap * 0.5
-    lo0_p = jnp.where(use_nbr, half - gap, 0.0)
-    hi0_p = jnp.where(use_nbr, 0.0, jnp.where(has_nbr_k, half, gap))
-    lo0_m = jnp.where(use_nbr, 0.0, jnp.where(has_nbr_k, half, gap))
-    hi0_m = jnp.where(use_nbr, half - gap, 0.0)
-    lo0_m, hi0_m = jnp.minimum(lo0_m, hi0_m), jnp.maximum(lo0_m, hi0_m)
-    lo0 = jnp.where(pos, lo0_p, lo0_m)
-    hi0 = jnp.where(pos, hi0_p, hi0_m)
-
-    def bis_body(_, carry):
-        lo, hi = carry
-        mid = 0.5 * (lo + hi)
-        fm = f_at(aidx, mid)
-        go_right = jnp.where(pos, fm < 0, fm > 0)
-        lo = jnp.where(go_right, mid, lo)
-        hi = jnp.where(go_right, hi, mid)
-        return lo, hi
-
-    lo, hi = lax.fori_loop(0, bisect_iters, bis_body, (lo0, hi0))
-    mu = 0.5 * (lo + hi)
-
-    dan_full = dd[None, :] - dd[aidx][:, None]
-    not_anchor = idxs[None, :] != aidx[:, None]
-    zz2_anch = zz2[aidx]
-
-    def fp_body(_, mu):
-        den = dan_full - mu[:, None]
-        den = jnp.where(den == 0, tiny, den)
-        other = jnp.sum(jnp.where(not_anchor, zz2[None, :] / den, 0.0), axis=1)
-        g = rho * zz2_anch / (1.0 + rho * other)
-        ok = jnp.isfinite(g) & (g > lo) & (g < hi)
-        return jnp.where(ok, g, mu)
-
-    mu = lax.fori_loop(0, 25, fp_body, mu)
-    act_k = active[kidx]
-    mu = jnp.where(act_k, mu, 0.0)
-    aidx = jnp.where(act_k, aidx, self_i)
-    return mu, aidx
-
-
-def _zhat_shard(dd, zf, rho, active, lam_anch_d, mu_all, kidx):
-    """|zhat| for MY pole indices kidx (Gu-Eisenstat inverse-eigenvalue
-    formula), using the replicated converged roots.  lam_anch_d[j] =
-    dd[aidx_j] (anchor pole value of root j)."""
-    nn = dd.shape[0]
-    dtype = dd.dtype
-    tiny = jnp.finfo(dtype).tiny
-    absrho = jnp.abs(rho)
-    idxs = jnp.arange(nn)
-    dk = dd[kidx]  # (kloc,)
-    D = dd[None, :] - dk[:, None]  # (kloc, nn): d_j - d_k
-    Dsafe = jnp.where(D == 0, 1.0, D)
-    lamd = (lam_anch_d[None, :] - dk[:, None]) + mu_all[None, :]  # lam_j - d_k
-    offk = idxs[None, :] != kidx[:, None]
-    act_j = active[None, :] & offk
-    ratio = jnp.where(act_j, lamd / Dsafe, 1.0)
-    prod = jnp.prod(jnp.abs(ratio), axis=1)
-    lamk_dk = lamd[jnp.arange(kidx.shape[0]), kidx]  # lam_k - d_k per my pole
-    zhat = jnp.sign(zf[kidx]) * jnp.sqrt(prod * jnp.abs(lamk_dk) / jnp.maximum(absrho, tiny))
-    return jnp.where(active[kidx], zhat, 0.0)
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))

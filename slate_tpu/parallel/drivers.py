@@ -205,6 +205,128 @@ def gesv_tntpiv_mesh(
     return to_dense(x), info
 
 
+# ---------------------------------------------------------------------------
+# Mixed-precision mesh solvers (src/gesv_mixed.cc:16-44, posv_mixed.cc) and
+# distributed inverses (src/getri.cc, src/potri.cc) — VERDICT r2 items 4/8
+# ---------------------------------------------------------------------------
+
+
+def _ir_loop_mesh(a_hi: DistMatrix, bd: DistMatrix, lo_solve, max_iter=30):
+    """Classic iterative refinement with every operand distributed: the
+    f32 factor/solve runs on the mesh, the f64 residual is one SUMMA gemm,
+    norms are mesh reductions (norm_dist) — nothing is gathered.  The
+    iteration control is a host loop on scalar norms, as the reference's
+    (gesv_mixed.cc's omp-master loop reading MPI-reduced norms)."""
+    from ..types import Norm
+    from .dist_aux import norm_dist
+
+    n = a_hi.m
+    eps = float(jnp.finfo(a_hi.tiles.dtype).eps)
+    anorm = float(norm_dist(Norm.Inf, a_hi))
+    cte = anorm * eps * float(n) ** 0.5
+
+    x = lo_solve(bd)  # f32 solve, tiles upcast below
+    x = DistMatrix(tiles=x.tiles.astype(a_hi.tiles.dtype), m=x.m, n=x.n,
+                   nb=x.nb, mesh=x.mesh, diag_pad=x.diag_pad)
+    iters, converged = 0, False
+    for it in range(max_iter):
+        r = gemm_summa(-1.0, a_hi, x, 1.0, bd)
+        rnorm = float(norm_dist(Norm.Inf, r))
+        xnorm = float(norm_dist(Norm.Inf, x))
+        if rnorm <= xnorm * cte:
+            converged = True
+            iters = it
+            break
+        d = lo_solve(r)
+        dt = DistMatrix(tiles=d.tiles.astype(a_hi.tiles.dtype), m=d.m, n=d.n,
+                        nb=d.nb, mesh=d.mesh, diag_pad=d.diag_pad)
+        x = DistMatrix(tiles=x.tiles + dt.tiles, m=x.m, n=x.n, nb=x.nb,
+                       mesh=x.mesh, diag_pad=x.diag_pad)
+        iters = it + 1
+    return x, iters, converged
+
+
+def _astype_dist(d: DistMatrix, dtype) -> DistMatrix:
+    return DistMatrix(tiles=d.tiles.astype(dtype), m=d.m, n=d.n, nb=d.nb,
+                      mesh=d.mesh, diag_pad=d.diag_pad)
+
+
+def posv_mixed_mesh(
+    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
+    max_iter: int = 30,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Distributed SPD solve, f32 mesh factor + f64 mesh refinement
+    (src/posv_mixed.cc).  Returns (x, iters, info); iters = -1 means the
+    refinement did not converge and the caller should fall back."""
+    ad = from_dense(a, mesh, nb, diag_pad_one=True)
+    a_lo = _astype_dist(ad, jnp.float32)
+    l, info = potrf_dist(a_lo)
+
+    def lo_solve(rd: DistMatrix) -> DistMatrix:
+        r32 = _astype_dist(rd, jnp.float32)
+        y = trsm_dist(l, r32, Uplo.Lower, Op.NoTrans)
+        return trsm_dist(l, y, Uplo.Lower, Op.ConjTrans)
+
+    bd = from_dense(b, mesh, nb)
+    if int(info) != 0:  # factor failed: skip the refinement entirely
+        return to_dense(_astype_dist(bd, ad.tiles.dtype)), jnp.asarray(-1, jnp.int32), info
+    x, iters, conv = _ir_loop_mesh(ad, bd, lo_solve, max_iter)
+    return to_dense(x), jnp.asarray(iters if conv else -1, jnp.int32), info
+
+
+def gesv_mixed_mesh(
+    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
+    max_iter: int = 30,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Distributed general solve, f32 partial-pivot mesh factor + f64 mesh
+    refinement (src/gesv_mixed.cc:16-44)."""
+    ad = from_dense(a, mesh, nb, diag_pad_one=True)
+    a_lo = _astype_dist(ad, jnp.float32)
+    lu, perm, info = getrf_pp_dist(a_lo)
+
+    def lo_solve(rd: DistMatrix) -> DistMatrix:
+        r32 = _astype_dist(rd, jnp.float32)
+        pr = permute_rows_dist(r32, perm)
+        y = trsm_dist(lu, pr, Uplo.Lower, Op.NoTrans, Diag.Unit)
+        return trsm_dist(lu, y, Uplo.Upper, Op.NoTrans)
+
+    bd = from_dense(b, mesh, nb)
+    if int(info) != 0:  # singular factor: skip the refinement entirely
+        return to_dense(_astype_dist(bd, ad.tiles.dtype)), jnp.asarray(-1, jnp.int32), info
+    x, iters, conv = _ir_loop_mesh(ad, bd, lo_solve, max_iter)
+    return to_dense(x), jnp.asarray(iters if conv else -1, jnp.int32), info
+
+
+def getri_mesh(
+    a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
+) -> Tuple[jax.Array, jax.Array]:
+    """Distributed inverse (src/getri.cc capability): partial-pivot factor
+    then solve A X = I entirely on the mesh — the solve-against-identity
+    formulation costs the same O(n^3) as the reference's trtri+trmm chain
+    and reuses the pivoted trsm sweeps."""
+    n = a.shape[0]
+    lu, perm, info = getrf_mesh(a, mesh, nb)
+    eye = jnp.eye(n, dtype=a.dtype)
+    bd = from_dense(eye, mesh, nb)
+    pb = permute_rows_dist(bd, perm)
+    y = trsm_dist(lu, pb, Uplo.Lower, Op.NoTrans, Diag.Unit)
+    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans)
+    return to_dense(x), info
+
+
+def potri_mesh(
+    a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
+) -> Tuple[jax.Array, jax.Array]:
+    """Distributed SPD inverse (src/potri.cc capability): Cholesky factor,
+    then A^-1 = L^-H L^-1 via two mesh trsm sweeps on the identity."""
+    n = a.shape[0]
+    l, info = potrf_mesh(a, mesh, nb)
+    eye = jnp.eye(n, dtype=a.dtype)
+    y = trsm_dist(l, from_dense(eye, mesh, nb), Uplo.Lower, Op.NoTrans)
+    x = trsm_dist(l, y, Uplo.Lower, Op.ConjTrans)
+    return to_dense(x), info
+
+
 def getrf_mesh(
     a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
 ) -> Tuple[DistMatrix, jax.Array, jax.Array]:

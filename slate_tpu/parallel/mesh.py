@@ -25,13 +25,21 @@ def make_mesh(
     p: Optional[int] = None,
     q: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,
+    order=None,
 ) -> Mesh:
     """Build a (p, q) mesh over ``devices`` (default: all available).
 
     With no arguments, picks the near-square factorization of the device
     count, matching the reference testers' default grid choice
     (test/grid_utils.hh).
-    """
+
+    ``order`` (types.GridOrder; default Row, this package's historical
+    layout) selects the ScaLAPACK-style process-grid ordering (reference
+    enums.hh:130, func.hh process_2d_grid): Col places device k at grid
+    position (k % p, k // p), Row at (k // q, k % q).  Ownership semantics
+    are identical; only which physical device holds which block changes."""
+    from ..types import GridOrder
+
     devs = list(devices) if devices is not None else jax.devices()
     if p is None and q is None:
         p, q = grid_2d_factor(len(devs))
@@ -41,7 +49,10 @@ def make_mesh(
         q = len(devs) // p
     if p < 1 or q < 1 or p * q > len(devs):
         raise ValueError(f"mesh {p}x{q} invalid for {len(devs)} devices")
-    grid = np.asarray(devs[: p * q]).reshape(p, q)
+    if order == GridOrder.Col:
+        grid = np.asarray(devs[: p * q]).reshape(q, p).T
+    else:  # Row order — also this package's historical default layout
+        grid = np.asarray(devs[: p * q]).reshape(p, q)
     return Mesh(grid, (ROW_AXIS, COL_AXIS))
 
 

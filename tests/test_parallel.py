@@ -731,3 +731,100 @@ def test_heev_mesh_distributed_solver(rng):
     assert np.abs(np.sort(wn) - wref).max() < 50 * n * eps * scale
     assert np.abs(an @ zn - zn * wn).max() < 50 * n * eps * scale
     assert np.abs(zn.T @ zn - np.eye(n)).max() < 50 * n * eps
+
+
+# ---------------------------------------------------------------------------
+# mixed-precision mesh solvers + distributed inverses (VERDICT r2 items 4/8)
+# ---------------------------------------------------------------------------
+
+
+def test_posv_mixed_mesh(rng):
+    from slate_tpu.parallel import posv_mixed_mesh
+
+    mesh = mesh24()
+    n = 96
+    a = np.asarray(_spd(rng, n))
+    b = np.asarray(_rand(rng, n, 3))
+    x, iters, info = posv_mixed_mesh(jnp.asarray(a), jnp.asarray(b), mesh, nb=16)
+    assert int(info) == 0
+    assert 0 <= int(iters) <= 3  # well-conditioned: converges in <= 3
+    resid = np.abs(a @ np.asarray(x) - b).max() / (np.abs(a).max() * np.abs(np.asarray(x)).max() * n)
+    assert resid < 1e-14, resid  # f64-grade answer from an f32 factor
+
+
+def test_gesv_mixed_mesh(rng):
+    from slate_tpu.parallel import gesv_mixed_mesh
+
+    mesh = mesh24()
+    n = 96
+    a = np.asarray(_rand(rng, n, n)) + n * np.eye(n)
+    b = np.asarray(_rand(rng, n, 2))
+    x, iters, info = gesv_mixed_mesh(jnp.asarray(a), jnp.asarray(b), mesh, nb=16)
+    assert int(info) == 0
+    assert 0 <= int(iters) <= 3
+    resid = np.abs(a @ np.asarray(x) - b).max() / (np.abs(a).max() * np.abs(np.asarray(x)).max() * n)
+    assert resid < 1e-14, resid
+
+
+def test_getri_potri_mesh(rng):
+    from slate_tpu.parallel import getri_mesh, potri_mesh
+
+    mesh = mesh22()
+    n = 64
+    a = np.asarray(_rand(rng, n, n))
+    inv, info = getri_mesh(jnp.asarray(a), mesh, nb=16)
+    assert int(info) == 0
+    assert np.abs(a @ np.asarray(inv) - np.eye(n)).max() < 1e-10
+    s = np.asarray(_spd(rng, n))
+    sinv, info2 = potri_mesh(jnp.asarray(s), mesh, nb=16)
+    assert int(info2) == 0
+    assert np.abs(s @ np.asarray(sinv) - np.eye(n)).max() < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# non-uniform block sizes + GridOrder (func.hh:39-203 parity, ref ex13)
+# ---------------------------------------------------------------------------
+
+
+def test_nonuniform_roundtrip_and_gemm(rng):
+    from slate_tpu.parallel import (
+        from_dense_nonuniform, gemm_summa, to_dense_nonuniform,
+    )
+
+    mesh = mesh24()
+    rowsz = [16, 8, 24, 16, 8, 24]
+    colsz = [8, 24, 16, 8, 24, 16]
+    a = _rand(rng, 96, 96)
+    b = _rand(rng, 96, 96)
+    ad = from_dense_nonuniform(a, mesh, rowsz, colsz)
+    assert ad.nb == 24  # max block size
+    back = to_dense_nonuniform(ad, rowsz, colsz)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(a))
+    bd = from_dense_nonuniform(b, mesh, colsz, rowsz)
+    c = to_dense_nonuniform(gemm_summa(1.0, ad, bd), rowsz, rowsz)
+    ref = np.asarray(a) @ np.asarray(b)
+    assert np.abs(np.asarray(c) - ref).max() < 1e-12
+
+
+def test_nonuniform_size_mismatch_raises(rng):
+    from slate_tpu.parallel import from_dense_nonuniform
+
+    with pytest.raises(ValueError):
+        from_dense_nonuniform(_rand(rng, 64, 64), mesh22(), [32, 16], [32, 32])
+
+
+def test_grid_order_col(rng):
+    from slate_tpu.parallel import gemm_mesh
+    from slate_tpu.types import GridOrder
+
+    from slate_tpu.parallel import make_mesh as mk
+    mesh = mk(2, 4, devices=cpu_devices(8), order=GridOrder.Col)
+    a, b = _rand(rng, 64, 48), _rand(rng, 48, 32)
+    c = gemm_mesh(1.0, a, b, mesh, nb=16)
+    ref = np.asarray(a) @ np.asarray(b)
+    np.testing.assert_allclose(np.asarray(c), ref, rtol=1e-12, atol=1e-10)
+    # Col vs Row order place device k at transposed grid coordinates
+    mrow = mk(2, 4, devices=cpu_devices(8), order=GridOrder.Row)
+    dcol = np.asarray(mesh.devices)
+    drow = np.asarray(mrow.devices)
+    assert dcol[1, 0] == drow[0, 1]  # device k=1: (1,0) in Col vs (0,1) in Row

@@ -1,0 +1,199 @@
+"""ScaLAPACK drop-in symbol surface (native/scalapack_api_generated.cc ->
+scalapack_bridge): call the Fortran-convention pd* symbols via ctypes the
+way a re-linked ScaLAPACK application would (reference scalapack_api/)."""
+
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+_LIB = os.path.join(os.path.dirname(__file__), "..", "native", "lib",
+                    "libslatetpu_scalapack.so")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if not os.path.exists(_LIB):
+        pytest.skip("native scalapack shim not built")
+    return ctypes.CDLL(_LIB)
+
+
+def _iref(v):
+    return ctypes.byref(ctypes.c_int32(v))
+
+
+def _cref(ch):
+    return ctypes.c_char_p(ch.encode())
+
+
+def _desc(m, n, mb=32):
+    # [dtype=1, ctxt, M, N, MB, NB, RSRC, CSRC, LLD] — single-rank grid
+    d = np.array([1, 0, m, n, mb, mb, 0, 0, m], dtype=np.int32)
+    return d, d.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _fptr(a):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def test_pdgemm(lib):
+    rng = np.random.default_rng(0)
+    m, n, k = 48, 40, 56
+    a = np.asfortranarray(rng.standard_normal((m, k)))
+    b = np.asfortranarray(rng.standard_normal((k, n)))
+    c = np.asfortranarray(np.zeros((m, n)))
+    da, pda = _desc(m, k)
+    db, pdb = _desc(k, n)
+    dc, pdc = _desc(m, n)
+    alpha = ctypes.byref(ctypes.c_double(2.0))
+    beta = ctypes.byref(ctypes.c_double(0.0))
+    lib.pdgemm_(_cref("N"), _cref("N"), _iref(m), _iref(n), _iref(k),
+                alpha, _fptr(a), _iref(1), _iref(1), pda,
+                _fptr(b), _iref(1), _iref(1), pdb,
+                beta, _fptr(c), _iref(1), _iref(1), pdc)
+    ref = 2.0 * (np.asarray(a) @ np.asarray(b))
+    assert np.abs(c - ref).max() < 1e-11
+
+
+def test_pdgemm_transposed_window(lib):
+    rng = np.random.default_rng(1)
+    # multiply a sub-window with op(A) = A^T (ia/ja offsets exercised)
+    M, K = 64, 64
+    abig = np.asfortranarray(rng.standard_normal((M, K)))
+    m, n, k = 24, 16, 32
+    b = np.asfortranarray(rng.standard_normal((k, n)))
+    c = np.asfortranarray(np.zeros((m, n)))
+    da, pda = _desc(M, K)
+    db, pdb = _desc(k, n)
+    dc, pdc = _desc(m, n)
+    lib.pdgemm_(_cref("T"), _cref("N"), _iref(m), _iref(n), _iref(k),
+                ctypes.byref(ctypes.c_double(1.0)),
+                _fptr(abig), _iref(3), _iref(5), pda,
+                _fptr(b), _iref(1), _iref(1), pdb,
+                ctypes.byref(ctypes.c_double(0.0)),
+                _fptr(c), _iref(1), _iref(1), pdc)
+    sub = np.asarray(abig)[2 : 2 + k, 4 : 4 + m]  # (k, m), then transposed
+    ref = sub.T @ np.asarray(b)
+    assert np.abs(c - ref).max() < 1e-11
+
+
+def test_pdgesv_and_pdgetrs(lib):
+    rng = np.random.default_rng(2)
+    n, nrhs = 64, 3
+    a0 = rng.standard_normal((n, n))
+    x_true = rng.standard_normal((n, nrhs))
+    b0 = a0 @ x_true
+    a = np.asfortranarray(a0)
+    b = np.asfortranarray(b0)
+    ipiv = np.zeros(n, np.int32)
+    info = ctypes.c_int32(-7)
+    da, pda = _desc(n, n)
+    db, pdb = _desc(n, nrhs)
+    lib.pdgesv_(_iref(n), _iref(nrhs), _fptr(a), _iref(1), _iref(1), pda,
+                _fptr(ipiv), _fptr(b), _iref(1), _iref(1), pdb,
+                ctypes.byref(info))
+    assert info.value == 0
+    assert np.abs(b - x_true).max() < 1e-9
+    # LU + ipiv written in place: replay the solve through pdgetrs_
+    b2 = np.asfortranarray(b0.copy())
+    info2 = ctypes.c_int32(-7)
+    lib.pdgetrs_(_cref("N"), _iref(n), _iref(nrhs),
+                 _fptr(a), _iref(1), _iref(1), pda, _fptr(ipiv),
+                 _fptr(b2), _iref(1), _iref(1), pdb, ctypes.byref(info2))
+    assert info2.value == 0
+    assert np.abs(b2 - x_true).max() < 1e-9
+
+
+def test_pdpotrf_pdpotrs(lib):
+    rng = np.random.default_rng(3)
+    n = 48
+    g = rng.standard_normal((n, n))
+    a0 = g @ g.T + n * np.eye(n)
+    a = np.asfortranarray(a0)
+    info = ctypes.c_int32(-7)
+    da, pda = _desc(n, n)
+    lib.pdpotrf_(_cref("L"), _iref(n), _fptr(a), _iref(1), _iref(1), pda,
+                 ctypes.byref(info))
+    assert info.value == 0
+    l = np.tril(np.asarray(a))
+    assert np.abs(l @ l.T - a0).max() < 1e-10 * n
+    x_true = rng.standard_normal((n, 2))
+    b = np.asfortranarray(a0 @ x_true)
+    db, pdb = _desc(n, 2)
+    info2 = ctypes.c_int32(-7)
+    lib.pdpotrs_(_cref("L"), _iref(n), _iref(2), _fptr(a), _iref(1), _iref(1),
+                 pda, _fptr(b), _iref(1), _iref(1), pdb, ctypes.byref(info2))
+    assert info2.value == 0
+    assert np.abs(b - x_true).max() < 1e-9
+
+
+def test_pdsyev_and_pzheev(lib):
+    rng = np.random.default_rng(4)
+    n = 40
+    g = rng.standard_normal((n, n))
+    a0 = (g + g.T) / 2
+    a = np.asfortranarray(a0)
+    w = np.zeros(n)
+    z = np.asfortranarray(np.zeros((n, n)))
+    da, pda = _desc(n, n)
+    dz, pdz = _desc(n, n)
+    work = np.zeros(4)
+    info = ctypes.c_int32(-7)
+    # standard two-call pattern: lwork=-1 is a workspace query
+    lib.pdsyev_(_cref("V"), _cref("L"), _iref(n), _fptr(a), _iref(1), _iref(1),
+                pda, _fptr(w), _fptr(z), _iref(1), _iref(1), pdz,
+                _fptr(work), _iref(-1), ctypes.byref(info))
+    assert info.value == 0
+    lwork = int(work[0])
+    assert lwork >= 1
+    lib.pdsyev_(_cref("V"), _cref("L"), _iref(n), _fptr(a), _iref(1), _iref(1),
+                pda, _fptr(w), _fptr(z), _iref(1), _iref(1), pdz,
+                _fptr(work), _iref(lwork), ctypes.byref(info))
+    assert info.value == 0
+    assert np.abs(np.sort(w) - np.linalg.eigvalsh(a0)).max() < 1e-10
+    zn = np.asarray(z)
+    assert np.abs(a0 @ zn - zn * w).max() < 1e-9
+    # complex drop-in (pzheev_ has the extra rwork/lrwork slots)
+    ac0 = g + 1j * rng.standard_normal((n, n))
+    ac0 = (ac0 + ac0.conj().T) / 2
+    ac = np.asfortranarray(ac0.astype(np.complex128))
+    wz = np.zeros(n)
+    zz = np.asfortranarray(np.zeros((n, n), np.complex128))
+    rwork = np.zeros(4)
+    infoz = ctypes.c_int32(-7)
+    lib.pzheev_(_cref("V"), _cref("L"), _iref(n), _fptr(ac), _iref(1), _iref(1),
+                pda, _fptr(wz), _fptr(zz), _iref(1), _iref(1), pdz,
+                _fptr(work), _iref(4), _fptr(rwork), _iref(4),
+                ctypes.byref(infoz))
+    assert infoz.value == 0
+    assert np.abs(np.sort(wz) - np.linalg.eigvalsh(ac0)).max() < 1e-10
+
+
+def test_pdlange(lib):
+    rng = np.random.default_rng(5)
+    m, n = 32, 24
+    a = np.asfortranarray(rng.standard_normal((m, n)))
+    da, pda = _desc(m, n)
+    work = np.zeros(1)
+    lib.pdlange_.restype = ctypes.c_double
+    v = lib.pdlange_(_cref("I"), _iref(m), _iref(n), _fptr(a), _iref(1),
+                     _iref(1), pda, _fptr(work))
+    assert abs(v - np.abs(np.asarray(a)).sum(axis=1).max()) < 1e-12
+
+
+def test_pstrsm_f32(lib):
+    rng = np.random.default_rng(6)
+    n, nrhs = 32, 4
+    t = np.tril(rng.standard_normal((n, n))).astype(np.float32) + n * np.eye(n, dtype=np.float32)
+    b0 = rng.standard_normal((n, nrhs)).astype(np.float32)
+    b = np.asfortranarray(b0.copy())
+    ta = np.asfortranarray(t)
+    da, pda = _desc(n, n)
+    db, pdb = _desc(n, nrhs)
+    alpha = ctypes.byref(ctypes.c_float(1.0))
+    lib.pstrsm_(_cref("L"), _cref("L"), _cref("N"), _cref("N"),
+                _iref(n), _iref(nrhs), alpha,
+                _fptr(ta), _iref(1), _iref(1), pda,
+                _fptr(b), _iref(1), _iref(1), pdb)
+    assert np.abs(t @ b - b0).max() < 1e-3
