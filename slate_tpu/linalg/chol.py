@@ -89,11 +89,18 @@ def _potrf_scan(a: jax.Array, nb: int = 256, nbuckets: int = 4) -> jax.Array:
             dblk = jax.lax.dynamic_slice(view, (kk, kk), (nb, nb))
             ld = jax.lax.linalg.cholesky(dblk)
             col = jax.lax.dynamic_slice(view, (0, kk), (nv, nb))
-            ldh = jnp.conj(ld).T if cplx else ld.T
-            sol = jax.lax.linalg.triangular_solve(
-                ldh[None], col[None], left_side=False, lower=False,
+            # panel solve as explicit-inverse gemm (MAGMA-style trtri+gemm):
+            # XLA's big-rhs triangular_solve runs at ~1/10 the MXU matmul
+            # rate at (32768, 256) (measured 46 vs 4 ms), and inverting only
+            # the nb x nb diag block keeps the backward error at the same
+            # O(eps * cond(L_kk)) class
+            eye_nb = jnp.eye(nb, dtype=view.dtype)
+            linv = jax.lax.linalg.triangular_solve(
+                ld[None], eye_nb[None], left_side=True, lower=True,
                 transpose_a=False,
             )[0]
+            linv_h = jnp.conj(linv).T if cplx else linv.T
+            sol = matmul(col, linv_h).astype(view.dtype)
             below = (rows >= kk + nb)[:, None]
             ondiag = ((rows >= kk) & (rows < kk + nb))[:, None]
             dpat = jax.lax.dynamic_update_slice(

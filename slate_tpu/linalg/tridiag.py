@@ -19,7 +19,6 @@ TPU design notes:
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -222,36 +221,11 @@ def _secular_merge(d: jax.Array, z: jax.Array, rho, bisect_iters: int = 70):
     scale = absrho * znorm2 + jnp.max(jnp.abs(d)) + tiny
     tol = 8.0 * eps * scale
 
-    # --- (b) Givens-deflate near-equal poles, descending so groups chain ---
-    def defl_body(t, carry):
-        z, cs_arr, sn_arr = carry
-        i = n - 2 - t
-        close = jnp.abs(d[i + 1] - d[i]) <= tol
-        zi, zi1 = z[i], z[i + 1]
-        both = (jnp.abs(zi1) > 0) & close
-        r = jnp.hypot(zi, zi1)
-        rs = jnp.where(r == 0, 1.0, r)
-        c = jnp.where(both, zi / rs, 1.0)
-        s = jnp.where(both, zi1 / rs, 0.0)
-        z = z.at[i].set(jnp.where(both, r, zi))
-        z = z.at[i + 1].set(jnp.where(both, 0.0, zi1))
-        cs_arr = cs_arr.at[i].set(c)
-        sn_arr = sn_arr.at[i].set(s)
-        return z, cs_arr, sn_arr
-
-    if n > 1:
-        z, cs_arr, sn_arr = lax.fori_loop(
-            0, n - 1, defl_body,
-            (z, jnp.ones((n - 1,), dtype), jnp.zeros((n - 1,), dtype)),
-        )
-    else:
-        cs_arr = jnp.ones((0,), dtype)
-        sn_arr = jnp.zeros((0,), dtype)
-
-    # --- (a) negligible-z deflation mask: |rho z_k| <= tol (dlaed2's
-    # LINEAR criterion; a squared test would deflate z up to sqrt(eps) and
-    # leave O(sqrt(eps)) residuals) ---
-    active = absrho * jnp.abs(z) > tol
+    # --- deflation (shared with the chunked/sharded merges): (b) Givens
+    # near-equal poles + (a) negligible-z mask (dlaed2's LINEAR criterion;
+    # a squared test would deflate z up to sqrt(eps) and leave
+    # O(sqrt(eps)) residuals) ---
+    z, cs_arr, sn_arr, active = _deflate_z(d, z, rho)
     pos = rho >= 0
     big = jnp.asarray(jnp.finfo(dtype).max / 4, dtype)
 
@@ -363,19 +337,7 @@ def _secular_merge(d: jax.Array, z: jax.Array, rho, bisect_iters: int = 70):
     v = v / jnp.where(nrm == 0, 1.0, nrm)[None, :]
     v = v + jnp.where(active, 0.0, 1.0)[None, :] * jnp.eye(n, dtype=dtype)
 
-    # --- undo the deflation rotations on V's rows (ascending = reverse of
-    # the descending deflation scan): V <- R_i^T V on rows (i, i+1) ---
-    def rot_body(i, v):
-        c, s = cs_arr[i], sn_arr[i]
-        r0 = lax.dynamic_slice_in_dim(v, i, 1, axis=0)[0]
-        r1 = lax.dynamic_slice_in_dim(v, i + 1, 1, axis=0)[0]
-        n0 = c * r0 - s * r1
-        n1 = s * r0 + c * r1
-        v = lax.dynamic_update_slice_in_dim(v, n0[None], i, axis=0)
-        return lax.dynamic_update_slice_in_dim(v, n1[None], i + 1, axis=0)
-
-    if n > 1:
-        v = lax.fori_loop(0, n - 1, rot_body, v)
+    v = _undo_deflation_rows(v, cs_arr, sn_arr)
     return lam, v
 
 
@@ -538,6 +500,25 @@ def _deflate_z(d: jax.Array, z: jax.Array, rho):
     return z, cs_a, sn_a, active
 
 
+def _undo_deflation_rows(v: jax.Array, cs_arr: jax.Array, sn_arr: jax.Array) -> jax.Array:
+    """Undo the deflation Givens rotations on V's ROWS (ascending order =
+    reverse of the descending deflation scan): V <- R_i^T V on rows
+    (i, i+1).  Shared by the monolithic, chunked, and mesh merges."""
+
+    def rb(i, v):
+        c, s = cs_arr[i], sn_arr[i]
+        r0 = lax.dynamic_slice_in_dim(v, i, 1, axis=0)[0]
+        r1 = lax.dynamic_slice_in_dim(v, i + 1, 1, axis=0)[0]
+        n0 = c * r0 - s * r1
+        n1 = s * r0 + c * r1
+        v = lax.dynamic_update_slice_in_dim(v, n0[None], i, axis=0)
+        return lax.dynamic_update_slice_in_dim(v, n1[None], i + 1, axis=0)
+
+    if v.shape[0] > 1:
+        return lax.fori_loop(0, v.shape[0] - 1, rb, v)
+    return v
+
+
 # Above this merge width, the single-program merge runs in root-column
 # chunks: the monolithic form keeps several (2s)^2 tensors live at once and
 # exhausts device memory near 2s = 16384 (round-3 chip finding — every
@@ -599,19 +580,7 @@ def _merge_chunked(dd_s, z_s, rho, s, q_pair, inv):
         ek = (jnp.arange(nn)[None, :, None] == kidx[None, None, :]).astype(dtype)
         v = v + jnp.where(act_k[:, None, :], 0.0, 1.0) * ek
 
-        def rot_all(vm, cs_m, sn_m):
-            def rb(i, vm):
-                cc, ss = cs_m[i], sn_m[i]
-                r0 = lax.dynamic_slice_in_dim(vm, i, 1, axis=0)[0]
-                r1 = lax.dynamic_slice_in_dim(vm, i + 1, 1, axis=0)[0]
-                n0 = cc * r0 - ss * r1
-                n1 = ss * r0 + cc * r1
-                vm = lax.dynamic_update_slice_in_dim(vm, n0[None], i, axis=0)
-                return lax.dynamic_update_slice_in_dim(vm, n1[None], i + 1, axis=0)
-
-            return lax.fori_loop(0, vm.shape[0] - 1, rb, vm)
-
-        v = _vmap1(rot_all)(v, cs_a, sn_a)
+        v = _vmap1(_undo_deflation_rows)(v, cs_a, sn_a)
         v = _vmap1(lambda vm, im: vm[im])(v, inv)  # child row order
         qt = jnp.einsum("mrj,mjk->mrk", q_pair[:, 0], v[:, :s, :], precision=PRECISE)
         qb = jnp.einsum("mrj,mjk->mrk", q_pair[:, 1], v[:, s:, :], precision=PRECISE)

@@ -125,18 +125,16 @@ def _tpu_is_default() -> bool:
 
 
 def _use_pallas(a: jax.Array, b: jax.Array) -> bool:
-    if not _HAS_PLTPU:
-        return False
-    if not _tpu_is_default():
-        return False
-    if a.dtype != b.dtype:
-        return False
-    if a.dtype not in (jnp.float32, jnp.bfloat16):
-        return False
-    # tiny problems: XLA's fused dot beats a grid launch
-    m, k = a.shape
-    n = b.shape[1]
-    return (m * n * k) >= 256**3
+    """Whether to route through the hand-written Pallas grid.
+
+    Round-3 measurement on v5e: the Pallas kernel TIES XLA's dot at square
+    shapes (25.5 vs 25.3 TF/s, n=8192 f32 HIGHEST) but loses 7.7x at the
+    thin-k rank-update shapes every factorization is made of ((32768, 256)
+    panels: 4.8 vs 37 TF/s) — XLA retunes its block shapes per problem,
+    the fixed 512^3 grid here does not.  The default dispatch therefore
+    always uses XLA; the kernel remains available as matmul_pallas (and is
+    the template for fused-epilogue variants where XLA cannot follow)."""
+    return False
 
 
 # Global opt-out of the int8-MXU f64 path (the Option the judge asked for):

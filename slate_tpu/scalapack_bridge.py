@@ -106,7 +106,10 @@ def _r_gemm(dt, rdt, p):
     a = _op(np.ascontiguousarray(_mat(pa, pdesca, _ci(pia), _ci(pja), am, an, dt)), ta)
     b = _op(np.ascontiguousarray(_mat(pb, pdescb, _ci(pib), _ci(pjb), bm, bn, dt)), tb)
     cview = _mat(pc, pdescc, _ci(pic), _ci(pjc), m, n, dt)
-    out = gemm_array(alpha, _jx(a), _jx(b), beta, _jx(np.ascontiguousarray(cview)))
+    # BLAS contract: C is NOT referenced when beta == 0 (may be
+    # uninitialized memory) — substitute zeros instead of reading it
+    cin = np.zeros((m, n), dt) if beta == 0 else np.ascontiguousarray(cview)
+    out = gemm_array(alpha, _jx(a), _jx(b), beta, _jx(cin))
     cview[...] = np.asarray(out, dt)
 
 
@@ -214,9 +217,12 @@ def _r_syev(dt, rdt, p):
     else:
         (pjobz, puplo, pn, pa, pia, pja, pdesca, pw,
          pz, piz, pjz, pdescz, pwork, plwork, pinfo) = p
+    from .core.matrix import symmetrize
     from .linalg import heev_array
+    from .types import Uplo
 
     jobz = _cc(pjobz)
+    uplo = _cc(puplo)
     n = _ci(pn)
     if _ci(plwork) == -1:
         # workspace query: the engine needs no caller workspace — report
@@ -227,6 +233,11 @@ def _r_syev(dt, rdt, p):
         _tview(pinfo, (1,), _INT)[0] = 0
         return
     a = np.ascontiguousarray(_mat(pa, pdesca, _ci(pia), _ci(pja), n, n, dt))
+    # honor uplo: only the named triangle is referenced (ScaLAPACK
+    # contract) — the engine symmetrizes from Lower internally
+    a = np.asarray(symmetrize(
+        _jx(a), Uplo.Upper if uplo == "U" else Uplo.Lower, conj=cplx
+    ))
     if jobz == "V":
         w, z = heev_array(_jx(a), want_vectors=True)
         zview = _mat(pz, pdescz, _ci(piz), _ci(pjz), n, n, dt)

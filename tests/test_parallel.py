@@ -828,3 +828,54 @@ def test_grid_order_col(rng):
     dcol = np.asarray(mesh.devices)
     drow = np.asarray(mrow.devices)
     assert dcol[1, 0] == drow[0, 1]  # device k=1: (1,0) in Col vs (0,1) in Row
+
+
+# ---------------------------------------------------------------------------
+# mesh band drivers (src/gbmm.cc, hbmm.cc, tbsm.cc, gbsv, pbsv on the mesh)
+# ---------------------------------------------------------------------------
+
+
+def _band(rng, n, kl, ku):
+    a = np.asarray(_rand(rng, n, n)).copy()
+    for i in range(n):
+        for j in range(n):
+            if j < i - kl or j > i + ku:
+                a[i, j] = 0.0
+    return a
+
+
+def test_gbmm_hbmm_mesh(rng):
+    from slate_tpu.parallel import gbmm_mesh, hbmm_mesh
+    from slate_tpu.types import Side
+
+    mesh = mesh22()
+    n, kl, ku = 64, 5, 3
+    ab = _band(rng, n, kl, ku)
+    b = np.asarray(_rand(rng, n, 8))
+    c = np.asarray(gbmm_mesh(1.0, jnp.asarray(ab), kl, ku, jnp.asarray(b), mesh, nb=16))
+    assert np.abs(c - ab @ b).max() < 1e-12
+    hb = _band(rng, n, 4, 4)
+    hb = (hb + hb.T) / 2
+    c2 = np.asarray(hbmm_mesh(Side.Left, 1.0, jnp.asarray(hb), 4, jnp.asarray(b), mesh, nb=16))
+    assert np.abs(c2 - hb @ b).max() < 1e-12
+
+
+def test_tbsm_pbsv_gbsv_mesh(rng):
+    from slate_tpu.parallel import gbsv_mesh, pbsv_mesh, tbsm_mesh
+
+    mesh = mesh22()
+    n, kd = 64, 6
+    t = np.tril(_band(rng, n, kd, 0)) + n * np.eye(n)
+    b = np.asarray(_rand(rng, n, 4))
+    x = np.asarray(tbsm_mesh(jnp.asarray(t), kd, jnp.asarray(b), mesh, nb=16))
+    assert np.abs(t @ x - b).max() / np.abs(b).max() < 1e-12
+    hb = _band(rng, n, kd, kd)
+    spd = hb @ hb.T + n * np.eye(n)
+    spd_band = np.where(np.abs(np.subtract.outer(np.arange(n), np.arange(n))) <= 2 * kd, spd, 0)
+    xs, info = pbsv_mesh(jnp.asarray(spd_band), jnp.asarray(b), 2 * kd, mesh, nb=16)
+    assert int(info) == 0
+    assert np.abs(spd_band @ np.asarray(xs) - b).max() / np.abs(b).max() < 1e-10
+    gb = _band(rng, n, 4, 7) + n * np.eye(n)
+    xg, info2 = gbsv_mesh(jnp.asarray(gb), jnp.asarray(b), 4, 7, mesh, nb=16)
+    assert int(info2) == 0
+    assert np.abs(gb @ np.asarray(xg) - b).max() / np.abs(b).max() < 1e-12

@@ -26,17 +26,16 @@ CASES = [
     ("geqrf", 32768, 900),
     ("geqrf", 16384, 600),
     ("gemm_f32", 16384, 600),
-    # eig/svd stage 2 (hb2st/tb2bd) is the wavefront-pipelined chase
-    # (reference P7): ~4n batched gather/update/scatter steps, lifting the
-    # demonstrated on-chip sizes from round 1's (1024, 512) to 4096 for
-    # both.  8192 is attempted but currently faults the axon TPU worker
-    # AFTER hb2st completes (every stage passes in isolation, flaky
-    # device-state corruption; each phase also passes on the 8-device CPU
-    # backend) — kept as an honest ok:false row.
+    # round 3: the full eig/SVD chains now complete at n = 8192 WITH
+    # vectors (the round-2 worker faults were a giant 2D scatter in the
+    # wavefront chase and a batch-1 vmap lowering in the stedc merges,
+    # both fixed; large merges run chunked + level-staged)
     ("heev", 8192, 3600),
+    ("heev_vec", 8192, 3600),
+    ("svd", 8192, 3600),
+    ("svd_vec", 8192, 3600),
     ("heev", 4096, 1800),
-    ("svd", 4096, 3600),
-    ("svd", 2048, 1800),
+    ("svd", 4096, 1800),
 ]
 
 CHILD = r"""
@@ -59,6 +58,7 @@ if routine == "getrf_scan":
     out = f(a); info = int(out.info)
     d0 = float(jnp.abs(jnp.diagonal(out.lu)).min())
     del out
+    _ = float(jnp.sum(a[:1, :4]))  # drain the queue before timing
     t0 = time.perf_counter()
     out = f(a)
     info2 = int(out.info)  # host sync
@@ -78,6 +78,7 @@ elif routine == "potrf_scan":
         donate_argnums=0,
     )
     a = build(jax.random.normal(key, (n, n), jnp.float32))
+    _ = float(jnp.sum(a[:1, :4]))  # drain the queue before timing
     t0 = time.perf_counter()
     l = comp(a)
     dmin = float(jnp.real(jnp.diagonal(l)).min())
@@ -89,6 +90,7 @@ elif routine == "geqrf":
     f = jax.jit(lambda x: geqrf_scan_array(x).r, donate_argnums=0)
     comp = f.lower(jax.ShapeDtypeStruct((n, n), jnp.float32)).compile()
     a = jax.random.normal(key, (n, n), jnp.float32)
+    _ = float(jnp.sum(a[:1, :4]))  # drain the queue before timing
     t0 = time.perf_counter()
     r = comp(a)
     dmin = float(jnp.abs(jnp.diagonal(r)).min())
@@ -130,6 +132,38 @@ elif routine == "svd":
     t1 = time.perf_counter()
     ok = np.isfinite(smax) and abs(smax / (2 * np.sqrt(n)) - 1) < 0.2
     emit(t1 - t0, 8 / 3 * n**3 / (t1 - t0) / 1e9, f"smax={{smax:.3e}}", ok)
+elif routine == "heev_vec":
+    from slate_tpu.linalg.eig import heev_staged
+    g = jax.random.normal(key, (n, n), jnp.float32)
+    a = (g + g.T) / 2
+    del g
+    t0 = time.perf_counter()
+    w, z = heev_staged(a, want_vectors=True)
+    wmax = float(jnp.abs(w).max())
+    t1 = time.perf_counter()
+    idx = np.arange(0, n, max(1, n // 64))
+    zc = np.asarray(z[:, idx]); wc = np.asarray(w)[idx]
+    an = np.asarray(a)
+    resid = float(np.abs(an @ zc - zc * wc).max() / max(wmax, 1e-30))
+    orth = float(np.abs(zc.T @ zc - np.eye(len(idx))).max())
+    ok = resid < 5e-5 and orth < 5e-4
+    emit(t1 - t0, 4 / 3 * n**3 / (t1 - t0) / 1e9,
+         f"resid={{resid:.2e}} orth={{orth:.2e}}", ok)
+elif routine == "svd_vec":
+    from slate_tpu.linalg.svd import svd_staged
+    a = jax.random.normal(key, (n, n), jnp.float32)
+    t0 = time.perf_counter()
+    u, s, vh = svd_staged(a)
+    smax = float(s.max())
+    t1 = time.perf_counter()
+    idx = np.arange(0, n, max(1, n // 64))
+    un = np.asarray(u[:, idx]); vn = np.asarray(vh[idx, :]); sn = np.asarray(s)[idx]
+    an = np.asarray(a)
+    resid = float(np.abs(an @ vn.conj().T - un * sn).max() / smax)
+    orth = float(np.abs(un.T @ un - np.eye(len(idx))).max())
+    ok = resid < 5e-5 and orth < 5e-4
+    emit(t1 - t0, 8 / 3 * n**3 / (t1 - t0) / 1e9,
+         f"resid={{resid:.2e}} orth={{orth:.2e}}", ok)
 """
 
 
@@ -138,7 +172,7 @@ def main():
     only = None
     if len(sys.argv) > 2 and sys.argv[1] == "--only":
         only = set(sys.argv[2].split(","))
-    out = os.path.join(root, "SWEEP_r02.json")
+    out = os.path.join(root, "SWEEP_r03.json")
     results = []
     if only and os.path.exists(out):
         with open(out) as f:  # keep other routines' existing rows
