@@ -137,6 +137,10 @@ def _use_pallas(a: jax.Array, b: jax.Array) -> bool:
     return False
 
 
+# Ozaki dispatch thresholds (measured win region; see matmul() comment).
+_OZAKI_MIN_ELEMS = 8192**3 // 2
+_OZAKI_MIN_DIM = 4096
+
 # Global opt-out of the int8-MXU f64 path (the Option the judge asked for):
 # inside this context every matmul traces the XLA f32-pair emulation instead
 # of the Ozaki dispatch — per-call opt-out is precision=Precision.Emulated.
@@ -184,11 +188,15 @@ def matmul(
     if precision is None:
         precision = Precision.Highest if precise else Precision.Fast
     dt = jnp.result_type(a.dtype, b.dtype)
-    # size gate (mirrors _use_pallas): tiny products — panel matvecs in the
-    # qr/refine/eig inner loops — are latency-bound either way, and each
-    # Ozaki specialization costs 45 int GEMMs of compile; XLA's f64
-    # emulation is accurate and cheaper to build below the MXU-bound scale
-    big = a.shape[0] * a.shape[1] * b.shape[1] >= 256**3
+    # Ozaki win-region gate, set by measurement (v5e, round 3): XLA's f64
+    # emulation is far faster than its reputation at factorization shapes
+    # (m=n=4096: 178 GF/s at k=256 rising to 1.6 TF/s at k=4096, vs Ozaki
+    # 34 -> 440 GF/s — emulation wins everywhere there), while at
+    # m=n=k=8192 Ozaki reaches 4.6 TF/s vs ~1.4 TF/s emulated.  The digit
+    # split + f64 output epilogue are O(9 mn + 9(m+n)k) emulated work that
+    # only amortizes when every dimension is large.
+    m_, k_, n_ = a.shape[0], a.shape[1], b.shape[1]
+    big = m_ * k_ * n_ >= _OZAKI_MIN_ELEMS and min(m_, k_, n_) >= _OZAKI_MIN_DIM
     if (
         big
         and precision != Precision.Emulated
