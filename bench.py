@@ -136,23 +136,18 @@ def bench_getrf():
 N_F64 = 8192
 
 
-def bench_potrf_f64(emulated=False):
+def bench_potrf_f64():
     # the SCANNED form: its panels are explicit-inverse gemms, so every
     # O(n^3) flop is a matmul — which the dispatch routes to XLA's tuned
     # f64 emulation at these thin-k shapes (the recursive form's trsm base
     # cases fall to the wide emulated triangular_solve and crawl)
     from slate_tpu.linalg.chol import _potrf_scan
-    from slate_tpu.ops.matmul import f64_emulation
 
     n = N_F64
     g = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.float64)
     a = (g @ g.T) / n + 2 * jnp.eye(n, dtype=jnp.float64)
-    import contextlib
-
-    ctx = f64_emulation() if emulated else contextlib.nullcontext()
-    with ctx:
-        run = jax.jit(lambda x: jnp.sum(jnp.abs(jnp.diagonal(_potrf_scan(x)))))
-        t = _timeit_perturbed(run, a)
+    run = jax.jit(lambda x: jnp.sum(jnp.abs(jnp.diagonal(_potrf_scan(x)))))
+    t = _timeit_perturbed(run, a)
     return n**3 / 3.0 / t / 1e9
 
 
@@ -172,21 +167,15 @@ def bench_gemm_f64_emulated():
 
         @jax.jit
         def run(a, b):
+            # b as an argument — a 512MB closure constant stalls compile
             def body(i, carry):
                 acc, aa = carry
                 return acc + jnp.matmul(aa, b), aa + 1e-9
             acc, _ = jax.lax.fori_loop(0, 2, body, (jnp.zeros((N, N), jnp.float64), a))
             return jnp.sum(acc[:1])
 
-        float(run(a, b))  # compile + warm
-        best = float("inf")
-        for i in range(2):
-            ai = a + (i + 1) * 1e-9
-            _ = float(jnp.sum(ai[:1, :4]))  # drain
-            t0 = time.perf_counter()
-            float(run(ai, b))
-            best = min(best, time.perf_counter() - t0)
-    return 2.0 * N**3 * 2 / best / 1e9
+        t = _timeit_perturbed(run, a, b)
+    return 2.0 * N**3 * 2 / t / 1e9
 
 
 def bench_getrf_f64():
@@ -199,16 +188,16 @@ def bench_getrf_f64():
     return 2.0 * n**3 / 3.0 / t / 1e9
 
 
-def _timeit_perturbed(fn, a, reps=2):
-    """Best wall time with a PERTURBED input per rep (identical dispatches
-    are cached by the tunnel) and a queue drain before each timing."""
-    float(fn(a))  # compile + warm
+def _timeit_perturbed(fn, a, *rest, reps=2):
+    """Best wall time with a PERTURBED first input per rep (identical
+    dispatches are cached by the tunnel) and a queue drain per timing."""
+    float(fn(a, *rest))  # compile + warm
     best = float("inf")
     for i in range(reps):
         ai = a + (i + 1) * 1e-9
         _ = float(jnp.sum(ai[:1, :4]))  # drain
         t0 = time.perf_counter()
-        float(fn(ai))
+        float(fn(ai, *rest))
         best = min(best, time.perf_counter() - t0)
     return best
 

@@ -227,16 +227,16 @@ def trmm_array(
     if side == Side.Right:
         # B op(A) = (op(A)^T B^T)^T
         if op == Op.NoTrans:
-            out = trmm_array(Side.Left, uplo, Op.Trans, diag, alpha, a, b.T)
+            out = trmm_array(Side.Left, uplo, Op.Trans, diag, alpha, a, b.T, precision)
         elif op == Op.Trans:
-            out = trmm_array(Side.Left, uplo, Op.NoTrans, diag, alpha, a, b.T)
+            out = trmm_array(Side.Left, uplo, Op.NoTrans, diag, alpha, a, b.T, precision)
         else:  # ConjTrans: B A^H = (conj(A) B^T)^T
-            out = trmm_array(Side.Left, uplo, Op.NoTrans, diag, alpha, jnp.conj(a), b.T)
+            out = trmm_array(Side.Left, uplo, Op.NoTrans, diag, alpha, jnp.conj(a), b.T, precision)
         return out.T
     if op == Op.Trans:
-        return trmm_array(Side.Left, _other(uplo), Op.NoTrans, diag, alpha, a.T, b)
+        return trmm_array(Side.Left, _other(uplo), Op.NoTrans, diag, alpha, a.T, b, precision)
     if op == Op.ConjTrans:
-        return trmm_array(Side.Left, _other(uplo), Op.NoTrans, diag, alpha, jnp.conj(a).T, b)
+        return trmm_array(Side.Left, _other(uplo), Op.NoTrans, diag, alpha, jnp.conj(a).T, b, precision)
     core = _trmm_ll if uplo == Uplo.Lower else _trmm_lu
     return alpha * core(a, jnp.asarray(b), diag, precision)
 

@@ -11,9 +11,9 @@ MatrixStorage.hh:579-630, with zero runtime code).
 
 Dtype policy: bf16/f32 inputs hit the MXU directly, with the accumulation
 tier selected by ``types.Precision`` (single-pass bf16 / bf16x3 / bf16x9);
-f64 and complex128 route through the int8-MXU Ozaki scheme (ops/ozaki.py)
-on TPU — full f64 accuracy at ~4x the rate of XLA's f32-pair emulation —
-and fall back to ``jnp.matmul`` elsewhere.
+f64 and complex128 on TPU pick the faster of XLA's f32-pair emulation and
+the int8-MXU Ozaki scheme (ops/ozaki.py) PER SHAPE — both are f64-grade
+accurate; Ozaki only wins (and only engages) for huge square products.
 """
 
 from __future__ import annotations
@@ -179,12 +179,13 @@ def matmul(
     ``precision`` selects the accumulation tier (types.Precision); when
     None, ``precise`` maps to Highest/Fast for backward compatibility.
 
-    f64 (and complex128) on TPU route through the int8-MXU Ozaki scheme
-    (ops/ozaki.py) at full f64 accuracy — the TPU-native replacement for
-    the reference's vendor DGEMM/ZGEMM (internal_gemm.cc:634-692); pass
-    ``precision=Precision.Emulated`` to opt out and use XLA's ~1.3 TF/s
-    f32-pair emulation instead.  Fast-tier f64 uses the 6-slice split
-    (~2^-33 measured relative accuracy)."""
+    f64 (and complex128) on TPU dispatch to the faster of XLA's f32-pair
+    emulation and the int8-MXU Ozaki scheme (ops/ozaki.py) by shape —
+    both f64-grade; the Ozaki path only engages in its measured win
+    region (huge square products, see the gate below).  Pass
+    ``precision=Precision.Emulated`` to force emulation everywhere.
+    Fast-tier f64 uses the 6-slice split (~2^-33 measured accuracy) when
+    Ozaki engages."""
     if precision is None:
         precision = Precision.Highest if precise else Precision.Fast
     dt = jnp.result_type(a.dtype, b.dtype)

@@ -47,16 +47,25 @@ def _rand(rng, m, n, dtype):
     return a.astype(dtype)
 
 
-def _time(fn, *args, label: str = ""):
+def _sync(out):
+    """Force REAL execution: the axon tunnel defers programs and
+    block_until_ready does not block through it — only a host transfer
+    proves the work ran (one element is enough)."""
     import jax
 
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "ndim"):
+            jax.device_get(leaf[(0,) * leaf.ndim])
+            break
+    return out
+
+
+def _time(fn, *args, label: str = ""):
     from slate_tpu.utils.trace import Trace
 
-    out = fn(*args)  # warm/compile
-    jax.block_until_ready(out)
+    _sync(fn(*args))  # warm/compile (and drain the dispatch queue)
     t0 = time.perf_counter()
-    out = fn(*args)
-    jax.block_until_ready(out)
+    out = _sync(fn(*args))
     t1 = time.perf_counter()
     if Trace.enabled():
         Trace.add(label or getattr(fn, "__name__", "op"), 0, t0, t1)
