@@ -29,7 +29,10 @@ CASES = [
     # round 3: the full eig/SVD chains now complete at n = 8192 WITH
     # vectors (the round-2 worker faults were a giant 2D scatter in the
     # wavefront chase and a batch-1 vmap lowering in the stedc merges,
-    # both fixed; large merges run chunked + level-staged)
+    # both fixed; large merges run chunked + level-staged).  n = 16384
+    # heev was attempted and still faults the worker inside the
+    # he2hb/hb2st stage pair — the next scale step for round 4 (stedc
+    # itself passes at 16384 standalone)
     ("heev", 8192, 3600),
     ("heev_vec", 8192, 3600),
     ("svd", 8192, 3600),
