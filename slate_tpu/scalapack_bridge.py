@@ -310,3 +310,95 @@ def scalapack_call_ret(routine: str, tchar: str, *ptrs) -> float:
 
         print(f"slate_tpu scalapack {routine}: {e!r}", file=sys.stderr)
         return float("nan")
+
+
+def _r_gesvd(dt, rdt, p):
+    cplx = np.issubdtype(np.dtype(dt), np.complexfloating)
+    if cplx:  # p{c,z}gesvd append rwork
+        (pjobu, pjobvt, pm, pn, pa, pia, pja, pdesca, ps, pu, piu, pju,
+         pdescu, pvt, pivt, pjvt, pdescvt, pwork, plwork, prwork, pinfo) = p
+    else:
+        (pjobu, pjobvt, pm, pn, pa, pia, pja, pdesca, ps, pu, piu, pju,
+         pdescu, pvt, pivt, pjvt, pdescvt, pwork, plwork, pinfo) = p
+    from .linalg import svd_array
+
+    jobu, jobvt = _cc(pjobu), _cc(pjobvt)
+    m, n = _ci(pm), _ci(pn)
+    k = min(m, n)
+    if _ci(plwork) == -1:
+        _tview(pwork, (1,), rdt)[0] = 1
+        if cplx:
+            _tview(prwork, (1,), rdt)[0] = 1
+        _tview(pinfo, (1,), _INT)[0] = 0
+        return
+    a = np.ascontiguousarray(_mat(pa, pdesca, _ci(pia), _ci(pja), m, n, dt))
+    want = jobu == "V" or jobvt == "V"
+    if want:
+        u, sv, vt = svd_array(_jx(a), want_vectors=True)
+        if jobu == "V":
+            _mat(pu, pdescu, _ci(piu), _ci(pju), m, k, dt)[...] = np.asarray(u, dt)
+        if jobvt == "V":
+            _mat(pvt, pdescvt, _ci(pivt), _ci(pjvt), k, n, dt)[...] = np.asarray(vt, dt)
+    else:
+        sv = svd_array(_jx(a), want_vectors=False)
+    _tview(ps, (k,), rdt)[...] = np.asarray(sv, rdt)
+    _tview(pinfo, (1,), _INT)[0] = 0
+
+
+def _r_gels(dt, rdt, p):
+    (ptrans, pm, pn, pnrhs, pa, pia, pja, pdesca,
+     pb, pib, pjb, pdescb, pwork, plwork, pinfo) = p
+    from .linalg import gels_array
+
+    trans = _cc(ptrans)
+    m, n, nrhs = _ci(pm), _ci(pn), _ci(pnrhs)
+    if _ci(plwork) == -1:
+        _tview(pwork, (1,), rdt)[0] = 1
+        _tview(pinfo, (1,), _INT)[0] = 0
+        return
+    if trans != "N":
+        raise ValueError("p?gels drop-in supports trans='N' (minimize ||Ax-b||)")
+    a = np.ascontiguousarray(_mat(pa, pdesca, _ci(pia), _ci(pja), m, n, dt))
+    # ScaLAPACK requires descB to hold max(m, n) rows: the RHS occupies the
+    # top m, the (possibly longer, m < n min-norm) solution the top n
+    bview = _mat(pb, pdescb, _ci(pib), _ci(pjb), max(m, n), nrhs, dt)
+    x = gels_array(_jx(a), _jx(np.ascontiguousarray(bview[:m, :])))
+    bview[:n, :] = np.asarray(x, dt)[:n]
+    _tview(pinfo, (1,), _INT)[0] = 0
+
+
+def _r_syrk(dt, rdt, p):
+    (puplo, ptrans, pn, pk, palpha, pa, pia, pja, pdesca,
+     pbeta, pc, pic, pjc, pdescc) = p
+    from .blas3.blas3 import herk, syrk
+    from .types import Uplo
+
+    cplx = np.issubdtype(np.dtype(dt), np.complexfloating)
+    uplo = Uplo.Lower if _cc(puplo) == "L" else Uplo.Upper
+    trans = _cc(ptrans)
+    n, k = _ci(pn), _ci(pk)
+    am, an = (n, k) if trans == "N" else (k, n)
+    a = _op(np.ascontiguousarray(_mat(pa, pdesca, _ci(pia), _ci(pja), am, an, dt)), trans)
+    # p{c,z}herk alpha/beta are REAL scalars (zherk signature); only syrk's
+    # are of the matrix dtype
+    sdt = rdt if cplx else dt
+    alpha, beta = _cs(palpha, sdt), _cs(pbeta, sdt)
+    cview = _mat(pc, pdescc, _ci(pic), _ci(pjc), n, n, dt)
+    cin = np.zeros((n, n), dt) if beta == 0 else np.ascontiguousarray(cview)
+    fn = herk if cplx else syrk
+    out = fn(alpha, _jx(a), beta, _jx(cin), uplo)
+    # BLAS contract: only the uplo triangle is written; the caller's other
+    # triangle stays untouched (read it from the live view, never cin)
+    outn = np.asarray(out, dt)
+    tri = np.tril(outn) if uplo == Uplo.Lower else np.triu(outn)
+    other = np.tril(np.ascontiguousarray(cview), -1) if uplo == Uplo.Upper else np.triu(np.ascontiguousarray(cview), 1)
+    cview[...] = tri + other
+
+
+_SCALAPACK.update({
+    "gesvd": _r_gesvd,
+    "gels": _r_gels,
+    "syrk": _r_syrk,
+    "herk": _r_syrk,
+})
+_HAS_INFO.update({"gesvd", "gels"})

@@ -197,3 +197,55 @@ def test_pstrsm_f32(lib):
                 _fptr(ta), _iref(1), _iref(1), pda,
                 _fptr(b), _iref(1), _iref(1), pdb)
     assert np.abs(t @ b - b0).max() < 1e-3
+
+
+def test_pdgesvd_pdgels_pdsyrk(lib):
+    rng = np.random.default_rng(7)
+    m, n = 40, 32
+    a0 = rng.standard_normal((m, n))
+    a = np.asfortranarray(a0)
+    s = np.zeros(min(m, n))
+    u = np.asfortranarray(np.zeros((m, min(m, n))))
+    vt = np.asfortranarray(np.zeros((min(m, n), n)))
+    da, pda = _desc(m, n)
+    du, pdu = _desc(m, min(m, n))
+    dv, pdv = _desc(min(m, n), n)
+    work = np.zeros(4)
+    info = ctypes.c_int32(-7)
+    lib.pdgesvd_(_cref("V"), _cref("V"), _iref(m), _iref(n),
+                 _fptr(a), _iref(1), _iref(1), pda, _fptr(s),
+                 _fptr(u), _iref(1), _iref(1), pdu,
+                 _fptr(vt), _iref(1), _iref(1), pdv,
+                 _fptr(work), _iref(4), ctypes.byref(info))
+    assert info.value == 0
+    sref = np.linalg.svd(a0, compute_uv=False)
+    assert np.abs(s - sref).max() < 1e-10
+    rec = (np.asarray(u) * s) @ np.asarray(vt)
+    assert np.abs(rec - a0).max() < 1e-9
+
+    # least squares: m > n overdetermined
+    b0 = rng.standard_normal((m, 2))
+    b = np.asfortranarray(b0.copy())
+    db, pdb = _desc(m, 2)
+    info2 = ctypes.c_int32(-7)
+    lib.pdgels_(_cref("N"), _iref(m), _iref(n), _iref(2),
+                _fptr(a := np.asfortranarray(a0)), _iref(1), _iref(1), pda,
+                _fptr(b), _iref(1), _iref(1), pdb,
+                _fptr(work), _iref(4), ctypes.byref(info2))
+    assert info2.value == 0
+    xref, *_ = np.linalg.lstsq(a0, b0, rcond=None)
+    assert np.abs(np.asarray(b)[:n] - xref).max() < 1e-9
+
+    # syrk: C = alpha A A^T (lower)
+    k = 24
+    aa = np.asfortranarray(rng.standard_normal((n, k)))
+    c = np.asfortranarray(np.zeros((n, n)))
+    dA, pdA = _desc(n, k)
+    dC, pdC = _desc(n, n)
+    lib.pdsyrk_(_cref("L"), _cref("N"), _iref(n), _iref(k),
+                ctypes.byref(ctypes.c_double(1.5)),
+                _fptr(aa), _iref(1), _iref(1), pdA,
+                ctypes.byref(ctypes.c_double(0.0)),
+                _fptr(c), _iref(1), _iref(1), pdC)
+    ref = 1.5 * np.asarray(aa) @ np.asarray(aa).T
+    assert np.abs(np.tril(c) - np.tril(ref)).max() < 1e-11
