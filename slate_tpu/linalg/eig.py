@@ -23,6 +23,7 @@ TPU design:
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -183,7 +184,7 @@ class Hb2stFactors(NamedTuple):
     n: int
 
 
-def _wavefront_chase(ap, n, w, nsweeps, max_hops, one, facs):
+def _wavefront_chase(ap, n, w, nsweeps, max_hops, one, facs, s_lo=None, s_hi=None):
     """Shared wavefront scheduling harness for the bulge chases (hb2st and
     svd.tb2bd): hop (sweep j, hop t) touches only the 3w x 3w diagonal
     block at r0 = j + 1 + t*w, and two hops conflict iff their r0 differ
@@ -236,10 +237,44 @@ def _wavefront_chase(ap, n, w, nsweeps, max_hops, one, facs):
         return (ap, *fs)
 
     nsteps = 4 * (nsweeps - 1) + max_hops
-    return lax.fori_loop(0, nsteps, step_body, (ap, *facs))
+    return lax.fori_loop(s_lo if s_lo is not None else 0,
+                         s_hi if s_hi is not None else nsteps,
+                         step_body, (ap, *facs))
 
 
-def hb2st(band: Array, w: int = _EIG_NB):
+# Empirical worker per-program ceiling: the fused wavefront chase faults
+# past this n; segmented dispatch (below) is the escape hatch.
+_CHASE_SEGMENT_ABOVE = 8192
+
+
+def _chase_segments(n: int) -> int:
+    """Auto segment count for the staged drivers: 1 (fused) at or below
+    the validated ceiling, else ~one segment per 4096 rows."""
+    return 1 if n <= _CHASE_SEGMENT_ABOVE else max(2, n // 4096)
+
+
+def _wavefront_chase_segmented(ap, n, w, nsweeps, max_hops, one, facs, segments):
+    """Run the chase as ``segments`` jitted programs over step ranges,
+    state carried on device — bit-identical to the fused form (same
+    step_body, same order).  Keeps the step-count formula in ONE place for
+    both the eig (hb2st) and svd (tb2bd) chases."""
+    if segments <= 1:
+        return _wavefront_chase(ap, n, w, nsweeps, max_hops, one, facs)
+    nsteps = 4 * (nsweeps - 1) + max_hops
+    bounds = [nsteps * i // segments for i in range(segments)] + [nsteps]
+
+    @functools.partial(jax.jit, static_argnames=("lo", "hi"))
+    def _seg(ap, facs, lo, hi):
+        out = _wavefront_chase(ap, n, w, nsweeps, max_hops, one, facs, lo, hi)
+        return out[0], tuple(out[1:])
+
+    facs = tuple(facs)
+    for i in range(segments):
+        ap, facs = _seg(ap, facs, bounds[i], bounds[i + 1])
+    return (ap, *facs)
+
+
+def hb2st(band: Array, w: int = _EIG_NB, segments: int = 1):
     """Hermitian band (bandwidth w, dense storage) -> real tridiagonal
     (d, e) + reflectors for the back-transform.  Returns
     (d, e_real, factors, phases); eigvec lifting: z_band =
@@ -278,8 +313,11 @@ def hb2st(band: Array, w: int = _EIG_NB):
         return block, v, tau
 
     if n > 2:
-        ap, vs, taus = _wavefront_chase(
-            ap, n, w, nsweeps, max_hops, one, (vs, taus)
+        # segments > 1: one jitted program per step range (call hb2st
+        # EAGERLY to benefit) — the scale escape hatch for chases whose
+        # single program exceeds the worker's limits (cf. stedc_staged)
+        ap, vs, taus = _wavefront_chase_segmented(
+            ap, n, w, nsweeps, max_hops, one, (vs, taus), segments
         )
     at = ap[pad : pad + n, pad : pad + n]
     d = jnp.real(jnp.diagonal(at))
@@ -395,7 +433,11 @@ def heev_staged(
     if n == 1:
         return heev_array(a, want_vectors, method, nb)
     f1 = jax.jit(he2hb, static_argnums=1)(a, nb)
-    d, e, f2, phases = jax.jit(hb2st, static_argnums=1)(f1.band, nb)
+    segs = _chase_segments(n)
+    if segs > 1:  # segmented chase must dispatch eagerly
+        d, e, f2, phases = hb2st(f1.band, nb, segments=segs)
+    else:
+        d, e, f2, phases = jax.jit(hb2st, static_argnums=(1, 2))(f1.band, nb)
     if not want_vectors:
         return jax.jit(_vals)(d, e)
     if method == MethodEig.DC:

@@ -175,7 +175,7 @@ class Tb2bdFactors(NamedTuple):
     n: int
 
 
-def tb2bd(band: Array, w: int = _SVD_NB):
+def tb2bd(band: Array, w: int = _SVD_NB, segments: int = 1):
     """Upper-band (bandwidth w) square matrix -> upper bidiagonal (d, e),
     plus reflectors.  Chases each row's out-of-band tail down the band with
     alternating right/left Householders.
@@ -184,7 +184,7 @@ def tb2bd(band: Array, w: int = _SVD_NB):
     gather/scatter harness are eig._wavefront_chase; per hop the in-block
     update is one right Householder eliminating a row tail followed by one
     left Householder eliminating the created column bulge."""
-    from .eig import _wavefront_chase
+    from .eig import _wavefront_chase_segmented
 
     n = band.shape[0]
     dtype = band.dtype
@@ -219,8 +219,8 @@ def tb2bd(band: Array, w: int = _SVD_NB):
         return block, vr, taur, vl, taul
 
     if n > 1:
-        ap, rvs, rtaus, lvs, ltaus = _wavefront_chase(
-            ap, n, w, nsweeps, max_hops, one, (rvs, rtaus, lvs, ltaus)
+        ap, rvs, rtaus, lvs, ltaus = _wavefront_chase_segmented(
+            ap, n, w, nsweeps, max_hops, one, (rvs, rtaus, lvs, ltaus), segments
         )
     at = ap[pad : pad + n, pad : pad + n]
     d = jnp.diagonal(at)
@@ -336,7 +336,13 @@ def svd_staged(a: Array, want_vectors: bool = True, nb: int = _SVD_NB):
         return jnp.conj(vh).T, s, jnp.conj(u).T
     f1 = jax.jit(ge2tb, static_argnums=1)(a, nb)
     band = f1.band[:n, :n]
-    d, e, f2, pu, pv = jax.jit(tb2bd, static_argnums=1)(band, nb)
+    from .eig import _chase_segments
+
+    segs = _chase_segments(n)
+    if segs > 1:  # segmented chase must dispatch eagerly
+        d, e, f2, pu, pv = tb2bd(band, nb, segments=segs)
+    else:
+        d, e, f2, pu, pv = jax.jit(tb2bd, static_argnums=(1, 2))(band, nb)
     if not want_vectors:
         return jax.jit(bdsqr, static_argnums=2)(d, e, False)
     from .eig import _chase_sweep_apply

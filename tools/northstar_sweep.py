@@ -29,14 +29,16 @@ CASES = [
     # round 3: the full eig/SVD chains now complete at n = 8192 WITH
     # vectors (the round-2 worker faults were a giant 2D scatter in the
     # wavefront chase and a batch-1 vmap lowering in the stedc merges,
-    # both fixed; large merges run chunked + level-staged).  n = 16384
-    # heev was attempted and still faults the worker inside the
-    # he2hb/hb2st stage pair — the next scale step for round 4 (stedc
-    # itself passes at 16384 standalone)
+    # both fixed; large merges run chunked + level-staged)
     ("heev", 8192, 3600),
     ("heev_vec", 8192, 3600),
     ("svd", 8192, 3600),
     ("svd_vec", 8192, 3600),
+    # n = 16384 heev: unlocked late in round 3 by SEGMENTING the wavefront
+    # chase (one jitted program per step range) — the fused chase's step
+    # count, not any single op, was what killed the worker past 8192.
+    # svd 16384 still faults (ge2tb or the 2n = 32768 GK solve) — round 4.
+    ("heev", 16384, 5400),
     ("heev", 4096, 1800),
     ("svd", 4096, 1800),
 ]
