@@ -527,21 +527,14 @@ _CHUNK_AT = 16384
 _CHUNK_COLS = 2048
 
 
-def _merge_chunked(dd_s, z_s, rho, s, q_pair, inv):
-    """One merge level evaluated in root-column chunks with bounded peak
-    memory: deflation + root finding + zhat as vector passes, then per
-    chunk the (2s x cols) eigenvector slab, its deflation rotations, the
-    child-order row unsort, and the block-diagonal assembly write.  Shapes:
-    dd_s/z_s (m, 2s) sorted-pole; q_pair (m, 2, s_rows, s); inv (m, 2s).
-    Returns (lam (m, 2s), q_new (m, 2*s_rows, 2s))."""
-    m, nn = dd_s.shape
-    dtype = dd_s.dtype
-    tiny = jnp.finfo(dtype).tiny
+def _merge_chunk_prep(dd_s, z_s, rho):
+    """Shared chunked-merge prelude: deflation, chunked secular roots, and
+    chunked zhat, all with (2s/chunks x 2s) peak tensors.  Returns
+    (zf, cs_a, sn_a, active, lam, lam_anch_d, mu_all, zhat, nch, cols)."""
+    nn = dd_s.shape[1]
     zf, cs_a, sn_a, active = _vmap1(_deflate_z)(dd_s, z_s, rho)
-
     nch = max(1, nn // _CHUNK_COLS)
     cols = nn // nch
-    # pass 1: converged roots, chunk by chunk
     mus, aidxs = [], []
     for ci in range(nch):
         kidx = ci * cols + jnp.arange(cols)
@@ -554,8 +547,6 @@ def _merge_chunked(dd_s, z_s, rho, s, q_pair, inv):
     aidx_all = jnp.concatenate(aidxs, axis=1)
     lam_anch_d = jnp.take_along_axis(dd_s, aidx_all, axis=1)
     lam = lam_anch_d + mu_all
-
-    # pass 2: zhat, pole chunk by pole chunk
     zhs = []
     for ci in range(nch):
         kidx = ci * cols + jnp.arange(cols)
@@ -564,30 +555,68 @@ def _merge_chunked(dd_s, z_s, rho, s, q_pair, inv):
         )(dd_s, zf, rho, active, lam_anch_d, mu_all)
         zhs.append(zh_c)
     zhat = jnp.concatenate(zhs, axis=1)
+    return zf, cs_a, sn_a, active, lam, lam_anch_d, mu_all, zhat, nch, cols
 
-    # pass 3: eigenvector slab + assembly per chunk
+
+def _merge_chunk_v(dd_s, lam_anch_d, mu_all, zhat, active, cs_a, sn_a, inv, kidx):
+    """Eigenvector slab for root columns ``kidx`` (child row order)."""
+    dtype = dd_s.dtype
+    nn = dd_s.shape[1]
+    tiny = jnp.finfo(dtype).tiny
+    den = (dd_s[:, :, None] - lam_anch_d[:, None, kidx]) - mu_all[:, None, kidx]
+    den = jnp.where(den == 0, tiny, den)
+    v = zhat[:, :, None] / den
+    act_k = active[:, kidx]
+    v = jnp.where(act_k[:, None, :], v, 0.0)
+    nrm = jnp.sqrt(jnp.sum(v * v, axis=1))
+    v = v / jnp.where(nrm == 0, 1.0, nrm)[:, None, :]
+    ek = (jnp.arange(nn)[None, :, None] == kidx[None, None, :]).astype(dtype)
+    v = v + jnp.where(act_k[:, None, :], 0.0, 1.0) * ek
+    v = _vmap1(_undo_deflation_rows)(v, cs_a, sn_a)
+    return _vmap1(lambda vm, im: vm[im])(v, inv)  # child row order
+
+
+def _merge_chunked(dd_s, z_s, rho, s, q_pair, inv):
+    """One merge level evaluated in root-column chunks with bounded peak
+    memory: the shared prelude (_merge_chunk_prep) runs deflation + root
+    finding + zhat as vector passes, then per chunk the (2s x cols)
+    eigenvector slab is built (_merge_chunk_v) and consumed by the
+    block-diagonal assembly write.  Shapes: dd_s/z_s (m, 2s) sorted-pole;
+    q_pair (m, 2, s_rows, s); inv (m, 2s).  Returns (lam, q_new)."""
+    m, nn = dd_s.shape
+    dtype = dd_s.dtype
+    zf, cs_a, sn_a, active, lam, lam_anch_d, mu_all, zhat, nch, cols = (
+        _merge_chunk_prep(dd_s, z_s, rho)
+    )
     srows = q_pair.shape[2]
     q_new = jnp.zeros((m, 2 * srows, nn), dtype)
     for ci in range(nch):
         kidx = ci * cols + jnp.arange(cols)
-        den = (dd_s[:, :, None] - lam_anch_d[:, None, kidx]) - mu_all[:, None, kidx]
-        den = jnp.where(den == 0, tiny, den)
-        v = zhat[:, :, None] / den  # (m, nn, cols)
-        act_k = active[:, kidx]
-        v = jnp.where(act_k[:, None, :], v, 0.0)
-        nrm = jnp.sqrt(jnp.sum(v * v, axis=1))
-        v = v / jnp.where(nrm == 0, 1.0, nrm)[:, None, :]
-        ek = (jnp.arange(nn)[None, :, None] == kidx[None, None, :]).astype(dtype)
-        v = v + jnp.where(act_k[:, None, :], 0.0, 1.0) * ek
-
-        v = _vmap1(_undo_deflation_rows)(v, cs_a, sn_a)
-        v = _vmap1(lambda vm, im: vm[im])(v, inv)  # child row order
+        v = _merge_chunk_v(dd_s, lam_anch_d, mu_all, zhat, active, cs_a, sn_a, inv, kidx)
         qt = jnp.einsum("mrj,mjk->mrk", q_pair[:, 0], v[:, :s, :], precision=PRECISE)
         qb = jnp.einsum("mrj,mjk->mrk", q_pair[:, 1], v[:, s:, :], precision=PRECISE)
         q_new = lax.dynamic_update_slice(
             q_new, jnp.concatenate([qt, qb], axis=1).astype(dtype), (0, 0, ci * cols)
         )
     return lam, q_new
+
+
+def _merge_chunked_vals(dd_s, z_s, rho, s, top, bot, inv):
+    """Values-only wide merge with bounded memory: same prelude and slab
+    builder as _merge_chunked, but each chunk is reduced straight to its
+    top/bot boundary-row contribution and freed — no O((2s)^2) tensor is
+    ever live.  ``top``/``bot`` are the child boundary rows (m*2, s)."""
+    dtype = dd_s.dtype
+    zf, cs_a, sn_a, active, lam, lam_anch_d, mu_all, zhat, nch, cols = (
+        _merge_chunk_prep(dd_s, z_s, rho)
+    )
+    tops, bots = [], []
+    for ci in range(nch):
+        kidx = ci * cols + jnp.arange(cols)
+        v = _merge_chunk_v(dd_s, lam_anch_d, mu_all, zhat, active, cs_a, sn_a, inv, kidx)
+        tops.append(jnp.einsum("mj,mjk->mk", top[0::2], v[:, :s, :], precision=PRECISE))
+        bots.append(jnp.einsum("mj,mjk->mk", bot[1::2], v[:, s:, :], precision=PRECISE))
+    return lam, jnp.concatenate(tops, axis=1).astype(dtype), jnp.concatenate(bots, axis=1).astype(dtype)
 
 
 _DC_SMALL = 32  # base-case size (reference stedc small-problem cutoff)
@@ -656,6 +685,13 @@ def _stedc_levels(d, e, want_q: bool):
         dd_s = jnp.take_along_axis(dd, order, axis=1)
         z_s = jnp.take_along_axis(z, order, axis=1)
         inv = jnp.argsort(order, axis=1)
+        if 2 * s >= _CHUNK_AT:
+            # wide merges: never materialize the O((2s)^2) eigenvector
+            # matrix the boundary rows contract against (faulted the
+            # worker at 2s = 32768 inside the n=16384 SVD's GK solve)
+            w, top, bot = _merge_chunked_vals(dd_s, z_s, rho, s, top, bot, inv)
+            s *= 2
+            continue
         lam, v_s = _vmap1(_secular_merge)(dd_s, z_s, rho)
         v = _vmap1(lambda vm, im: vm[im])(v_s, inv)  # child row order
         # eigencolumns stay in sorted-pole root order (parents re-sort

@@ -144,3 +144,21 @@ def test_segmented_chase_matches_fused(rng):
     o2 = tb2bd(jnp.asarray(ub), w, segments=4)
     np.testing.assert_array_equal(np.asarray(o1[0]), np.asarray(o2[0]))
     np.testing.assert_array_equal(np.asarray(o1[2].rvs), np.asarray(o2[2].rvs))
+
+
+def test_chunked_values_merge_matches_monolithic(rng, monkeypatch):
+    # the wide-merge values branch (2s >= _CHUNK_AT) must agree with the
+    # monolithic path it replaces — forced down to test scale
+    import slate_tpu.linalg.tridiag as tg
+
+    n = 300
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    w_ref = np.asarray(tg.stedc_vals(jnp.asarray(d), jnp.asarray(e)))
+    monkeypatch.setattr(tg, "_CHUNK_AT", 128)
+    monkeypatch.setattr(tg, "_CHUNK_COLS", 32)
+    w_chunk = np.asarray(tg.stedc_vals(jnp.asarray(d), jnp.asarray(e)))
+    T = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    wl = np.linalg.eigvalsh(T)
+    assert np.abs(w_chunk - wl).max() < 1e-11
+    assert np.abs(w_chunk - w_ref).max() < 1e-11
