@@ -53,18 +53,17 @@ def _arr(x: ArrayLike) -> jax.Array:
     return x.array if isinstance(x, BaseMatrix) else jnp.asarray(x)
 
 
-def _mul_prec(opts: Optional[Options], *operands: jax.Array) -> Precision:
+def _mul_prec(opts: Optional[Options]) -> Precision:
     """Precision tier for multiply-class drivers (gemm/hemm/trmm/...).
 
-    Default: Fast (native MXU) for f32/bf16 data, Highest for f64/complex —
-    matching the reference's vendor-native SGEMM speed while keeping full
-    accuracy where the dtype demands it.  Option.Precision overrides."""
+    Default: Highest for every dtype — the reference always runs
+    full-precision vendor GEMM (internal_gemm.cc:634), so f32 callers of
+    the drop-in API get SGEMM-class (2^-24) accuracy, not single-pass
+    bf16.  The faster reduced-accuracy tiers (Fast ~2^-8, High ~2^-16 on
+    f32 data) are explicit opt-ins via Option.Precision."""
     p = get_option(opts, Option.Precision, None) if opts else None
     if p is not None:
         return Precision(p)  # coerce "fast"-style string values to the enum
-    dt = jnp.result_type(*(o.dtype for o in operands))
-    if dt in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
-        return Precision.Fast
     return Precision.Highest
 
 
@@ -97,7 +96,7 @@ def gemm(alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, opts: Optional[O
     method.hh:35-45) is a scheduling choice the XLA partitioner makes from
     shardings; semantics are identical, so one entry point suffices."""
     aa, bb = _arr(a), _arr(b)
-    return _wrap_like(c, gemm_array(alpha, aa, bb, beta, _arr(c), precision=_mul_prec(opts, aa, bb)))
+    return _wrap_like(c, gemm_array(alpha, aa, bb, beta, _arr(c), precision=_mul_prec(opts)))
 
 
 def _side_mul(
@@ -113,7 +112,7 @@ def hemm(side: Side, alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, opts
     am = a if isinstance(a, BaseMatrix) else HermitianMatrix.from_array(a, Uplo.Lower)
     afull = symmetrize(am.data, am.uplo, conj=True)
     bb = _arr(b)
-    return _wrap_like(c, _side_mul(side, alpha, afull, bb, beta, _arr(c), precision=_mul_prec(opts, afull, bb)))
+    return _wrap_like(c, _side_mul(side, alpha, afull, bb, beta, _arr(c), precision=_mul_prec(opts)))
 
 
 def symm(side: Side, alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, opts: Optional[Options] = None):
@@ -121,7 +120,7 @@ def symm(side: Side, alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, opts
     am = a if isinstance(a, BaseMatrix) else SymmetricMatrix.from_array(a, Uplo.Lower)
     afull = symmetrize(am.data, am.uplo, conj=False)
     bb = _arr(b)
-    return _wrap_like(c, _side_mul(side, alpha, afull, bb, beta, _arr(c), precision=_mul_prec(opts, afull, bb)))
+    return _wrap_like(c, _side_mul(side, alpha, afull, bb, beta, _arr(c), precision=_mul_prec(opts)))
 
 
 def _rank_k_update(alpha, a: jax.Array, beta, c: ArrayLike, uplo: Uplo, conj: bool, two_sided_b: Optional[jax.Array] = None, precision: Optional[Precision] = None):
@@ -153,27 +152,27 @@ def herk(alpha, a: ArrayLike, beta, c: ArrayLike, uplo: Optional[Uplo] = None, o
     """slate::herk (src/herk.cc): C := alpha*A*A^H + beta*C, C Hermitian."""
     u = uplo or (c.uplo if isinstance(c, BaseMatrix) else Uplo.Lower)
     aa = _arr(a)
-    return _rank_k_update(alpha, aa, beta, c, u, conj=True, precision=_mul_prec(opts, aa))
+    return _rank_k_update(alpha, aa, beta, c, u, conj=True, precision=_mul_prec(opts))
 
 
 def syrk(alpha, a: ArrayLike, beta, c: ArrayLike, uplo: Optional[Uplo] = None, opts: Optional[Options] = None):
     """slate::syrk: C := alpha*A*A^T + beta*C, C symmetric."""
     u = uplo or (c.uplo if isinstance(c, BaseMatrix) else Uplo.Lower)
     aa = _arr(a)
-    return _rank_k_update(alpha, aa, beta, c, u, conj=False, precision=_mul_prec(opts, aa))
+    return _rank_k_update(alpha, aa, beta, c, u, conj=False, precision=_mul_prec(opts))
 
 
 def her2k(alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, uplo: Optional[Uplo] = None, opts: Optional[Options] = None):
     """slate::her2k: C := alpha*A*B^H + conj(alpha)*B*A^H + beta*C."""
     u = uplo or (c.uplo if isinstance(c, BaseMatrix) else Uplo.Lower)
     aa = _arr(a)
-    return _rank_k_update(alpha, aa, beta, c, u, conj=True, two_sided_b=_arr(b), precision=_mul_prec(opts, aa))
+    return _rank_k_update(alpha, aa, beta, c, u, conj=True, two_sided_b=_arr(b), precision=_mul_prec(opts))
 
 
 def syr2k(alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, uplo: Optional[Uplo] = None, opts: Optional[Options] = None):
     u = uplo or (c.uplo if isinstance(c, BaseMatrix) else Uplo.Lower)
     aa = _arr(a)
-    return _rank_k_update(alpha, aa, beta, c, u, conj=False, two_sided_b=_arr(b), precision=_mul_prec(opts, aa))
+    return _rank_k_update(alpha, aa, beta, c, u, conj=False, two_sided_b=_arr(b), precision=_mul_prec(opts))
 
 
 # ---------------------------------------------------------------------------
@@ -244,7 +243,7 @@ def trmm_array(
 def trmm(side: Side, alpha, a: ArrayLike, b: ArrayLike, opts: Optional[Options] = None):
     am = a if isinstance(a, BaseMatrix) else TriangularMatrix.from_array(a, Uplo.Lower)
     bb = _arr(b)
-    out = trmm_array(side, am.uplo, am.op, am.diag, alpha, am.data, bb, precision=_mul_prec(opts, am.data, bb))
+    out = trmm_array(side, am.uplo, am.op, am.diag, alpha, am.data, bb, precision=_mul_prec(opts))
     return _wrap_like(b, out)
 
 
@@ -252,6 +251,18 @@ def _trsm_left_lower_notrans(a: jax.Array, b: jax.Array, diag: Diag) -> jax.Arra
     """Solve L X = B, L lower triangular, recursive blocked."""
     n = a.shape[0]
     if n <= _NB:
+        if b.shape[1] > n:
+            # wide RHS: XLA's triangular_solve runs ~10x below the MXU
+            # matmul rate there (and far worse under f64 emulation), so
+            # invert the small triangle against eye (an n-wide solve) and
+            # ride one gemm — the same explicit-inverse panel trade as
+            # chol._potrf_scan, O(eps * cond(L11)) on a base block
+            eye = jnp.eye(n, dtype=a.dtype)
+            linv = jax.lax.linalg.triangular_solve(
+                a, eye, left_side=True, lower=True, transpose_a=False,
+                unit_diagonal=(diag == Diag.Unit),
+            )
+            return matmul(linv, b).astype(b.dtype)
         return jax.lax.linalg.triangular_solve(
             a, b, left_side=True, lower=True, transpose_a=False,
             unit_diagonal=(diag == Diag.Unit),
@@ -328,7 +339,7 @@ def gbmm(alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, opts: Optional[O
     am = a if isinstance(a, BaseMatrix) else None
     ad = band_project(_arr(a), am.kl, am.ku) if am is not None and am.kl is not None else _arr(a)
     bb = _arr(b)
-    return _wrap_like(c, gemm_array(alpha, ad, bb, beta, _arr(c), precision=_mul_prec(opts, ad, bb)))
+    return _wrap_like(c, gemm_array(alpha, ad, bb, beta, _arr(c), precision=_mul_prec(opts)))
 
 
 def hbmm(side: Side, alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, opts: Optional[Options] = None):
@@ -341,7 +352,7 @@ def hbmm(side: Side, alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, opts
     else:
         afull = symmetrize(_arr(a), Uplo.Lower, conj=True)
     bb = _arr(b)
-    return _wrap_like(c, _side_mul(side, alpha, afull, bb, beta, _arr(c), precision=_mul_prec(opts, afull, bb)))
+    return _wrap_like(c, _side_mul(side, alpha, afull, bb, beta, _arr(c), precision=_mul_prec(opts)))
 
 
 def tbsm(side: Side, alpha, a: ArrayLike, b: ArrayLike, pivots: Optional[jax.Array] = None):

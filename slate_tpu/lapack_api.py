@@ -13,11 +13,22 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .blas3.blas3 import gemm_array, trsm_array
+from .blas3.blas3 import (
+    gemm_array,
+    hemm as _hemm_drv,
+    her2k as _her2k_drv,
+    herk as _herk_drv,
+    symm as _symm_drv,
+    syr2k as _syr2k_drv,
+    syrk as _syrk_drv,
+    trmm_array,
+    trsm_array,
+)
 from .linalg import (
     gels_array,
     geqrf_array,
     gesv_array,
+    gesv_mixed_array,
     getrf_array,
     getri_array,
     getrs_array,
@@ -25,11 +36,14 @@ from .linalg import (
     hesv_array,
     norm,
     posv_array,
+    posv_mixed_array,
     potrf_array,
+    potri_array,
     potrs_array,
     svd_array,
 )
 from .linalg.norms import gecondest, pocondest
+from .ops.tile_ops import genorm as _genorm, henorm as _henorm, trnorm as _trnorm
 from .types import Diag, Norm, Op, Side, Uplo
 
 _DTYPES = {"s": jnp.float32, "d": jnp.float64, "c": jnp.complex64, "z": jnp.complex128}
@@ -100,10 +114,98 @@ def _make(prefix):
             alpha, _cast(dt, a), _cast(dt, b),
         )
 
+    def trmm(side, uplo, trans, diag, alpha, a, b):
+        # lapack_api/lapack_trmm.cc
+        return trmm_array(
+            Side.Left if side.upper() == "L" else Side.Right,
+            _uplo(uplo),
+            {"N": Op.NoTrans, "T": Op.Trans, "C": Op.ConjTrans}[trans.upper()],
+            Diag.Unit if diag.upper() == "U" else Diag.NonUnit,
+            alpha, _cast(dt, a), _cast(dt, b),
+        )
+
+    def _side(s):
+        return Side.Left if s.upper() == "L" else Side.Right
+
+    def hemm(side, uplo, alpha, a, b, beta, c):
+        # lapack_api/lapack_hemm.cc: C := alpha A B + beta C, A Hermitian
+        from .core.matrix import HermitianMatrix
+
+        am = HermitianMatrix.from_array(_cast(dt, a), _uplo(uplo))
+        return _hemm_drv(_side(side), alpha, am, _cast(dt, b), beta, _cast(dt, c))
+
+    def symm(side, uplo, alpha, a, b, beta, c):
+        # lapack_api/lapack_symm.cc
+        from .core.matrix import SymmetricMatrix
+
+        am = SymmetricMatrix.from_array(_cast(dt, a), _uplo(uplo))
+        return _symm_drv(_side(side), alpha, am, _cast(dt, b), beta, _cast(dt, c))
+
+    def _rank_op(trans, a):
+        # LAPACK herk/syrk trans: 'N' uses A (n x k); 'T'/'C' uses A^T/A^H
+        ad = _cast(dt, a)
+        t = trans.upper()
+        if t == "N":
+            return ad
+        return jnp.conj(ad).T if t == "C" else ad.T
+
+    def herk(uplo, trans, alpha, a, beta, c):
+        # lapack_api/lapack_herk.cc: C := alpha op(A) op(A)^H + beta C
+        return _herk_drv(alpha, _rank_op(trans, a), beta, _cast(dt, c), _uplo(uplo))
+
+    def syrk(uplo, trans, alpha, a, beta, c):
+        return _syrk_drv(alpha, _rank_op(trans, a), beta, _cast(dt, c), _uplo(uplo))
+
+    def her2k(uplo, trans, alpha, a, b, beta, c):
+        # lapack_api/lapack_her2k.cc
+        return _her2k_drv(alpha, _rank_op(trans, a), _rank_op(trans, b), beta,
+                          _cast(dt, c), _uplo(uplo))
+
+    def syr2k(uplo, trans, alpha, a, b, beta, c):
+        return _syr2k_drv(alpha, _rank_op(trans, a), _rank_op(trans, b), beta,
+                          _cast(dt, c), _uplo(uplo))
+
+    def potri(l, uplo="L"):
+        # lapack_api/lapack_potri.cc: inverse from the Cholesky factor
+        return potri_array(_cast(dt, l), _uplo(uplo))
+
+    def gesv_mixed(a, b):
+        # lapack_api/lapack_gesv_mixed.cc (slate_dsgesv): f32 factor +
+        # f64 iterative refinement; returns (x, iters, info)
+        x, iters, info = gesv_mixed_array(_cast(dt, a), _cast(dt, b))
+        return x, int(iters), int(info)
+
+    def posv_mixed(a, b, uplo="L"):
+        x, iters, info = posv_mixed_array(_cast(dt, a), _cast(dt, b), _uplo(uplo))
+        return x, int(iters), int(info)
+
+    _NORMC = {"M": Norm.Max, "1": Norm.One, "O": Norm.One, "I": Norm.Inf,
+              "F": Norm.Fro, "E": Norm.Fro}
+
+    def lange(norm_char, a):
+        # lapack_api/lapack_lange.cc
+        return float(_genorm(_NORMC[norm_char.upper()], _cast(dt, a)))
+
+    def lanhe(norm_char, uplo, a):
+        # lapack_api/lapack_lanhe.cc (Hermitian, one stored triangle)
+        return float(_henorm(_NORMC[norm_char.upper()], _cast(dt, a), _uplo(uplo)))
+
+    lansy = lanhe  # lapack_lansy.cc: same abs-value structure
+
+    def lantr(norm_char, uplo, diag, a):
+        # lapack_api/lapack_lantr.cc
+        return float(_trnorm(
+            _NORMC[norm_char.upper()], _cast(dt, a), _uplo(uplo),
+            Diag.Unit if diag.upper() == "U" else Diag.NonUnit,
+        ))
+
     ns.update(
         gemm=gemm, gesv=gesv, getrf=getrf, getrs=getrs, getri=getri,
         posv=posv, potrf=potrf, potrs=potrs, geqrf=geqrf, gels=gels,
-        gesvd=gesvd, gecon=gecon, trsm=trsm,
+        gesvd=gesvd, gecon=gecon, trsm=trsm, trmm=trmm, hemm=hemm,
+        symm=symm, herk=herk, syrk=syrk, her2k=her2k, syr2k=syr2k,
+        potri=potri, gesv_mixed=gesv_mixed, posv_mixed=posv_mixed,
+        lange=lange, lanhe=lanhe, lansy=lansy, lantr=lantr,
     )
 
     if prefix in ("s", "d"):

@@ -138,8 +138,13 @@ def _use_pallas(a: jax.Array, b: jax.Array) -> bool:
 
 
 # Ozaki dispatch thresholds (measured win region; see matmul() comment).
-_OZAKI_MIN_ELEMS = 8192**3 // 2
-_OZAKI_MIN_DIM = 4096
+# Round-4 remeasure with the pair-epilogue: Ozaki beats XLA's f32-pair
+# emulation at EVERY shape with min dim >= 1024 and >= 2048^3 work
+# (2048^3: 180 vs 169 GF/s; (8192,1024,8192): 1145 vs 664; 4096^3:
+# 1106 vs 866; (8192,4096,8192): 2674 vs 1610; 8192^3: ~4700 vs ~1400),
+# so the gate now encodes that boundary.
+_OZAKI_MIN_ELEMS = 2048**3
+_OZAKI_MIN_DIM = 1024
 
 # Global opt-out of the int8-MXU f64 path (the Option the judge asked for):
 # inside this context every matmul traces the XLA f32-pair emulation instead
@@ -189,13 +194,12 @@ def matmul(
     if precision is None:
         precision = Precision.Highest if precise else Precision.Fast
     dt = jnp.result_type(a.dtype, b.dtype)
-    # Ozaki win-region gate, set by measurement (v5e, round 3): XLA's f64
-    # emulation is far faster than its reputation at factorization shapes
-    # (m=n=4096: 178 GF/s at k=256 rising to 1.6 TF/s at k=4096, vs Ozaki
-    # 34 -> 440 GF/s — emulation wins everywhere there), while at
-    # m=n=k=8192 Ozaki reaches 4.6 TF/s vs ~1.4 TF/s emulated.  The digit
-    # split + f64 output epilogue are O(9 mn + 9(m+n)k) emulated work that
-    # only amortizes when every dimension is large.
+    # Ozaki win-region gate, set by measurement (v5e, round 4, after the
+    # pair-epilogue rework): the split scheme now wins at every shape with
+    # min dim >= 1024 and >= 2048^3 multiply work (see the threshold
+    # constants above); XLA's f32-pair emulation keeps only the thin-k
+    # panel shapes (k < 1024), where the O(9(m+n)k) digit split and the
+    # per-element epilogue do not amortize.
     m_, k_, n_ = a.shape[0], a.shape[1], b.shape[1]
     big = m_ * k_ * n_ >= _OZAKI_MIN_ELEMS and min(m_, k_, n_) >= _OZAKI_MIN_DIM
     if (

@@ -10,6 +10,9 @@ broadcast, zero tag/lifetime bookkeeping).
 
 from __future__ import annotations
 
+import contextlib
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -28,16 +31,77 @@ PRECISE = lax.Precision.HIGHEST
 BUCKETS = 4
 
 
+# ---------------------------------------------------------------------------
+# Communication-volume audit (VERDICT r4 item 7).  Trace-time hooks: every
+# audited collective records its per-device payload bytes while a
+# ``comm_audit()`` context is active.  Shapes are static under jit, so the
+# traced operand size IS the per-execution payload; a ``lax.fori_loop`` body
+# traces exactly once, so the kernels wrap their loops in ``audit_scope``
+# with the trip count to recover totals.  The analogue of instrumenting the
+# reference's tileBcast/listReduce with byte counters (BaseMatrix.hh).
+# ---------------------------------------------------------------------------
+
+_AUDIT: Optional[list] = None
+_AUDIT_MULT = [1]
+
+
+@contextlib.contextmanager
+def comm_audit():
+    """Yield a list that fills with (op, payload_bytes, multiplicity)
+    records for every audited collective traced while active.  Callers
+    must ensure the target kernel actually re-traces (jax.clear_caches()
+    or a fresh shape) — a jit cache hit records nothing."""
+    global _AUDIT
+    old, _AUDIT = _AUDIT, []
+    try:
+        yield _AUDIT
+    finally:
+        _AUDIT = old
+
+
+@contextlib.contextmanager
+def audit_scope(mult):
+    """Multiply records inside by ``mult`` (enclosing loop trip count)."""
+    _AUDIT_MULT.append(_AUDIT_MULT[-1] * int(mult))
+    try:
+        yield
+    finally:
+        _AUDIT_MULT.pop()
+
+
+def _rec(op: str, x: jax.Array) -> None:
+    if _AUDIT is not None:
+        _AUDIT.append((op, int(x.size) * x.dtype.itemsize, _AUDIT_MULT[-1]))
+
+
+def psum_a(x: jax.Array, axis: str) -> jax.Array:
+    """Audited lax.psum."""
+    _rec(f"psum[{axis}]", x)
+    return lax.psum(x, axis)
+
+
+def all_gather_a(x: jax.Array, axis_name: str, **kw) -> jax.Array:
+    """Audited lax.all_gather (kw passes through, e.g. tensor ``axis=``)."""
+    _rec(f"all_gather[{axis_name}]", x)
+    return lax.all_gather(x, axis_name, **kw)
+
+
+def psum_scatter_a(x: jax.Array, axis_name: str, **kw) -> jax.Array:
+    """Audited lax.psum_scatter."""
+    _rec(f"psum_scatter[{axis_name}]", x)
+    return lax.psum_scatter(x, axis_name, **kw)
+
+
 def bcast_from_col(x: jax.Array, owner_col) -> jax.Array:
     """Broadcast ``x`` from mesh column ``owner_col`` to all columns
     (tileBcast along a process row, BaseMatrix.hh:1917)."""
     me = lax.axis_index(COL_AXIS)
-    return lax.psum(jnp.where(me == owner_col, x, jnp.zeros_like(x)), COL_AXIS)
+    return psum_a(jnp.where(me == owner_col, x, jnp.zeros_like(x)), COL_AXIS)
 
 
 def bcast_from_row(x: jax.Array, owner_row) -> jax.Array:
     me = lax.axis_index(ROW_AXIS)
-    return lax.psum(jnp.where(me == owner_row, x, jnp.zeros_like(x)), ROW_AXIS)
+    return psum_a(jnp.where(me == owner_row, x, jnp.zeros_like(x)), ROW_AXIS)
 
 
 def local_indices(p: int, q: int, mtl: int, ntl: int):
@@ -65,7 +129,7 @@ def bcast_diag_tile(
         t_loc, (k // p - roff, k // q - coff, 0, 0), (1, 1, nb, nb)
     )[0, 0]
     dtile = jnp.where(own, dtile, jnp.zeros_like(dtile))
-    return lax.psum(lax.psum(dtile, ROW_AXIS), COL_AXIS)
+    return psum_a(psum_a(dtile, ROW_AXIS), COL_AXIS)
 
 
 def bucket_plan(nt: int, p: int, q: int, nbuckets: int = BUCKETS):

@@ -99,7 +99,9 @@ def _potrf_jit(at, mesh, p, q, nt):
                     view, jnp.where(mine, newcol, pcol)[:, None], kc, axis=1
                 )
                 pan = bcast_from_col(jnp.where(below & mine, newcol, 0), k % q)
-                allpan = lax.all_gather(pan, ROW_AXIS, axis=0)
+                from .comm import all_gather_a
+
+                allpan = all_gather_a(pan, ROW_AXIS, axis=0)
                 # logical row j sits at local slot j // p - roff of its
                 # owner mesh row j % p; columns below the view's row cut
                 # (slot < 0 would wrap) are finished (j <= k) and zero
@@ -123,12 +125,15 @@ def _potrf_jit(at, mesh, p, q, nt):
         # The reference gets the same effect from its shrinking task DAG
         # (potrf.cc:94); lookahead overlap is XLA's async scheduling over
         # the per-step collectives.
+        from .comm import audit_scope
+
         for k0, k1, s0r, s0c in bucket_plan(nt, p, q):
             view = t_loc[s0r:, s0c:]
             i_log_v = r + (s0r + jnp.arange(mtl - s0r)) * p
             j_log_v = c + (s0c + jnp.arange(ntl - s0c)) * q
             step = step_on(i_log_v, j_log_v, s0r, s0c)
-            view = lax.fori_loop(k0, k1, step, view)
+            with audit_scope(k1 - k0):
+                view = lax.fori_loop(k0, k1, step, view)
             t_loc = t_loc.at[s0r:, s0c:].set(view)
 
         _, _, i_log, j_log = local_indices(p, q, mtl, ntl)

@@ -267,7 +267,9 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true):
             own_src = (src_t % p == r) & slot_ok
             vals = t_loc[jnp.minimum(src_t // p, mtl - 1), :, src_r, :]
             vals = jnp.where(own_src[:, None, None], vals, 0)
-            rows_data = lax.psum(vals, ROW_AXIS)
+            from .comm import psum_a
+
+            rows_data = psum_a(vals, ROW_AXIS)
             dst = jnp.minimum(pos, mglob - 1)
             dst_t, dst_r = dst // nb, dst % nb
             own_dst = (dst_t % p == r) & slot_ok
@@ -279,8 +281,11 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true):
             # ---- standard right-looking step on the pivoted panel ----
             return _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c), rowperm
 
+        from .comm import audit_scope
+
         rowperm0 = jnp.arange(mglob)
-        t_loc, rowperm = lax.fori_loop(0, nt, step, (t_loc, rowperm0))
+        with audit_scope(nt):
+            t_loc, rowperm = lax.fori_loop(0, nt, step, (t_loc, rowperm0))
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, rowperm[None], info[None, None]
 
@@ -362,8 +367,10 @@ def _pp_jit(at, mesh, p, q, nt, m_true):
                 absv = jnp.where(active, jnp.abs(colv), -1.0)
                 li = jnp.argmax(absv)
                 lv, lgid = absv[li], flat_gids[li]
-                gv = lax.all_gather(lv, ROW_AXIS)  # (p,)
-                gg = lax.all_gather(lgid, ROW_AXIS)
+                from .comm import all_gather_a
+
+                gv = all_gather_a(lv, ROW_AXIS)  # (p,)
+                gg = all_gather_a(lgid, ROW_AXIS)
                 maxv = jnp.max(gv)
                 # winner: max |v|; ties -> smallest global row (deterministic,
                 # matches the scan/recursive single-chip tie policy).  No
@@ -382,7 +389,9 @@ def _pp_jit(at, mesh, p, q, nt, m_true):
 
                 own_p, idx_p, vp = owner_val(piv)
                 own_g, idx_g, vg = owner_val(gcol)
-                rows2 = lax.psum(jnp.stack([vp, vg]), ROW_AXIS)  # (2, nb)
+                from .comm import psum_a
+
+                rows2 = psum_a(jnp.stack([vp, vg]), ROW_AXIS)  # (2, nb)
                 row_piv, row_gcol = rows2[0], rows2[1]
                 flat = flat.at[idx_p].set(jnp.where(own_p, row_gcol, flat[idx_p]))
                 flat = flat.at[idx_g].set(jnp.where(own_g, row_piv, flat[idx_g]))
@@ -397,9 +406,12 @@ def _pp_jit(at, mesh, p, q, nt, m_true):
                 flat = flat - mult[:, None] * urow[None, :]
                 return flat, piv_pos
 
-            flat, piv_pos = lax.fori_loop(
-                0, nb, colstep, (flat, jnp.zeros((nb,), flat_gids.dtype))
-            )
+            from .comm import audit_scope
+
+            with audit_scope(nb):
+                flat, piv_pos = lax.fori_loop(
+                    0, nb, colstep, (flat, jnp.zeros((nb,), flat_gids.dtype))
+                )
 
             # ---- apply the nb transpositions to the full rows (all column
             # blocks; the panel column is overwritten below) ----
@@ -425,7 +437,9 @@ def _pp_jit(at, mesh, p, q, nt, m_true):
             own_src = (src_t % p == r) & slot_ok
             vals = t_loc[jnp.minimum(src_t // p, mtl - 1), :, src_r, :]
             vals = jnp.where(own_src[:, None, None], vals, 0)
-            rows_data = lax.psum(vals, ROW_AXIS)
+            from .comm import psum_a
+
+            rows_data = psum_a(vals, ROW_AXIS)
             dst = jnp.minimum(pos, mglob - 1)
             dst_t, dst_r = dst // nb, dst % nb
             own_dst = (dst_t % p == r) & slot_ok
@@ -450,8 +464,11 @@ def _pp_jit(at, mesh, p, q, nt, m_true):
                 rowperm,
             )
 
+        from .comm import audit_scope
+
         rowperm0 = jnp.arange(mglob)
-        t_loc, rowperm = lax.fori_loop(0, nt, step, (t_loc, rowperm0))
+        with audit_scope(nt):
+            t_loc, rowperm = lax.fori_loop(0, nt, step, (t_loc, rowperm0))
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, rowperm[None], info[None, None]
 

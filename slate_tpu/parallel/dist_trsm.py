@@ -22,17 +22,23 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..types import Diag, Op, Uplo
+from ..types import Diag, MethodTrsm, Op, Side, Uplo, select_trsm_method
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 from .comm import (
     PRECISE,
+    all_gather_a,
+    audit_scope,
     bcast_diag_tile,
     bcast_from_col,
     bcast_from_row,
     local_indices,
+    psum_scatter_a,
     shard_map,
 )
+
+from typing import Optional
+
 
 def trsm_dist(
     a: DistMatrix,
@@ -40,9 +46,19 @@ def trsm_dist(
     uplo: Uplo = Uplo.Lower,
     op: Op = Op.NoTrans,
     diag: Diag = Diag.NonUnit,
+    method: Optional[MethodTrsm] = None,
 ) -> DistMatrix:
     """Solve op(A) X = B; A triangular-distributed, B distributed. X
-    overwrites B's layout (left side; alpha folded by callers)."""
+    overwrites B's layout (left side; alpha folded by callers).
+
+    ``method`` picks the communication schedule (slate::trsm's MethodTrsm,
+    method.hh:88-99): TrsmB broadcasts the A panel to B's owners each
+    step; TrsmA keeps A's tiles stationary — the solved X row is
+    replicated, A's owner column computes the update partials, and one
+    reduce-scatter over the column axis delivers each owner's tiles — the
+    win when B is far thinner than A.  None = auto-select by shape; the
+    TrsmA schedule covers op == NoTrans (transposed solves re-route
+    through TrsmB, whose transpose-gather already moves no A panel)."""
     p, q = mesh_shape(a.mesh)
     if b.grid != a.grid or b.nb != a.nb or b.mt != a.nt or b.m != a.n:
         raise ValueError(
@@ -50,10 +66,77 @@ def trsm_dist(
             f"B {b.m}x{b.n} nb={b.nb} grid={b.grid}"
         )
     a.require_diag_pad("trsm_dist")
-    xt = _trsm_jit(
-        a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag
-    )
+    if method is None:
+        method = select_trsm_method(Side.Left, b.mt, b.nt)
+    if method == MethodTrsm.TrsmA and op == Op.NoTrans:
+        xt = _trsm_a_jit(a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, diag)
+    else:
+        xt = _trsm_jit(
+            a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag
+        )
     return DistMatrix(tiles=xt, m=b.m, n=b.n, nb=b.nb, mesh=b.mesh)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
+def _trsm_a_jit(at, bt, mesh, p, q, nt, uplo, diag):
+    """Stationary-A left solve, op = NoTrans (slate::trsmA,
+    src/trsmA.cc semantics): per step the solved X row is all-gathered,
+    the update partials A[i,k] @ X[k,:] are computed only where A's
+    column-k tiles live, and a psum-scatter over the column axis hands
+    every device exactly its own block-cyclic update — A never moves."""
+    spec = P(ROW_AXIS, COL_AXIS)
+    eff_lower = uplo == Uplo.Lower
+    forward = eff_lower
+    unit = diag == Diag.Unit
+
+    def kernel(a_loc, b_loc):
+        mtl, ntl, nb, _ = a_loc.shape
+        ntl_b = b_loc.shape[1]
+        r, c, i_log, _ = local_indices(p, q, mtl, ntl)
+
+        def step(s, b_loc):
+            k = s if forward else nt - 1 - s
+            kr, kc = k // p, k // q
+
+            dtile = bcast_diag_tile(a_loc, k, p, q, nb)
+
+            # solve X[k,:] on the owning mesh row, write back
+            brow = lax.dynamic_slice_in_dim(b_loc, kr, 1, axis=0)[0]
+            xrow = lax.linalg.triangular_solve(
+                jnp.broadcast_to(dtile, brow.shape), brow,
+                left_side=True, lower=eff_lower, transpose_a=False,
+                unit_diagonal=unit,
+            )
+            mine_r = (r == k % p)
+            b_loc = lax.dynamic_update_slice_in_dim(
+                b_loc, jnp.where(mine_r, xrow, brow)[None], kr, axis=0
+            )
+            # replicate the solved row: every column of the mesh needs it
+            # to multiply against A's stationary column-k tiles
+            xrow = bcast_from_row(jnp.where(mine_r, xrow, 0), k % p)
+            xfull = all_gather_a(xrow, COL_AXIS, axis=0)  # (q, ntl_b, nb, nb)
+
+            # owner-computes: only mesh column k % q holds A[:, k]
+            remaining = (i_log > k) if forward else (i_log < k)
+            acol = lax.dynamic_slice_in_dim(a_loc, kc, 1, axis=1)[:, 0]
+            mine_c = (c == k % q)
+            acol = jnp.where(remaining[:, None, None] & mine_c, acol, 0)
+            part = jnp.einsum(
+                "iab,Jjbc->iJjac", acol, xfull, precision=PRECISE
+            )  # (mtl, q, ntl_b, nb, nb)
+            # reduce the partials over columns, scattering slice J to
+            # mesh column J (each device receives only its own tiles)
+            upd = psum_scatter_a(
+                part, COL_AXIS, scatter_dimension=1, tiled=False
+            )
+            return b_loc - upd.astype(b_loc.dtype)
+
+        with audit_scope(nt):
+            return lax.fori_loop(0, nt, step, b_loc)
+
+    return shard_map(
+        kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
+    )(at, bt)
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8))
@@ -109,14 +192,15 @@ def _trsm_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
                 arow = lax.dynamic_slice_in_dim(a_loc, kr, 1, axis=0)[0]
                 mine_r2 = (r == k % p)
                 arow = bcast_from_row(jnp.where(mine_r2, arow, 0), k % p)
-                allrow = lax.all_gather(arow, COL_AXIS, axis=0)  # (q,ntl,nb,nb)
+                allrow = all_gather_a(arow, COL_AXIS, axis=0)  # (q,ntl,nb,nb)
                 pan = opt(allrow[i_log % q, i_log // q])
                 pan = jnp.where(remaining[:, None, None], pan, 0)
 
             upd = jnp.einsum("iab,jbc->ijac", pan, xrow, precision=PRECISE)
             return b_loc - upd.astype(b_loc.dtype)
 
-        return lax.fori_loop(0, nt, step, b_loc)
+        with audit_scope(nt):
+            return lax.fori_loop(0, nt, step, b_loc)
 
     return shard_map(
         kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
@@ -194,14 +278,15 @@ def _trsm_right_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
                 acol = lax.dynamic_slice_in_dim(a_loc, kc, 1, axis=1)[:, 0]
                 mine_c2 = (c == k % q)
                 acol = bcast_from_col(jnp.where(mine_c2, acol, 0), k % q)
-                allcol = lax.all_gather(acol, ROW_AXIS, axis=0)  # (p,mtl,nb,nb)
+                allcol = all_gather_a(acol, ROW_AXIS, axis=0)  # (p,mtl,nb,nb)
                 arow = opt(allcol[j_log_b % p, j_log_b // p])
                 arow = jnp.where(remaining[:, None, None], arow, 0)
 
             upd = jnp.einsum("iab,jbc->ijac", xcol, arow, precision=PRECISE)
             return b_loc - upd.astype(b_loc.dtype)
 
-        return lax.fori_loop(0, nt, step, b_loc)
+        with audit_scope(nt):
+            return lax.fori_loop(0, nt, step, b_loc)
 
     return shard_map(
         kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False

@@ -268,10 +268,17 @@ def posv_mixed_mesh(
         return trsm_dist(l, y, Uplo.Lower, Op.ConjTrans)
 
     bd = from_dense(b, mesh, nb)
-    if int(info) != 0:  # factor failed: skip the refinement entirely
-        return to_dense(_astype_dist(bd, ad.tiles.dtype)), jnp.asarray(-1, jnp.int32), info
+    if int(info) != 0:  # factor failed: x is NaN so misuse fails loudly
+        return _nan_like_solution(bd, ad), jnp.asarray(-1, jnp.int32), info
     x, iters, conv = _ir_loop_mesh(ad, bd, lo_solve, max_iter)
     return to_dense(x), jnp.asarray(iters if conv else -1, jnp.int32), info
+
+
+def _nan_like_solution(bd: DistMatrix, ad: DistMatrix) -> jax.Array:
+    """NaN-filled x for a failed factor: a caller that ignores info/iters
+    cannot mistake the RHS for a solution (the reference leaves X
+    undefined; NaN is the loud functional equivalent)."""
+    return jnp.full((bd.m, bd.n), jnp.nan, ad.tiles.dtype)
 
 
 def gesv_mixed_mesh(
@@ -291,8 +298,8 @@ def gesv_mixed_mesh(
         return trsm_dist(lu, y, Uplo.Upper, Op.NoTrans)
 
     bd = from_dense(b, mesh, nb)
-    if int(info) != 0:  # singular factor: skip the refinement entirely
-        return to_dense(_astype_dist(bd, ad.tiles.dtype)), jnp.asarray(-1, jnp.int32), info
+    if int(info) != 0:  # singular factor: x is NaN so misuse fails loudly
+        return _nan_like_solution(bd, ad), jnp.asarray(-1, jnp.int32), info
     x, iters, conv = _ir_loop_mesh(ad, bd, lo_solve, max_iter)
     return to_dense(x), jnp.asarray(iters if conv else -1, jnp.int32), info
 

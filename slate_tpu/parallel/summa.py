@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..types import MethodGemm, select_gemm_method
 from .comm import PRECISE as _PRECISE
 from .comm import bcast_from_col as _bcast_from_col
 from .comm import bcast_from_row as _bcast_from_row
@@ -41,11 +42,18 @@ def gemm_summa(
     b: DistMatrix,
     beta=0.0,
     c: Optional[DistMatrix] = None,
+    method: Optional[MethodGemm] = None,
 ) -> DistMatrix:
     """C := alpha A B + beta C on block-cyclic tile stacks.
 
     Requires matching nb and mesh; k tile-grids agree because every
     DistMatrix pads its grid to lcm(p, q) multiples (dist.py).
+
+    ``method`` selects the stationary operand (slate::gemm's MethodGemm
+    dispatch, src/gemm.cc:72-86): GemmC is the k-loop broadcast pipeline
+    below; GemmA keeps A's tiles in place and reduces C — the win when
+    the output panel is tiny (method.hh:35-45).  None = auto-select from
+    the tile-grid shape, as the reference's select_algo does.
     """
     p, q = mesh_shape(a.mesh)
     if b.grid != (p, q) or b.nb != a.nb:
@@ -57,9 +65,63 @@ def gemm_summa(
     kt = a.nt
     if b.mt != kt:
         raise ValueError(f"inner tile grids mismatch: {a.nt} vs {b.mt}")
+    if method is None:
+        method = select_gemm_method(a.mt, b.nt, a.nt)
+    if method == MethodGemm.GemmA:
+        return _gemm_summa_a(alpha, a, b, beta, c)
     ctiles = None if c is None else c.tiles
     out_t = _summa_jit(a.tiles, b.tiles, ctiles, alpha, beta, a.mesh, p, q, kt)
     return DistMatrix(tiles=out_t, m=a.m, n=b.n, nb=a.nb, mesh=a.mesh)
+
+
+def _gemm_summa_a(alpha, a: DistMatrix, b: DistMatrix, beta, c) -> DistMatrix:
+    """Stationary-A SUMMA (slate::gemmA, src/gemmA.cc:1-60 semantics):
+    A's tiles never move; the (thin) B is replicated to every device with
+    two all_gathers, each device multiplies it against its OWN k-slabs of
+    A, and the per-column partial C contributions are summed with one
+    psum over the k mesh axis (the reference's listReduce of C,
+    gemmA.cc) — owner-selects its block-cyclic C tiles from the reduced
+    rows.  Total tile-gemm count equals GemmC's (no redundant compute);
+    communication is |B| replication + |C| reduction instead of |A|
+    broadcast, the win when C/B are output panels far thinner than A."""
+    p, q = mesh_shape(a.mesh)
+    ctiles = None if c is None else c.tiles
+    out_t = _summa_a_jit(a.tiles, b.tiles, ctiles, alpha, beta, a.mesh, p, q)
+    return DistMatrix(tiles=out_t, m=a.m, n=b.n, nb=a.nb, mesh=a.mesh)
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6, 7))
+def _summa_a_jit(at, bt, ct, alpha, beta, mesh, p, q):
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(a_loc, b_loc):
+        mtl, ktl, nb, _ = a_loc.shape
+        ktl_b, ntl_b = b_loc.shape[0], b_loc.shape[1]
+        cc = lax.axis_index(COL_AXIS)
+        from .comm import all_gather_a, psum_a
+
+        # replicate B: bfull[r', c', kappa, nu] = B(r' + p*kappa, c' + q*nu)
+        bfull = all_gather_a(b_loc, COL_AXIS, axis=0)        # (q, ktl_b, ntl_b, ...)
+        bfull = all_gather_a(bfull, ROW_AXIS, axis=0)        # (p, q, ktl_b, ntl_b, ...)
+        bfull = jnp.moveaxis(bfull, 2, 1)                    # (p, ktl_b, q, ntl_b, ...)
+        # my stationary k-slabs: logical k = cc + q*kappa
+        k_idx = cc + q * jnp.arange(ktl)
+        bsel = bfull[k_idx % p, k_idx // p]                  # (ktl, q, ntl_b, nb, nb)
+        # partial C for my rows x ALL columns from my A slabs only
+        part = jnp.einsum(
+            "ikab,kJjbc->iJjac", a_loc, bsel, precision=_PRECISE
+        )                                                     # (mtl, q, ntl_b, nb, nb)
+        # reduce partials over the k mesh axis; every device then selects
+        # its own block-cyclic column slice J == cc
+        full = psum_a(part, COL_AXIS)
+        return lax.dynamic_slice_in_dim(full, cc, 1, axis=1)[:, 0]
+
+    prod = shard_map(
+        kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
+    )(at, bt)
+    if ct is None:
+        return (alpha * prod).astype(at.dtype)
+    return (alpha * prod + beta * ct).astype(at.dtype)
 
 
 @functools.partial(jax.jit, static_argnums=(5, 6, 7, 8))
@@ -80,7 +142,10 @@ def _summa_jit(at, bt, ct, alpha, beta, mesh, p, q, kt):
             return acc + _local_outer(acol, brow, dtype)
 
         acc0 = jnp.zeros((mtl, ntl, nb, nb), dtype)
-        return lax.fori_loop(0, kt, step, acc0)
+        from .comm import audit_scope
+
+        with audit_scope(kt):
+            return lax.fori_loop(0, kt, step, acc0)
 
     prod = shard_map(
         kernel,

@@ -224,7 +224,8 @@ def _r_syev(dt, rdt, p):
     jobz = _cc(pjobz)
     uplo = _cc(puplo)
     n = _ci(pn)
-    if _ci(plwork) == -1:
+    # pzheev treats lwork == -1 OR lrwork == -1 as a workspace query
+    if _ci(plwork) == -1 or (cplx and _ci(plrwork) == -1):
         # workspace query: the engine needs no caller workspace — report
         # the minimal legal size and return without solving
         _tview(pwork, (1,), rdt)[0] = 1
@@ -367,38 +368,268 @@ def _r_gels(dt, rdt, p):
     _tview(pinfo, (1,), _INT)[0] = 0
 
 
-def _r_syrk(dt, rdt, p):
+def _write_tri(cview, outn, uplo):
+    """Write only the uplo triangle back (BLAS contract: the caller's
+    other triangle stays untouched — read it from the live view)."""
+    from .types import Uplo
+
+    tri = np.tril(outn) if uplo == Uplo.Lower else np.triu(outn)
+    other = (np.tril(np.ascontiguousarray(cview), -1) if uplo == Uplo.Upper
+             else np.triu(np.ascontiguousarray(cview), 1))
+    cview[...] = tri + other
+
+
+def _rank_k_body(dt, rdt, p, conj):
     (puplo, ptrans, pn, pk, palpha, pa, pia, pja, pdesca,
      pbeta, pc, pic, pjc, pdescc) = p
     from .blas3.blas3 import herk, syrk
     from .types import Uplo
 
-    cplx = np.issubdtype(np.dtype(dt), np.complexfloating)
     uplo = Uplo.Lower if _cc(puplo) == "L" else Uplo.Upper
     trans = _cc(ptrans)
     n, k = _ci(pn), _ci(pk)
     am, an = (n, k) if trans == "N" else (k, n)
     a = _op(np.ascontiguousarray(_mat(pa, pdesca, _ci(pia), _ci(pja), am, an, dt)), trans)
-    # p{c,z}herk alpha/beta are REAL scalars (zherk signature); only syrk's
-    # are of the matrix dtype
-    sdt = rdt if cplx else dt
+    # p{c,z}herk alpha/beta are REAL scalars (zherk signature); syrk's are
+    # of the matrix dtype
+    sdt = rdt if conj else dt
     alpha, beta = _cs(palpha, sdt), _cs(pbeta, sdt)
     cview = _mat(pc, pdescc, _ci(pic), _ci(pjc), n, n, dt)
     cin = np.zeros((n, n), dt) if beta == 0 else np.ascontiguousarray(cview)
-    fn = herk if cplx else syrk
+    fn = herk if conj else syrk
     out = fn(alpha, _jx(a), beta, _jx(cin), uplo)
-    # BLAS contract: only the uplo triangle is written; the caller's other
-    # triangle stays untouched (read it from the live view, never cin)
-    outn = np.asarray(out, dt)
-    tri = np.tril(outn) if uplo == Uplo.Lower else np.triu(outn)
-    other = np.tril(np.ascontiguousarray(cview), -1) if uplo == Uplo.Upper else np.triu(np.ascontiguousarray(cview), 1)
-    cview[...] = tri + other
+    _write_tri(cview, np.asarray(out, dt), uplo)
+
+
+def _r_syrk(dt, rdt, p):
+    # p?syrk (scalapack_syrk.cc): symmetric even for c/z (PCSYRK/PZSYRK)
+    _rank_k_body(dt, rdt, p, conj=False)
+
+
+def _r_herk(dt, rdt, p):
+    # p{c,z}herk (scalapack_herk.cc)
+    _rank_k_body(dt, rdt, p, conj=True)
+
+
+def _r_syr2k(dt, rdt, p, conj=False):
+    # p?syr2k / p{c,z}her2k (scalapack_syr2k.cc, scalapack_her2k.cc)
+    (puplo, ptrans, pn, pk, palpha, pa, pia, pja, pdesca,
+     pb, pib, pjb, pdescb, pbeta, pc, pic, pjc, pdescc) = p
+    from .blas3.blas3 import her2k, syr2k
+    from .types import Uplo
+
+    uplo = Uplo.Lower if _cc(puplo) == "L" else Uplo.Upper
+    trans = _cc(ptrans)
+    n, k = _ci(pn), _ci(pk)
+    am, an = (n, k) if trans == "N" else (k, n)
+    a = _op(np.ascontiguousarray(_mat(pa, pdesca, _ci(pia), _ci(pja), am, an, dt)), trans)
+    b = _op(np.ascontiguousarray(_mat(pb, pdescb, _ci(pib), _ci(pjb), am, an, dt)), trans)
+    alpha = _cs(palpha, dt)
+    # zher2k's beta is REAL; zsyr2k's is complex
+    beta = _cs(pbeta, rdt if conj else dt)
+    cview = _mat(pc, pdescc, _ci(pic), _ci(pjc), n, n, dt)
+    cin = np.zeros((n, n), dt) if beta == 0 else np.ascontiguousarray(cview)
+    fn = her2k if conj else syr2k
+    out = fn(alpha, _jx(a), _jx(b), beta, _jx(cin), uplo)
+    _write_tri(cview, np.asarray(out, dt), uplo)
+
+
+def _r_her2k(dt, rdt, p):
+    _r_syr2k(dt, rdt, p, conj=True)
+
+
+def _r_symm(dt, rdt, p, conj=False):
+    # p?symm / p{c,z}hemm (scalapack_symm.cc:24+, scalapack_hemm.cc:24-60)
+    (pside, puplo, pm, pn, palpha, pa, pia, pja, pdesca,
+     pb, pib, pjb, pdescb, pbeta, pc, pic, pjc, pdescc) = p
+    from .blas3.blas3 import hemm, symm
+    from .core.matrix import HermitianMatrix, SymmetricMatrix
+    from .types import Side, Uplo
+
+    side = Side.Left if _cc(pside) == "L" else Side.Right
+    uplo = Uplo.Lower if _cc(puplo) == "L" else Uplo.Upper
+    m, n = _ci(pm), _ci(pn)
+    na = m if side == Side.Left else n
+    alpha, beta = _cs(palpha, dt), _cs(pbeta, dt)
+    a = np.ascontiguousarray(_mat(pa, pdesca, _ci(pia), _ci(pja), na, na, dt))
+    b = np.ascontiguousarray(_mat(pb, pdescb, _ci(pib), _ci(pjb), m, n, dt))
+    cview = _mat(pc, pdescc, _ci(pic), _ci(pjc), m, n, dt)
+    cin = np.zeros((m, n), dt) if beta == 0 else np.ascontiguousarray(cview)
+    if conj:
+        out = hemm(side, alpha, HermitianMatrix.from_array(_jx(a), uplo),
+                   _jx(b), beta, _jx(cin))
+    else:
+        out = symm(side, alpha, SymmetricMatrix.from_array(_jx(a), uplo),
+                   _jx(b), beta, _jx(cin))
+    cview[...] = np.asarray(out, dt)
+
+
+def _r_hemm(dt, rdt, p):
+    _r_symm(dt, rdt, p, conj=True)
+
+
+def _r_trmm(dt, rdt, p):
+    # p?trmm (scalapack_trmm.cc): B := alpha op(A) B in place
+    (pside, puplo, pta, pdiag, pm, pn, palpha, pa, pia, pja, pdesca,
+     pb, pib, pjb, pdescb) = p
+    from .blas3.blas3 import trmm_array
+    from .types import Diag, Op, Side, Uplo
+
+    side = Side.Left if _cc(pside) == "L" else Side.Right
+    uplo = Uplo.Lower if _cc(puplo) == "L" else Uplo.Upper
+    opc = {"N": Op.NoTrans, "T": Op.Trans, "C": Op.ConjTrans}[_cc(pta)]
+    diag = Diag.Unit if _cc(pdiag) == "U" else Diag.NonUnit
+    m, n = _ci(pm), _ci(pn)
+    na = m if side == Side.Left else n
+    a = np.ascontiguousarray(_mat(pa, pdesca, _ci(pia), _ci(pja), na, na, dt))
+    bview = _mat(pb, pdescb, _ci(pib), _ci(pjb), m, n, dt)
+    out = trmm_array(side, uplo, opc, diag, _cs(palpha, dt), _jx(a),
+                     _jx(np.ascontiguousarray(bview)))
+    bview[...] = np.asarray(out, dt)
+
+
+def _r_potri(dt, rdt, p):
+    # p?potri (scalapack_potri.cc): inverse from the Cholesky factor,
+    # uplo triangle overwritten in place
+    puplo, pn, pa, pia, pja, pdesca, pinfo = p
+    from .linalg import potri_array
+    from .types import Uplo
+
+    uplo = Uplo.Lower if _cc(puplo) == "L" else Uplo.Upper
+    n = _ci(pn)
+    aview = _mat(pa, pdesca, _ci(pia), _ci(pja), n, n, dt)
+    af = np.ascontiguousarray(aview)
+    # LAPACK potri contract: INFO = i > 0 when factor diagonal i is zero
+    # (the inverse would be non-finite); do not overwrite A in that case
+    dz = np.flatnonzero(np.diagonal(af) == 0)
+    if dz.size:
+        _tview(pinfo, (1,), _INT)[0] = int(dz[0]) + 1
+        return
+    inv = potri_array(_jx(af), uplo)
+    _write_tri(aview, np.asarray(inv, dt), uplo)
+    _tview(pinfo, (1,), _INT)[0] = 0
+
+
+def _r_posv(dt, rdt, p):
+    # p?posv (scalapack_posv.cc): factor in place + solve
+    (puplo, pn, pnrhs, pa, pia, pja, pdesca, pb, pib, pjb, pdescb, pinfo) = p
+    from .linalg import posv_array
+    from .types import Uplo
+
+    uplo = Uplo.Lower if _cc(puplo) == "L" else Uplo.Upper
+    n, nrhs = _ci(pn), _ci(pnrhs)
+    aview = _mat(pa, pdesca, _ci(pia), _ci(pja), n, n, dt)
+    bview = _mat(pb, pdescb, _ci(pib), _ci(pjb), n, nrhs, dt)
+    x, f, info = posv_array(_jx(np.ascontiguousarray(aview)),
+                            _jx(np.ascontiguousarray(bview)), uplo)
+    _write_tri(aview, np.asarray(f, dt), uplo)
+    if int(info) == 0:
+        bview[...] = np.asarray(x, dt)
+    _tview(pinfo, (1,), _INT)[0] = int(info)
+
+
+def _r_getri(dt, rdt, p):
+    # p?getri (scalapack_getri.cc): inverse from pdgetrf's factors
+    (pn, pa, pia, pja, pdesca, pipiv, pwork, plwork, piwork, pliwork,
+     pinfo) = p
+    from .linalg.lu import LUFactors, getri_array
+
+    n = _ci(pn)
+    if _ci(plwork) == -1 or _ci(pliwork) == -1:  # workspace query
+        _tview(pwork, (1,), rdt)[0] = 1
+        _tview(piwork, (1,), _INT)[0] = 1
+        _tview(pinfo, (1,), _INT)[0] = 0
+        return
+    aview = _mat(pa, pdesca, _ci(pia), _ci(pja), n, n, dt)
+    af = np.ascontiguousarray(aview)
+    # LAPACK getri contract: INFO = i > 0 when U(i,i) is exactly zero
+    dz = np.flatnonzero(np.diagonal(af) == 0)
+    if dz.size:
+        _tview(pinfo, (1,), _INT)[0] = int(dz[0]) + 1
+        return
+    ipiv = _tview(pipiv, (n,), _INT)
+    perm = _ipiv_to_perm(ipiv, n)
+    f = LUFactors(lu=_jx(af), perm=_jx(perm), info=_jx(np.int32(0)))
+    aview[...] = np.asarray(getri_array(f), dt)
+    _tview(pinfo, (1,), _INT)[0] = 0
+
+
+def _r_sgesv(dt, rdt, p):
+    # pdsgesv / pzcgesv (scalapack_gesv_mixed.cc): f32-factor + f64
+    # iterative refinement; ITER < 0 signals the full-precision fallback
+    # (LAPACK dsgesv ITER semantics)
+    (pn, pnrhs, pa, pia, pja, pdesca, pipiv, pb, pib, pjb, pdescb,
+     px, pix, pjx, pdescx, piter, pinfo) = p
+    from .linalg.lu import getrf_array, getrs_array, gesv_array
+    from .linalg.refine import _refine_loop
+
+    n, nrhs = _ci(pn), _ci(pnrhs)
+    aview = _mat(pa, pdesca, _ci(pia), _ci(pja), n, n, dt)
+    bview = _mat(pb, pdescb, _ci(pib), _ci(pjb), n, nrhs, dt)
+    xview = _mat(px, pdescx, _ci(pix), _ci(pjx), n, nrhs, dt)
+    a = _jx(np.ascontiguousarray(aview))
+    b = _jx(np.ascontiguousarray(bview))
+    lo = np.complex64 if np.issubdtype(np.dtype(dt), np.complexfloating) else np.float32
+    f32 = getrf_array(a.astype(lo))
+    _tview(pipiv, (n,), _INT)[...] = _perm_to_ipiv(np.asarray(f32.perm))
+    x, iters, done = _refine_loop(a, b, lambda r: getrs_array(f32, r.astype(lo)), 30)
+    info = 0
+    if not bool(done):  # reference fallback: full-precision solve
+        x, f = gesv_array(a, b)
+        info = int(f.info)  # singular A must surface (LAPACK dsgesv INFO)
+        iters = -1
+    xview[...] = np.asarray(x, dt)
+    _tview(piter, (1,), _INT)[0] = int(iters)
+    _tview(pinfo, (1,), _INT)[0] = info
+
+
+def _r_lansy(dt, rdt, p, conj=False):
+    # p?lansy / p{c,z}lanhe (scalapack_lansy.cc, scalapack_lanhe.cc)
+    pnorm, puplo, pn, pa, pia, pja, pdesca, pwork = p
+    from .ops.tile_ops import henorm
+    from .types import Norm, Uplo
+
+    nc = _cc(pnorm)
+    norm = {"M": Norm.Max, "1": Norm.One, "O": Norm.One, "I": Norm.Inf,
+            "F": Norm.Fro, "E": Norm.Fro}[nc]
+    uplo = Uplo.Lower if _cc(puplo) == "L" else Uplo.Upper
+    n = _ci(pn)
+    a = np.ascontiguousarray(_mat(pa, pdesca, _ci(pia), _ci(pja), n, n, dt))
+    return float(henorm(norm, _jx(a), uplo))
+
+
+def _r_lantr(dt, rdt, p):
+    # p?lantr (scalapack_lantr.cc)
+    pnorm, puplo, pdiag, pm, pn, pa, pia, pja, pdesca, pwork = p
+    from .ops.tile_ops import trnorm
+    from .types import Diag, Norm, Uplo
+
+    nc = _cc(pnorm)
+    norm = {"M": Norm.Max, "1": Norm.One, "O": Norm.One, "I": Norm.Inf,
+            "F": Norm.Fro, "E": Norm.Fro}[nc]
+    uplo = Uplo.Lower if _cc(puplo) == "L" else Uplo.Upper
+    diag = Diag.Unit if _cc(pdiag) == "U" else Diag.NonUnit
+    m, n = _ci(pm), _ci(pn)
+    a = np.ascontiguousarray(_mat(pa, pdesca, _ci(pia), _ci(pja), m, n, dt))
+    return float(trnorm(norm, _jx(a), uplo, diag))
 
 
 _SCALAPACK.update({
     "gesvd": _r_gesvd,
     "gels": _r_gels,
     "syrk": _r_syrk,
-    "herk": _r_syrk,
+    "herk": _r_herk,
+    "syr2k": _r_syr2k,
+    "her2k": _r_her2k,
+    "symm": _r_symm,
+    "hemm": _r_hemm,
+    "trmm": _r_trmm,
+    "potri": _r_potri,
+    "posv": _r_posv,
+    "getri": _r_getri,
+    "sgesv": _r_sgesv,
+    "lansy": _r_lansy,
+    "lanhe": lambda dt, rdt, p: _r_lansy(dt, rdt, p, conj=True),
+    "lantr": _r_lantr,
 })
-_HAS_INFO.update({"gesvd", "gels"})
+_HAS_INFO.update({"gesvd", "gels", "potri", "posv", "getri", "sgesv"})

@@ -149,9 +149,9 @@ class Precision(enum.Enum):
     - ``Emulated``: opt out of the int8 Ozaki f64 path entirely and use
       XLA's f32-pair f64 emulation (~1.3 TF/s; debugging escape hatch).
 
-    Factorizations default to Highest; multiply-class drivers (gemm, hemm,
-    trmm, ...) default to Fast for f32/bf16 inputs and Highest for
-    f64/complex128 — pass Option.Precision to override either way.
+    Every driver defaults to Highest — matching the reference's
+    always-full-precision vendor BLAS — and the reduced tiers are
+    explicit opt-ins via Option.Precision.
     """
 
     Fast = "fast"

@@ -162,11 +162,11 @@ def run_gesv_mesh(n, dtype, rng, check, grid):
 
 
 def run_gemm(n, dtype, rng, check, precision=None):
-    """Times the gemm driver at its default tier (Fast for f32/bf16 — the
-    native-MXU rate, matching the reference's vendor SGEMM — Highest/Ozaki
-    for f64), or at an explicit --precision tier.  The --check gate uses a
-    tier-aware tolerance: Fast is single-pass bf16 (~2^-8 relative on
-    N(0,1) data), High is bf16x3 (~2^-16), Highest is ~f32 (3-eps style)."""
+    """Times the gemm driver at its default tier (Highest for every dtype,
+    matching the reference's full-precision vendor BLAS), or at an explicit
+    --precision tier.  The --check gate uses a tier-aware tolerance: Fast
+    is single-pass bf16 (~2^-8 relative on N(0,1) data), High is bf16x3
+    (~2^-16), Highest is ~f32 (3-eps style)."""
     import jax.numpy as jnp
     from slate_tpu.blas3.blas3 import _mul_prec
     from slate_tpu.ops.matmul import matmul
@@ -174,7 +174,7 @@ def run_gemm(n, dtype, rng, check, precision=None):
 
     a, b = _rand(rng, n, n, dtype), _rand(rng, n, n, dtype)
     aj, bj = jnp.asarray(a), jnp.asarray(b)
-    prec = precision or _mul_prec(None, aj, bj)
+    prec = precision or _mul_prec(None)
     c, t = _time(lambda x, y: matmul(x, y, precision=prec), aj, bj)
     gflops = 2 * n**3 / t / 1e9
     err = 0.0
@@ -373,8 +373,24 @@ def main(argv=None):
                         print(f"note: --precision {args.precision} ignored for "
                               f"mesh routine {routine}@{args.grid} (mesh kernels "
                               f"run their documented fixed tiers)", file=sys.stderr)
-                    err, t, gflops, ok = MESH_ROUTINES[routine](
-                        n, dtype, rng, check, args.grid)
+                    if args.trace:
+                        # collective-volume audit rides the trace flag
+                        # (VERDICT r4 item 7; full table: tools/comm_audit.py)
+                        import jax as _jax
+
+                        from slate_tpu.parallel.comm import comm_audit
+
+                        _jax.clear_caches()
+                        with comm_audit() as _comm_recs:
+                            err, t, gflops, ok = MESH_ROUTINES[routine](
+                                n, dtype, rng, check, args.grid)
+                        payload = sum(b * m for _, b, m in _comm_recs)
+                        execs = sum(m for _, _, m in _comm_recs)
+                        print(f"  comm: {payload:,} payload B/dev over "
+                              f"{execs:,} collective execs", file=sys.stderr)
+                    else:
+                        err, t, gflops, ok = MESH_ROUTINES[routine](
+                            n, dtype, rng, check, args.grid)
                     rname = routine + "@" + args.grid
                 elif routine == "gemm" and args.precision:
                     from slate_tpu.types import Precision

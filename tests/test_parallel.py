@@ -83,6 +83,46 @@ def test_gemm_summa_beta(rng):
     np.testing.assert_allclose(np.asarray(c), ref, rtol=1e-12, atol=1e-10)
 
 
+def test_gemm_summa_stationary_a(rng):
+    # GemmA (src/gemmA.cc): stationary-A schedule must agree with GemmC
+    # and numpy on thin-C shapes, where select_gemm_method auto-picks it
+    from slate_tpu.types import MethodGemm, select_gemm_method
+
+    mesh = mesh24()
+    m, k, n = 96, 128, 16
+    a, b, c0 = _rand(rng, m, k), _rand(rng, k, n), _rand(rng, m, n)
+    ad, bd = from_dense(a, mesh, 8), from_dense(b, mesh, 8)
+    cd = from_dense(c0, mesh, 8)
+    ref = 2.0 * np.asarray(a) @ np.asarray(b) - np.asarray(c0)
+    outs = {
+        meth: np.asarray(to_dense(gemm_summa(2.0, ad, bd, -1.0, cd, method=meth)))
+        for meth in (MethodGemm.GemmA, MethodGemm.GemmC)
+    }
+    for meth, out in outs.items():
+        np.testing.assert_allclose(out, ref, rtol=1e-12, atol=1e-10, err_msg=str(meth))
+    # thin output panel auto-selects the stationary-A path (method.hh:35-45)
+    assert select_gemm_method(m // 8, n // 8, k // 8) == MethodGemm.GemmA
+
+
+@pytest.mark.parametrize("uplo", [Uplo.Lower, Uplo.Upper])
+def test_trsm_dist_stationary_a(rng, uplo):
+    # TrsmA (src/trsmA.cc): stationary-A schedule, thin RHS
+    from slate_tpu.types import MethodTrsm, Side, select_trsm_method
+
+    mesh = mesh24()
+    n, nrhs = 96, 8
+    t = np.tril(np.asarray(_rand(rng, n, n))) + n * np.eye(n)
+    if uplo == Uplo.Upper:
+        t = t.T
+    b = _rand(rng, n, nrhs)
+    ad = from_dense(jnp.asarray(t), mesh, nb=8, diag_pad_one=True)
+    bd = from_dense(b, mesh, nb=8)
+    x = to_dense(trsm_dist(ad, bd, uplo, Op.NoTrans, method=MethodTrsm.TrsmA))
+    err = np.linalg.norm(t @ np.asarray(x) - np.asarray(b)) / np.linalg.norm(np.asarray(b))
+    assert err < 1e-12
+    assert select_trsm_method(Side.Left, n // 8, nrhs // 8) == MethodTrsm.TrsmA
+
+
 @pytest.mark.parametrize("n", [64, 100])
 def test_potrf_dist(rng, n):
     mesh = mesh24()
@@ -764,6 +804,21 @@ def test_gesv_mixed_mesh(rng):
     assert 0 <= int(iters) <= 3
     resid = np.abs(a @ np.asarray(x) - b).max() / (np.abs(a).max() * np.abs(np.asarray(x)).max() * n)
     assert resid < 1e-14, resid
+
+
+def test_posv_mixed_mesh_failed_factor_returns_nan(rng):
+    # non-SPD input: info != 0 and x is NaN-filled — a caller that skips
+    # the info check cannot mistake the RHS for a solution (ADVICE r3)
+    from slate_tpu.parallel import posv_mixed_mesh
+
+    mesh = mesh24()
+    n = 96
+    a = -np.eye(n)  # negative definite: f32 potrf must fail
+    b = np.asarray(_rand(rng, n, 2))
+    x, iters, info = posv_mixed_mesh(jnp.asarray(a), jnp.asarray(b), mesh, nb=16)
+    assert int(info) != 0
+    assert int(iters) == -1
+    assert np.all(np.isnan(np.asarray(x)))
 
 
 def test_getri_potri_mesh(rng):

@@ -249,3 +249,185 @@ def test_pdgesvd_pdgels_pdsyrk(lib):
                 _fptr(c), _iref(1), _iref(1), pdC)
     ref = 1.5 * np.asarray(aa) @ np.asarray(aa).T
     assert np.abs(np.tril(c) - np.tril(ref)).max() < 1e-11
+
+
+def test_pdsymm_pzhemm(lib):
+    # scalapack_symm.cc / scalapack_hemm.cc drop-ins
+    rng = np.random.default_rng(8)
+    n = 32
+    g = rng.standard_normal((n, n))
+    sy = (g + g.T) / 2
+    b0 = rng.standard_normal((n, n))
+    c = np.asfortranarray(rng.standard_normal((n, n)))
+    c0 = np.asarray(c).copy()
+    da, pda = _desc(n, n)
+    lib.pdsymm_(_cref("L"), _cref("L"), _iref(n), _iref(n),
+                ctypes.byref(ctypes.c_double(2.0)),
+                _fptr(a := np.asfortranarray(np.tril(sy))), _iref(1), _iref(1), pda,
+                _fptr(b := np.asfortranarray(b0)), _iref(1), _iref(1), pda,
+                ctypes.byref(ctypes.c_double(-1.0)),
+                _fptr(c), _iref(1), _iref(1), pda)
+    assert np.abs(np.asarray(c) - (2 * sy @ b0 - c0)).max() < 1e-11
+    # hemm, complex, right side, upper triangle stored
+    he = (g + 1j * rng.standard_normal((n, n)))
+    he = (he + he.conj().T) / 2
+    cz = np.asfortranarray(np.zeros((n, n), np.complex128))
+    ab = np.array([2.0 + 0j])
+    bz = np.array([0.0 + 0j])
+    lib.pzhemm_(_cref("R"), _cref("U"), _iref(n), _iref(n),
+                _fptr(ab),
+                _fptr(az := np.asfortranarray(np.triu(he))), _iref(1), _iref(1), pda,
+                _fptr(bzm := np.asfortranarray(b0.astype(np.complex128))), _iref(1), _iref(1), pda,
+                _fptr(bz),
+                _fptr(cz), _iref(1), _iref(1), pda)
+    assert np.abs(np.asarray(cz) - 2 * b0 @ he).max() < 1e-11
+
+
+def test_pdtrmm(lib):
+    rng = np.random.default_rng(9)
+    n, nrhs = 32, 5
+    t = np.tril(rng.standard_normal((n, n))) + n * np.eye(n)
+    b0 = rng.standard_normal((n, nrhs))
+    b = np.asfortranarray(b0.copy())
+    da, pda = _desc(n, n)
+    db, pdb = _desc(n, nrhs)
+    lib.pdtrmm_(_cref("L"), _cref("L"), _cref("N"), _cref("N"),
+                _iref(n), _iref(nrhs), ctypes.byref(ctypes.c_double(0.5)),
+                _fptr(ta := np.asfortranarray(t)), _iref(1), _iref(1), pda,
+                _fptr(b), _iref(1), _iref(1), pdb)
+    assert np.abs(np.asarray(b) - 0.5 * t @ b0).max() < 1e-11
+
+
+def test_pdsyr2k_pzher2k(lib):
+    rng = np.random.default_rng(10)
+    n, k = 32, 24
+    a0 = rng.standard_normal((n, k))
+    b0 = rng.standard_normal((n, k))
+    c = np.asfortranarray(np.zeros((n, n)))
+    dA, pdA = _desc(n, k)
+    dC, pdC = _desc(n, n)
+    lib.pdsyr2k_(_cref("L"), _cref("N"), _iref(n), _iref(k),
+                 ctypes.byref(ctypes.c_double(1.0)),
+                 _fptr(a := np.asfortranarray(a0)), _iref(1), _iref(1), pdA,
+                 _fptr(b := np.asfortranarray(b0)), _iref(1), _iref(1), pdA,
+                 ctypes.byref(ctypes.c_double(0.0)),
+                 _fptr(c), _iref(1), _iref(1), pdC)
+    ref = a0 @ b0.T + b0 @ a0.T
+    assert np.abs(np.tril(c) - np.tril(ref)).max() < 1e-11
+    # her2k: complex, alpha complex, beta REAL (zher2k signature)
+    az = (a0 + 1j * rng.standard_normal((n, k))).astype(np.complex128)
+    bz = (b0 + 1j * rng.standard_normal((n, k))).astype(np.complex128)
+    cz = np.asfortranarray(np.zeros((n, n), np.complex128))
+    alpha = np.array([1.0 + 0j])
+    lib.pzher2k_(_cref("L"), _cref("N"), _iref(n), _iref(k),
+                 _fptr(alpha),
+                 _fptr(azf := np.asfortranarray(az)), _iref(1), _iref(1), pdA,
+                 _fptr(bzf := np.asfortranarray(bz)), _iref(1), _iref(1), pdA,
+                 ctypes.byref(ctypes.c_double(0.0)),
+                 _fptr(cz), _iref(1), _iref(1), pdC)
+    refz = az @ bz.conj().T + bz @ az.conj().T
+    assert np.abs(np.tril(cz) - np.tril(refz)).max() < 1e-10
+
+
+def test_pdposv_pdpotri(lib):
+    rng = np.random.default_rng(11)
+    n = 32
+    g = rng.standard_normal((n, n))
+    a0 = g @ g.T + n * np.eye(n)
+    x_true = rng.standard_normal((n, 2))
+    a = np.asfortranarray(a0)
+    b = np.asfortranarray(a0 @ x_true)
+    da, pda = _desc(n, n)
+    db, pdb = _desc(n, 2)
+    info = ctypes.c_int32(-7)
+    lib.pdposv_(_cref("L"), _iref(n), _iref(2), _fptr(a), _iref(1), _iref(1),
+                pda, _fptr(b), _iref(1), _iref(1), pdb, ctypes.byref(info))
+    assert info.value == 0
+    assert np.abs(np.asarray(b) - x_true).max() < 1e-9
+    l = np.tril(np.asarray(a))  # factor written in place
+    assert np.abs(l @ l.T - a0).max() < 1e-10 * n
+    # potri from the factor: uplo triangle of A^-1
+    info2 = ctypes.c_int32(-7)
+    lib.pdpotri_(_cref("L"), _iref(n), _fptr(a), _iref(1), _iref(1), pda,
+                 ctypes.byref(info2))
+    assert info2.value == 0
+    inv = np.tril(np.asarray(a))
+    full = inv + np.tril(inv, -1).T
+    assert np.abs(full @ a0 - np.eye(n)).max() < 1e-8
+
+
+def test_pdgetri(lib):
+    rng = np.random.default_rng(12)
+    n = 32
+    a0 = rng.standard_normal((n, n)) + n * np.eye(n)
+    a = np.asfortranarray(a0)
+    ipiv = np.zeros(n, np.int32)
+    info = ctypes.c_int32(-7)
+    da, pda = _desc(n, n)
+    lib.pdgetrf_(_iref(n), _iref(n), _fptr(a), _iref(1), _iref(1), pda,
+                 _fptr(ipiv), ctypes.byref(info))
+    assert info.value == 0
+    work = np.zeros(2)
+    iwork = np.zeros(2, np.int32)
+    # workspace query then real call (ScaLAPACK two-step contract)
+    lib.pdgetri_(_iref(n), _fptr(a), _iref(1), _iref(1), pda, _fptr(ipiv),
+                 _fptr(work), _iref(-1), _fptr(iwork), _iref(-1),
+                 ctypes.byref(info))
+    assert info.value == 0
+    lib.pdgetri_(_iref(n), _fptr(a), _iref(1), _iref(1), pda, _fptr(ipiv),
+                 _fptr(work), _iref(int(work[0])), _fptr(iwork),
+                 _iref(int(iwork[0])), ctypes.byref(info))
+    assert info.value == 0
+    assert np.abs(np.asarray(a) @ a0 - np.eye(n)).max() < 1e-9
+
+
+def test_pdsgesv_mixed(lib):
+    # scalapack_gesv_mixed.cc drop-in: f32 factor + f64 refinement
+    rng = np.random.default_rng(13)
+    n, nrhs = 48, 2
+    a0 = rng.standard_normal((n, n)) + n * np.eye(n)
+    x_true = rng.standard_normal((n, nrhs))
+    a = np.asfortranarray(a0)
+    b = np.asfortranarray(a0 @ x_true)
+    x = np.asfortranarray(np.zeros((n, nrhs)))
+    ipiv = np.zeros(n, np.int32)
+    it = ctypes.c_int32(-99)
+    info = ctypes.c_int32(-7)
+    da, pda = _desc(n, n)
+    db, pdb = _desc(n, nrhs)
+    lib.pdsgesv_(_iref(n), _iref(nrhs), _fptr(a), _iref(1), _iref(1), pda,
+                 _fptr(ipiv), _fptr(b), _iref(1), _iref(1), pdb,
+                 _fptr(x), _iref(1), _iref(1), pdb,
+                 ctypes.byref(it), ctypes.byref(info))
+    assert info.value == 0
+    assert it.value != -99  # iteration count written (>=0, or <0 = fallback)
+    assert np.abs(np.asarray(x) - x_true).max() < 1e-9
+    # ipiv holds real pivots from the f32 factor
+    assert ipiv.min() >= 1 and ipiv.max() <= n
+
+
+def test_pdlansy_pzlanhe_pdlantr(lib):
+    rng = np.random.default_rng(14)
+    n = 32
+    g = rng.standard_normal((n, n))
+    sy = (g + g.T) / 2
+    da, pda = _desc(n, n)
+    work = np.zeros(1)
+    lib.pdlansy_.restype = ctypes.c_double
+    v = lib.pdlansy_(_cref("1"), _cref("L"), _iref(n),
+                     _fptr(a := np.asfortranarray(np.tril(sy))), _iref(1),
+                     _iref(1), pda, _fptr(work))
+    assert abs(v - np.abs(sy).sum(axis=0).max()) < 1e-12
+    he = g + 1j * rng.standard_normal((n, n))
+    he = (he + he.conj().T) / 2
+    lib.pzlanhe_.restype = ctypes.c_double
+    v = lib.pzlanhe_(_cref("M"), _cref("U"), _iref(n),
+                     _fptr(az := np.asfortranarray(np.triu(he))), _iref(1),
+                     _iref(1), pda, _fptr(work))
+    assert abs(v - np.abs(he).max()) < 1e-12
+    t = np.tril(g)
+    lib.pdlantr_.restype = ctypes.c_double
+    v = lib.pdlantr_(_cref("I"), _cref("L"), _cref("N"), _iref(n), _iref(n),
+                     _fptr(tf := np.asfortranarray(t)), _iref(1), _iref(1),
+                     pda, _fptr(work))
+    assert abs(v - np.abs(t).sum(axis=1).max()) < 1e-12

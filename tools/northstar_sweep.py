@@ -41,6 +41,11 @@ CASES = [
     ("heev", 16384, 5400),
     ("heev", 4096, 1800),
     ("svd", 4096, 1800),
+    # round 4: every remaining driver family gets a real-TPU datapoint
+    # (VERDICT r4 item 9)
+    ("hesv", 4096, 1800),
+    ("pbsv", 16384, 900),
+    ("gbsv", 16384, 900),
 ]
 
 CHILD = r"""
@@ -180,6 +185,76 @@ elif routine == "svd_vec":
     ok = resid < 5e-5 and orth < 5e-4
     emit(t1 - t0, 8 / 3 * n**3 / (t1 - t0) / 1e9,
          f"resid={{resid:.2e}} orth={{orth:.2e}}", ok)
+elif routine == "hesv":
+    # symmetric-indefinite solve (unitary-congruence Q T Q^H + pivoted
+    # gtsv, linalg/indefinite.py) — first on-chip datapoint (VERDICT r4
+    # item 9); flop formula matches the driver's documented ~4x Aasen cost
+    from slate_tpu.linalg import hesv_array
+    g = jax.random.normal(key, (n, n), jnp.float32)
+    a = (g + g.T) / 2
+    del g
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, 2), jnp.float32)
+    x, fac, info = hesv_array(a, b)
+    _ = float(jnp.sum(jnp.abs(x[:1])))  # warm + sync
+    _ = float(jnp.sum(a[:1, :4]))
+    t0 = time.perf_counter()
+    x, fac, info = hesv_array(a + 1e-6, b)
+    _ = float(jnp.sum(jnp.abs(x[:1])))
+    t1 = time.perf_counter()
+    an, xn, bn = np.asarray(a + 1e-6), np.asarray(x), np.asarray(b)
+    resid = float(np.abs(an @ xn - bn).max()
+                  / (np.abs(an).max() * np.abs(xn).max() * n + np.abs(bn).max()))
+    ok = int(info) == 0 and resid < 100 * n * 1.2e-7
+    emit(t1 - t0, 4 * n**3 / 3 / (t1 - t0) / 1e9, f"resid={{resid:.2e}}", ok)
+elif routine == "pbsv":
+    # SPD band solve, windowed O(n kd^2) path (VERDICT r4 item 9)
+    from slate_tpu.linalg import pbsv_array
+    kd = 512
+    i = jnp.arange(n)
+    band = (jnp.abs(i[:, None] - i[None, :]) <= kd)
+    g = jax.random.normal(key, (n, n), jnp.float32)
+    a = jnp.where(band, (g + g.T) / 2, 0) + 3 * kd * jnp.eye(n, dtype=jnp.float32)
+    del g, band
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, 2), jnp.float32)
+    x, fac, info = pbsv_array(a, b, kd)
+    _ = float(jnp.sum(jnp.abs(x[:1])))
+    _ = float(jnp.sum(a[:1, :4]))
+    t0 = time.perf_counter()
+    x, fac, info = pbsv_array(a + 1e-6 * jnp.eye(n, dtype=jnp.float32), b, kd)
+    _ = float(jnp.sum(jnp.abs(x[:1])))
+    t1 = time.perf_counter()
+    an = np.asarray(a) + 1e-6 * np.eye(n, dtype=np.float32)
+    xn, bn = np.asarray(x), np.asarray(b)
+    resid = float(np.abs(an @ xn - bn).max()
+                  / (np.abs(an).max() * np.abs(xn).max() * n + np.abs(bn).max()))
+    ok = int(info) == 0 and resid < 100 * n * 1.2e-7
+    # ~n kd^2 factor flops + 4 n kd nrhs solve flops (windowed band path)
+    emit(t1 - t0, n * kd * (kd + 8.0) / (t1 - t0) / 1e9,
+         f"kd={{kd}} resid={{resid:.2e}}", ok)
+elif routine == "gbsv":
+    # general band solve, windowed partial-pivot path (VERDICT r4 item 9)
+    from slate_tpu.linalg import gbsv_array
+    kl = ku = 512
+    i = jnp.arange(n)
+    band = (i[:, None] - i[None, :] <= kl) & (i[None, :] - i[:, None] <= ku)
+    a = jnp.where(band, jax.random.normal(key, (n, n), jnp.float32), 0)
+    a = a + 3 * kl * jnp.eye(n, dtype=jnp.float32)
+    del band
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, 2), jnp.float32)
+    x, fac = gbsv_array(a, b, kl, ku)
+    _ = float(jnp.sum(jnp.abs(x[:1])))
+    _ = float(jnp.sum(a[:1, :4]))
+    t0 = time.perf_counter()
+    x, fac = gbsv_array(a + 1e-6 * jnp.eye(n, dtype=jnp.float32), b, kl, ku)
+    _ = float(jnp.sum(jnp.abs(x[:1])))
+    t1 = time.perf_counter()
+    an = np.asarray(a) + 1e-6 * np.eye(n, dtype=np.float32)
+    xn, bn = np.asarray(x), np.asarray(b)
+    resid = float(np.abs(an @ xn - bn).max()
+                  / (np.abs(an).max() * np.abs(xn).max() * n + np.abs(bn).max()))
+    ok = resid < 100 * n * 1.2e-7
+    emit(t1 - t0, 2.0 * n * kl * (kl + ku) / (t1 - t0) / 1e9,
+         f"kl=ku={{kl}} resid={{resid:.2e}}", ok)
 """
 
 
@@ -188,7 +263,7 @@ def main():
     only = None
     if len(sys.argv) > 2 and sys.argv[1] == "--only":
         only = set(sys.argv[2].split(","))
-    out = os.path.join(root, "SWEEP_r03.json")
+    out = os.path.join(root, "SWEEP_r04.json")
     results = []
     if only and os.path.exists(out):
         with open(out) as f:  # keep other routines' existing rows
