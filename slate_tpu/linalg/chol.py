@@ -169,6 +169,77 @@ def _potrf_left_looking(a: jax.Array, nb: Optional[int] = None) -> jax.Array:
     return out[:n, :n]
 
 
+def _potrf_ll_ozaki(a: jax.Array, nb: Optional[int] = None, n_slices: int = 9) -> jax.Array:
+    """Left-looking f64 lower Cholesky with a persistent Ozaki digit cache.
+
+    The plain left-looking form (above) re-splits the factored history into
+    int8 digit planes inside every panel-update GEMM (ops/ozaki.py splits
+    per call).  Cholesky admits an exact a-priori row bound that makes the
+    splits cacheable: sum_j L[i,j]^2 = A[i,i], so |L[i,j]| <= sqrt(A[i,i])
+    for every j — fixing each row's digit grid at 2^e[i] > sqrt(A[i,i])
+    BEFORE factoring means every panel's planes share the row scaling and
+    concatenate exactly along the contraction axis.  Each factored panel is
+    split ONCE into a (S, n, n) int8 cache; each panel update is then ONE
+    plane-level GEMM (ops/ozaki.matmul_planes) over the full history with a
+    single epilogue — no per-use splits, no per-panel partial sums.
+
+    The bound is looser than the true row max by at most sqrt(row length)
+    (mass-spread worst case), i.e. <= 7 lost top bits at n = 16384.  The
+    default S = 9 matches the split-per-call path's measured accuracy on
+    well- AND ill-conditioned fixtures (the residual floor is the
+    explicit-inverse panel solve, not the digit tail; test_chol.py gates
+    both); S = 10 covers even the mass-spread worst case at +22% MXU work.
+    Cache memory is S n^2 bytes (2.4 GB at n = 16384, S = 9) — the
+    dispatch in potrf_array gates this path to sizes where cache + matrix
+    fit HBM and falls back to the split-per-call form above.
+
+    Same math as the reference potrf task graph read column-wise
+    (src/potrf.cc:91-196); the digit cache is the TPU-native analogue of
+    keeping the factored panels resident on-device for the trailing herk.
+    """
+    from ..ops.ozaki import _row_exp, matmul_planes, split_rows
+
+    n = a.shape[0]
+    if nb is None:
+        nb = 4096 if n >= 16384 else 2048
+    if n <= nb:
+        return _potrf_lower(a)
+    nsteps = -(-n // nb)
+    np_ = nsteps * nb
+    if np_ != n:
+        ap = jnp.pad(a, ((0, np_ - n), (0, np_ - n)))
+        dpad = jnp.arange(n, np_)
+        ap = ap.at[dpad, dpad].set(1)
+    else:
+        ap = a
+    # fixed per-row digit grid from the exact row bound sqrt(diag)
+    e = _row_exp(jnp.sqrt(jnp.maximum(jnp.real(jnp.diagonal(ap)), 0)).astype(jnp.float32))[:, None]
+    q = jnp.zeros((n_slices, np_, np_), jnp.int8)
+    cols = []
+    for j in range(nsteps):
+        r0 = j * nb
+        panel = ap[r0:, r0 : r0 + nb]
+        if j:
+            upd = matmul_planes(q[:, r0:, :r0], e[r0:], q[:, r0 : r0 + nb, :r0], e[r0 : r0 + nb])
+            panel = panel - upd
+        dblk = _potrf_lower(panel[:nb])
+        dblk = jnp.tril(dblk)
+        if panel.shape[0] > nb:
+            linv = _trtri_nb(dblk)
+            below = matmul(panel[nb:], linv.T)
+            cpanel = jnp.concatenate([dblk, below.astype(ap.dtype)], axis=0)
+        else:
+            cpanel = dblk
+        if j + 1 < nsteps:  # the last panel is never read back
+            qc, _ = split_rows(cpanel, n_slices, e[r0:])
+            q = jax.lax.dynamic_update_slice(q, qc, (0, r0, r0))
+        cols.append(cpanel)
+    out = jnp.zeros((np_, np_), ap.dtype)
+    for j, c in enumerate(cols):
+        out = jax.lax.dynamic_update_slice(out, c, (j * nb, j * nb))
+    return out[:n, :n]
+
+
 def _trtri_nb(l: jax.Array) -> jax.Array:
     """Inverse of the nb x nb diagonal block (explicit-inverse panel
     solve; same O(eps cond(L_kk)) trade as _potrf_scan's panels)."""
@@ -179,6 +250,9 @@ def _trtri_nb(l: jax.Array) -> jax.Array:
 
 _POTRF_SCAN_MIN_N = 16384  # above this the recursive trace is too large
 _POTRF_LL_MIN_N = 4096  # f64/c128: left-looking beats recursion from here
+# Digit-cache ceiling: S n^2 int8 cache + ~4 f64 n^2 buffers must fit v5e's
+# 15.75G HBM (n = 16384: 2.7G + 8G); past this the split-per-call form runs.
+_POTRF_OZCACHE_MAX_N = 20480
 
 
 def _is_f64(dtype) -> bool:
@@ -194,7 +268,17 @@ def potrf_array(a: jax.Array, uplo: Uplo = Uplo.Lower) -> Tuple[jax.Array, jax.A
         # f64 rides the left-looking form: large-k updates hit the Ozaki
         # dispatch win region (measured 235 vs 211 GF/s at n=8192, 569
         # GF/s at 16384 vs 82 for the right-looking scan, v5e round 4)
-        l = _potrf_left_looking(full)
+        from ..ops.matmul import _F64_DISPATCH, _tpu_is_default
+
+        if (
+            a.dtype == jnp.dtype(jnp.float64)
+            and a.shape[0] <= _POTRF_OZCACHE_MAX_N
+            and _F64_DISPATCH["ozaki"]
+            and _tpu_is_default()
+        ):
+            l = _potrf_ll_ozaki(full)
+        else:
+            l = _potrf_left_looking(full)
     elif a.shape[0] > _POTRF_SCAN_MIN_N:
         l = _potrf_scan(full)
     else:

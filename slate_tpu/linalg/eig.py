@@ -184,62 +184,95 @@ class Hb2stFactors(NamedTuple):
     n: int
 
 
-def _wavefront_chase(ap, n, w, nsweeps, max_hops, one, facs, s_lo=None, s_hi=None):
-    """Shared wavefront scheduling harness for the bulge chases (hb2st and
-    svd.tb2bd): hop (sweep j, hop t) touches only the 3w x 3w diagonal
-    block at r0 = j + 1 + t*w, and two hops conflict iff their r0 differ
-    by < 3w.  Scheduling hop (j, t) at time s = 4j + t places concurrent
-    hops exactly 4w-1 >= 3w apart (disjoint) and executes every
-    conflicting pair in sequential order, so a chase runs in ~4n batched
-    steps instead of nsweeps * max_hops serial hops — each step one
-    gather of K ~ max_hops/4 disjoint blocks, one vmapped block update
-    (``one``), one scatter.
+def _dense_to_diagband(a: Array, w: int, pad: int) -> Array:
+    """Dense (n, n) -> diagonal-band storage (n + 2*pad, 4w) with
+    ba[i, dd] = A[i - pad, i - pad + dd - 2w] (zero outside the band or the
+    matrix).  4w diagonals (j - i in [-2w, 2w)) cover the working set of
+    both bulge chases: hb2st fills j - i in (-2w, 2w) (band w + bulge w,
+    both triangles kept), tb2bd fills [-w, 2w] (lower bulge w, upper fill
+    2w).  128 lanes at the default w = 32."""
+    n = a.shape[0]
+    D = 4 * w
+    i = jnp.arange(n)[:, None]
+    j = i + jnp.arange(D)[None, :] - 2 * w
+    ok = (j >= 0) & (j < n)
+    vals = jnp.where(ok, a[i, jnp.clip(j, 0, n - 1)], 0)
+    return jnp.zeros((n + 2 * pad, D), a.dtype).at[pad : pad + n].set(vals)
 
-    ``ap`` must be padded by 4w on each side: idle wavefront slots park on
-    the dummy block [0, 3w), which live windows (start >= 3w+1) never
-    touch; idle updates are identities (nact = 0 -> tau = 0), so their
-    duplicate scatter writes all carry the same zero values.  ``one``
-    receives (block, idx0, nact) where idx0 is the in-block row/column of
-    the vector being eliminated (w-1 on a sweep's first hop, else 0) and
-    returns (block, *per_hop_factors); factor rows for idle slots are
-    dropped via an out-of-bounds row index."""
+
+def _wavefront_chase_band(
+    ba, n, w, nsweeps, max_hops, one, facs, s_lo=None, s_hi=None
+):
+    """Band-storage wavefront chase.
+
+    Schedule: hop (sweep j, hop t) touches only the 3w x 3w diagonal block
+    at r0 = j + 1 + t*w, and two hops conflict iff their r0 differ by
+    < 3w.  Scheduling hop (j, t) at time s = 4j + t places concurrent hops
+    exactly 4w-1 >= 3w apart (disjoint) and executes every conflicting
+    pair in sequential order, so a chase runs in ~4n batched steps instead
+    of nsweeps * max_hops serial hops.  ``one`` receives (block, idx0,
+    nact) — idx0 the in-block row/column of the vector being eliminated
+    (w-1 on a sweep's first hop, else 0) — and returns (block,
+    *per_hop_factors); idle wavefront slots park on the dummy rows
+    [0, 3w) inside the pad (live windows start >= 3w+1) with identity
+    updates (nact = 0 -> tau = 0), and their factor rows are dropped via
+    an out-of-bounds scatter index.
+
+    Storage (the round-4 rework): the matrix lives in diagonal-band
+    storage (N, 4w) instead of a full (N, N) array — the loop carry drops
+    from O(n^2) (285 MB at n = 8192 f32) to O(n w) (4 MB), so the ~4n
+    serial steps stop being HBM-copy-bound.  Each step gathers
+    K row slabs (3w, 4w), shears them into dense (3w, 3w) windows for the
+    vmapped ``one`` update, shears back, and scatters.  Entries of a slab
+    row outside its 3w window (band columns left of the window) are
+    preserved by the shear-back mask.  This is the TPU answer to the
+    reference's cache-resident pipelined taskloop (hb2st.cc:170-281):
+    the working set now FITS fast memory instead of restreaming HBM."""
+    D = 4 * w
     k_slots = max_hops // 4 + 1
     islot = jnp.arange(k_slots)
     w3 = 3 * w
     pad = 4 * w
+    rr = jnp.arange(w3)
+    # shear indices: block[r, c] = slab[r, c - r + 2w]; slab[r, dd] = block[r, r + dd - 2w]
+    dd_idx = rr[None, :] - rr[:, None] + 2 * w  # (3w, 3w) band col per (r, c)
+    ok_g = (dd_idx >= 0) & (dd_idx < D)
+    cidx = rr[:, None] + jnp.arange(D)[None, :] - 2 * w  # (3w, D) block col per (r, dd)
+    ok_s = (cidx >= 0) & (cidx < w3)
 
     def step_body(s, carry):
-        ap, *fs = carry
+        ba, *fs = carry
         j = s // 4 - islot
         t = s - 4 * j
         r0 = j + 1 + t * w
         valid = (j >= 0) & (j < nsweeps) & (t < max_hops) & (r0 <= n - 1)
         nact = jnp.where(valid, jnp.clip(n - r0, 0, w), 0)
         b0 = jnp.where(valid, pad + r0 - w, 0)
-        blocks = jax.vmap(
-            lambda b: lax.dynamic_slice(ap, (b, b), (w3, w3))
-        )(b0)
+        slabs = jax.vmap(lambda b: lax.dynamic_slice(ba, (b, 0), (w3, D)))(b0)
+        blocks = jnp.where(
+            ok_g[None], jnp.take_along_axis(slabs, jnp.clip(dd_idx, 0, D - 1)[None].repeat(k_slots, 0), axis=2), 0
+        )
         idx0 = jnp.where(t == 0, w - 1, 0)
         blocks, *vals = jax.vmap(one)(blocks, idx0, nact)
+        newslabs = jnp.where(
+            ok_s[None],
+            jnp.take_along_axis(blocks, jnp.clip(cidx, 0, w3 - 1)[None].repeat(k_slots, 0), axis=2),
+            slabs,
+        )
 
-        # write-back: per-slot dynamic_update_slice (blocks on a wavefront
-        # are disjoint; idle slots all rewrite the identical dummy block at
-        # [0, 3w)).  A single giant 2D scatter here kernel-faulted the TPU
-        # runtime at n = 8192 (round-3 finding) — the slot loop lowers to
-        # plain aliased in-place updates instead.
-        def put(i, ap):
-            return lax.dynamic_update_slice(ap, blocks[i], (b0[i], b0[i]))
+        def put(i, ba):
+            return lax.dynamic_update_slice(ba, newslabs[i], (b0[i], 0))
 
-        ap = lax.fori_loop(0, k_slots, put, ap)
+        ba = lax.fori_loop(0, k_slots, put, ba)
         jw = jnp.where(valid, j, fs[0].shape[0])  # out-of-bounds -> dropped
         tw = jnp.where(valid, t, 0)
         fs = [f.at[jw, tw].set(v, mode="drop") for f, v in zip(fs, vals)]
-        return (ap, *fs)
+        return (ba, *fs)
 
     nsteps = 4 * (nsweeps - 1) + max_hops
     return lax.fori_loop(s_lo if s_lo is not None else 0,
                          s_hi if s_hi is not None else nsteps,
-                         step_body, (ap, *facs))
+                         step_body, (ba, *facs))
 
 
 # Empirical worker per-program ceiling: the fused wavefront chase faults
@@ -253,43 +286,49 @@ def _chase_segments(n: int) -> int:
     return 1 if n <= _CHASE_SEGMENT_ABOVE else max(2, n // 4096)
 
 
-def _wavefront_chase_segmented(ap, n, w, nsweeps, max_hops, one, facs, segments):
+def _wavefront_chase_segmented(ba, n, w, nsweeps, max_hops, one, facs, segments):
     """Run the chase as ``segments`` jitted programs over step ranges,
     state carried on device — bit-identical to the fused form (same
     step_body, same order).  Keeps the step-count formula in ONE place for
     both the eig (hb2st) and svd (tb2bd) chases."""
     if segments <= 1:
-        return _wavefront_chase(ap, n, w, nsweeps, max_hops, one, facs)
+        return _wavefront_chase_band(ba, n, w, nsweeps, max_hops, one, facs)
     nsteps = 4 * (nsweeps - 1) + max_hops
     bounds = [nsteps * i // segments for i in range(segments)] + [nsteps]
 
     @functools.partial(jax.jit, static_argnames=("lo", "hi"))
-    def _seg(ap, facs, lo, hi):
-        out = _wavefront_chase(ap, n, w, nsweeps, max_hops, one, facs, lo, hi)
+    def _seg(ba, facs, lo, hi):
+        out = _wavefront_chase_band(ba, n, w, nsweeps, max_hops, one, facs, lo, hi)
         return out[0], tuple(out[1:])
 
     facs = tuple(facs)
     for i in range(segments):
-        ap, facs = _seg(ap, facs, bounds[i], bounds[i + 1])
-    return (ap, *facs)
+        ba, facs = _seg(ba, facs, bounds[i], bounds[i + 1])
+    return (ba, *facs)
 
 
-def hb2st(band: Array, w: int = _EIG_NB, segments: int = 1):
-    """Hermitian band (bandwidth w, dense storage) -> real tridiagonal
-    (d, e) + reflectors for the back-transform.  Returns
-    (d, e_real, factors, phases); eigvec lifting: z_band =
-    phases * unmtr_hb2st(factors, z_tridiag).
+def hb2st(band: Array, w: int = _EIG_NB, segments: int = 1, diag_storage: bool = False):
+    """Hermitian band (bandwidth w, dense storage — or diagonal-band
+    storage (n, 4w) when ``diag_storage``, as built by _dense_to_diagband /
+    parallel.dist_twostage.gather_diagband) -> real tridiagonal (d, e) +
+    reflectors for the back-transform.  Returns (d, e_real, factors,
+    phases); eigvec lifting: z_band = phases * unmtr_hb2st(factors,
+    z_tridiag).
 
     Wavefront pipelining (reference P7, hb2st.cc:170-281 taskloop): see
-    _wavefront_chase for the schedule; per hop the in-block update is one
+    _wavefront_chase_band for the schedule; per hop the in-block update is one
     left Householder on rows [r0, r0+w) and its mirrored right
     application."""
     n = band.shape[0]
     dtype = band.dtype
     cplx = jnp.issubdtype(dtype, jnp.complexfloating)
     pad = 4 * w
-    ap = jnp.zeros((n + 2 * pad, n + 2 * pad), dtype)
-    ap = ap.at[pad : pad + n, pad : pad + n].set(band)
+    if diag_storage:
+        if band.shape[1] != 4 * w:
+            raise ValueError(f"diag storage needs (n, {4*w}), got {band.shape}")
+        ba = jnp.zeros((n + 2 * pad, 4 * w), dtype).at[pad : pad + n].set(band)
+    else:
+        ba = _dense_to_diagband(band, w, pad)
     max_hops = max(1, -(-(n - 1) // w))
     nsweeps = max(n - 2, 1)
     vs = jnp.zeros((max(n - 1, 1), max_hops, w), dtype)
@@ -316,12 +355,11 @@ def hb2st(band: Array, w: int = _EIG_NB, segments: int = 1):
         # segments > 1: one jitted program per step range (call hb2st
         # EAGERLY to benefit) — the scale escape hatch for chases whose
         # single program exceeds the worker's limits (cf. stedc_staged)
-        ap, vs, taus = _wavefront_chase_segmented(
-            ap, n, w, nsweeps, max_hops, one, (vs, taus), segments
+        ba, vs, taus = _wavefront_chase_segmented(
+            ba, n, w, nsweeps, max_hops, one, (vs, taus), segments
         )
-    at = ap[pad : pad + n, pad : pad + n]
-    d = jnp.real(jnp.diagonal(at))
-    e = jnp.diagonal(at, -1)
+    d = jnp.real(ba[pad : pad + n, 2 * w])
+    e = ba[pad + 1 : pad + n, 2 * w - 1]  # A[i, i-1], i = 1..n-1
     if cplx:
         # phase-rotate to a real tridiagonal: T_real = P^H T P
         ae = jnp.abs(e)
@@ -337,7 +375,7 @@ def hb2st(band: Array, w: int = _EIG_NB, segments: int = 1):
 
 
 def _chase_sweep_apply(
-    vs: Array, taus: Array, z: Array, n: int, w: int, adjoint: bool
+    vs: Array, taus: Array, z: Array, n: int, w: int, adjoint: bool, j0: int = 0
 ) -> Array:
     """Apply a bulge-chase reflector family to Z, one batched sweep at a
     time.  Within one sweep j the hops touch DISJOINT w-row slabs of Z
@@ -347,7 +385,11 @@ def _chase_sweep_apply(
 
     adjoint=False applies the basis U = H_1^H H_2^H ... (reflectors
     conj-transposed, reverse chronological order); adjoint=True applies
-    U^H (reflectors as-is, chronological order)."""
+    U^H (reflectors as-is, chronological order).  ``j0`` offsets the
+    family's sweep indices (vs[jj] is global sweep j0 + jj) so a BLOCK of
+    sweeps can be applied — the streamed distributed back-transform
+    (parallel.dist_twostage.chase_apply_dist) feeds one sharded block at a
+    time."""
     nsweeps, max_hops = vs.shape[0], vs.shape[1]
     nrhs = z.shape[1]
     span = max_hops * w
@@ -355,12 +397,13 @@ def _chase_sweep_apply(
     zp = zp.at[:n].set(z)
 
     def sweep_body(jj, zp):
-        j = jj if adjoint else (nsweeps - 1) - jj
+        jl = jj if adjoint else (nsweeps - 1) - jj  # local family row
+        j = j0 + jl  # global sweep index (slab position in Z)
         # hop order within a sweep is irrelevant (disjoint rows)
         slab = lax.dynamic_slice(zp, (j + 1, 0), (span, nrhs))
         slab = slab.reshape(max_hops, w, nrhs)
-        vj = lax.dynamic_slice(vs, (j, 0, 0), (1, max_hops, w))[0].astype(z.dtype)
-        tj = lax.dynamic_slice(taus, (j, 0), (1, max_hops))[0].astype(z.dtype)
+        vj = lax.dynamic_slice(vs, (jl, 0, 0), (1, max_hops, w))[0].astype(z.dtype)
+        tj = lax.dynamic_slice(taus, (jl, 0), (1, max_hops))[0].astype(z.dtype)
         cj = tj if adjoint else jnp.conj(tj)
         coef = jnp.einsum("hw,hwr->hr", jnp.conj(vj), slab)
         slab = slab - cj[:, None, None] * vj[:, :, None] * coef[:, None, :]

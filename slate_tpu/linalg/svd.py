@@ -175,23 +175,28 @@ class Tb2bdFactors(NamedTuple):
     n: int
 
 
-def tb2bd(band: Array, w: int = _SVD_NB, segments: int = 1):
-    """Upper-band (bandwidth w) square matrix -> upper bidiagonal (d, e),
+def tb2bd(band: Array, w: int = _SVD_NB, segments: int = 1, diag_storage: bool = False):
+    """Upper-band (bandwidth w) square matrix (or its diagonal-band
+    storage (n, 4w) when ``diag_storage``) -> upper bidiagonal (d, e),
     plus reflectors.  Chases each row's out-of-band tail down the band with
     alternating right/left Householders.
 
     Wavefront pipelining (reference P7, tb2bd.cc): the schedule and
-    gather/scatter harness are eig._wavefront_chase; per hop the in-block
+    gather/scatter harness are eig._wavefront_chase_band; per hop the in-block
     update is one right Householder eliminating a row tail followed by one
     left Householder eliminating the created column bulge."""
-    from .eig import _wavefront_chase_segmented
+    from .eig import _dense_to_diagband, _wavefront_chase_segmented
 
     n = band.shape[0]
     dtype = band.dtype
     cplx = jnp.issubdtype(dtype, jnp.complexfloating)
     pad = 4 * w
-    ap = jnp.zeros((n + 2 * pad, n + 2 * pad), dtype)
-    ap = ap.at[pad : pad + n, pad : pad + n].set(band)
+    if diag_storage:
+        if band.shape[1] != 4 * w:
+            raise ValueError(f"diag storage needs (n, {4*w}), got {band.shape}")
+        ba = jnp.zeros((n + 2 * pad, 4 * w), dtype).at[pad : pad + n].set(band)
+    else:
+        ba = _dense_to_diagband(band, w, pad)
     nsweeps = max(n - 1, 1)
     max_hops = max(1, -(-(n - 1) // w))
     lvs = jnp.zeros((nsweeps, max_hops, w), dtype)
@@ -219,12 +224,11 @@ def tb2bd(band: Array, w: int = _SVD_NB, segments: int = 1):
         return block, vr, taur, vl, taul
 
     if n > 1:
-        ap, rvs, rtaus, lvs, ltaus = _wavefront_chase_segmented(
-            ap, n, w, nsweeps, max_hops, one, (rvs, rtaus, lvs, ltaus), segments
+        ba, rvs, rtaus, lvs, ltaus = _wavefront_chase_segmented(
+            ba, n, w, nsweeps, max_hops, one, (rvs, rtaus, lvs, ltaus), segments
         )
-    at = ap[pad : pad + n, pad : pad + n]
-    d = jnp.diagonal(at)
-    e = jnp.diagonal(at, 1) if n > 1 else jnp.zeros((0,), dtype)
+    d = ba[pad : pad + n, 2 * w]
+    e = ba[pad : pad + n - 1, 2 * w + 1] if n > 1 else jnp.zeros((0,), dtype)
     f = Tb2bdFactors(lvs, ltaus, rvs, rtaus, w, n)
 
     # phase-normalize to a real nonnegative bidiagonal: B' = Pu^H B Pv

@@ -115,6 +115,25 @@ def _split_f32(x: Array) -> tuple[Array, Array]:
     return hi, lo
 
 
+def split_rows(x: Array, n_slices: int = _DEFAULT_SLICES, e: Array | None = None):
+    """Digit planes + row exponents of an (m, k) f64 operand.
+
+    Returns ``(q, e)`` with q (n_slices, m, k) int8 and e (m, 1) f32.  When
+    ``e`` is given it must satisfy |x[i, :]| < 2^e[i] (a per-row BOUND, not
+    necessarily the row max) — callers with an a-priori row bound (e.g.
+    Cholesky's |L[i, j]| <= sqrt(A_ii)) can fix the digit grid once and
+    cache/concatenate planes of different column blocks exactly, because
+    every block shares the same per-row scaling (see
+    linalg/chol._potrf_ll_ozaki).  A bound looser than the row max costs
+    top digit planes (log2(bound/rowmax) bits); add a slice to compensate.
+    """
+    hi, lo = _split_f32(x)
+    if e is None:
+        e = _row_exp(jnp.max(jnp.abs(hi), axis=1, keepdims=True))
+    q = _slice_digits(hi, lo, e, n_slices)
+    return q, e
+
+
 @functools.partial(jax.jit, static_argnames=("n_slices",))
 def matmul_f64(a: Array, b: Array, n_slices: int = _DEFAULT_SLICES) -> Array:
     """f64-accurate ``a @ b`` computed as Ozaki-split int8 GEMMs.
@@ -124,16 +143,20 @@ def matmul_f64(a: Array, b: Array, n_slices: int = _DEFAULT_SLICES) -> Array:
     """
     if a.dtype != jnp.float64 or b.dtype != jnp.float64:
         raise TypeError(f"matmul_f64 requires f64 operands, got {a.dtype}, {b.dtype}")
-    m, k = a.shape
-    k2, n = b.shape
-    assert k == k2, (a.shape, b.shape)
+    qa, ea = split_rows(a, n_slices)
+    qb, eb = split_rows(b.T, n_slices)
+    return matmul_planes(qa, ea, qb, eb)
 
-    ahi, alo = _split_f32(a)
-    bhi, blo = _split_f32(b.T)
-    ea = _row_exp(jnp.max(jnp.abs(ahi), axis=1, keepdims=True))   # (m, 1)
-    eb = _row_exp(jnp.max(jnp.abs(bhi), axis=1, keepdims=True))   # (n, 1)
-    qa = _slice_digits(ahi, alo, ea, n_slices)                    # (S, m, k)
-    qb = _slice_digits(bhi, blo, eb, n_slices)                    # (S, n, k)
+
+def matmul_planes(qa: Array, ea: Array, qb: Array, eb: Array) -> Array:
+    """f64 product A @ B^T from pre-split digit planes (split_rows of A
+    (m, k) and of B^T (n, k)).  This is the reuse entry point: operands
+    whose planes are cached (factorization panels, stationary matrices)
+    skip the O(S m k) digit split and the f64 hi/lo subtract on every
+    reuse — the panel-update schedule in linalg/chol rides this."""
+    n_slices, m, k = qa.shape
+    assert qb.shape[0] == n_slices and qb.shape[2] == k, (qa.shape, qb.shape)
+    n = qb.shape[1]
 
     nchunks = -(-k // _K_CHUNK)
 

@@ -42,6 +42,8 @@ from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 from .comm import (
     PRECISE,
+    all_gather_a,
+    audit_scope,
     bcast_diag_tile,
     bcast_from_col,
     bucket_plan,
@@ -99,7 +101,6 @@ def _potrf_jit(at, mesh, p, q, nt):
                     view, jnp.where(mine, newcol, pcol)[:, None], kc, axis=1
                 )
                 pan = bcast_from_col(jnp.where(below & mine, newcol, 0), k % q)
-                from .comm import all_gather_a
 
                 allpan = all_gather_a(pan, ROW_AXIS, axis=0)
                 # logical row j sits at local slot j // p - roff of its
@@ -125,7 +126,6 @@ def _potrf_jit(at, mesh, p, q, nt):
         # The reference gets the same effect from its shrinking task DAG
         # (potrf.cc:94); lookahead overlap is XLA's async scheduling over
         # the per-step collectives.
-        from .comm import audit_scope
 
         for k0, k1, s0r, s0c in bucket_plan(nt, p, q):
             view = t_loc[s0r:, s0c:]

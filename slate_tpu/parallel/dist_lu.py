@@ -43,11 +43,14 @@ from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 from .comm import (
     PRECISE,
+    all_gather_a,
+    audit_scope,
     bcast_diag_tile,
     bcast_from_col,
-    bucket_plan,
     bcast_from_row,
+    bucket_plan,
     local_indices,
+    psum_a,
     shard_map,
 )
 
@@ -267,7 +270,6 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true):
             own_src = (src_t % p == r) & slot_ok
             vals = t_loc[jnp.minimum(src_t // p, mtl - 1), :, src_r, :]
             vals = jnp.where(own_src[:, None, None], vals, 0)
-            from .comm import psum_a
 
             rows_data = psum_a(vals, ROW_AXIS)
             dst = jnp.minimum(pos, mglob - 1)
@@ -281,7 +283,6 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true):
             # ---- standard right-looking step on the pivoted panel ----
             return _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c), rowperm
 
-        from .comm import audit_scope
 
         rowperm0 = jnp.arange(mglob)
         with audit_scope(nt):
@@ -367,7 +368,6 @@ def _pp_jit(at, mesh, p, q, nt, m_true):
                 absv = jnp.where(active, jnp.abs(colv), -1.0)
                 li = jnp.argmax(absv)
                 lv, lgid = absv[li], flat_gids[li]
-                from .comm import all_gather_a
 
                 gv = all_gather_a(lv, ROW_AXIS)  # (p,)
                 gg = all_gather_a(lgid, ROW_AXIS)
@@ -389,7 +389,6 @@ def _pp_jit(at, mesh, p, q, nt, m_true):
 
                 own_p, idx_p, vp = owner_val(piv)
                 own_g, idx_g, vg = owner_val(gcol)
-                from .comm import psum_a
 
                 rows2 = psum_a(jnp.stack([vp, vg]), ROW_AXIS)  # (2, nb)
                 row_piv, row_gcol = rows2[0], rows2[1]
@@ -406,7 +405,6 @@ def _pp_jit(at, mesh, p, q, nt, m_true):
                 flat = flat - mult[:, None] * urow[None, :]
                 return flat, piv_pos
 
-            from .comm import audit_scope
 
             with audit_scope(nb):
                 flat, piv_pos = lax.fori_loop(
@@ -437,7 +435,6 @@ def _pp_jit(at, mesh, p, q, nt, m_true):
             own_src = (src_t % p == r) & slot_ok
             vals = t_loc[jnp.minimum(src_t // p, mtl - 1), :, src_r, :]
             vals = jnp.where(own_src[:, None, None], vals, 0)
-            from .comm import psum_a
 
             rows_data = psum_a(vals, ROW_AXIS)
             dst = jnp.minimum(pos, mglob - 1)
@@ -464,7 +461,6 @@ def _pp_jit(at, mesh, p, q, nt, m_true):
                 rowperm,
             )
 
-        from .comm import audit_scope
 
         rowperm0 = jnp.arange(mglob)
         with audit_scope(nt):

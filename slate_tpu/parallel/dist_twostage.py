@@ -399,3 +399,114 @@ def _apply_col_panels_jit(vls, tls, zt, mesh, p, q):
         out_specs=spec,
         check_vma=False,
     )(vls, tls, zt)
+
+
+# ---------------------------------------------------------------------------
+# Stage 2 distribution (VERDICT r3 item 4, reference src/unmtr_hb2st.cc):
+# the band travels as O(n w) diagonals, the bulge-chase reflector family is
+# SHARDED over all p*q devices, and the back-transform streams one sweep
+# block at a time to Z's column shards — no O(n^2) replication anywhere in
+# the stage-2 chain.
+# ---------------------------------------------------------------------------
+
+
+def gather_diagband(band: DistMatrix, w: int) -> jax.Array:
+    """Diagonal-band storage (n, 4w) of the distributed band matrix,
+    replicated (O(n w) bytes — the analogue of the reference's he2hbGather
+    to the rank that runs hb2st, HermitianBandMatrix.hh:305).  Each device
+    scatters its local tiles' near-diagonal elements into the diagonal
+    frame, then one psum over both mesh axes."""
+    p, q = mesh_shape(band.mesh)
+    return _gather_diagband_jit(band.tiles, band.mesh, p, q, band.nb, w)[: band.m]
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
+def _gather_diagband_jit(tiles, mesh, p, q, nb, w):
+    D = 4 * w
+
+    def kernel(t_loc):
+        mtl, ntl, _, _ = t_loc.shape
+        _, _, i_log, j_log = local_indices(p, q, mtl, ntl)
+        a = jnp.arange(nb)
+        # per local tile (ti, tj): element (x, y) lands at global row
+        # i_log[ti]*nb + x, diagonal offset (j_log[tj]-i_log[ti])*nb + y - x
+        gi0 = (i_log[:, None] * nb + a[None, :]).reshape(-1)  # (mtl*nb,)
+        dd = (
+            (j_log[None, :, None, None] - i_log[:, None, None, None]) * nb
+            + a[None, None, None, :]
+            - a[None, None, :, None]
+            + 2 * w
+        )  # (mtl, ntl, nb, nb)
+        ok = (dd >= 0) & (dd < D)
+        vals = jnp.where(ok, t_loc, 0)
+        out = jnp.zeros((mtl * p * nb, D), t_loc.dtype)
+        rows = jnp.broadcast_to(
+            gi0[:, None, None], (mtl * nb, ntl, nb)
+        )  # row id per (flat row, tile col, y)
+        flat_rows = rows.reshape(-1)
+        flat_dd = jnp.clip(dd, 0, D - 1).transpose(0, 2, 1, 3).reshape(-1)
+        out = out.at[flat_rows, flat_dd].add(
+            vals.transpose(0, 2, 1, 3).reshape(-1), mode="drop"
+        )
+        return lax.psum(out, (ROW_AXIS, COL_AXIS))
+
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS),),
+        out_specs=P(),
+        check_vma=False,
+    )(tiles)
+
+
+def chase_apply_dist(vs, taus, z, n: int, w: int, mesh) -> jax.Array:
+    """Z <- U Z for a bulge-chase reflector basis with Z column-sharded
+    over ALL p*q devices and the (sweep, hop) family sharded by sweep
+    blocks — the distributed unmtr_hb2st / unmbr_tb2bd (reference
+    src/unmtr_hb2st.cc:1-80).  Block b is psum-broadcast from its owner
+    (O(n^2/p) per step) and applied locally to my column shard via the
+    offset _chase_sweep_apply; peak per-device memory is O(n^2 / (p q)),
+    never the O(n^2) of the replicated form (asserted by
+    tests/test_parallel.py::test_chase_apply_dist_memory)."""
+    from ..linalg.eig import _chase_sweep_apply
+
+    p, q = mesh_shape(mesh)
+    nparts = p * q
+    nsweeps, max_hops, wv = vs.shape
+    assert wv == w
+    blk = -(-nsweeps // nparts)
+    vs_p = jnp.pad(vs, ((0, blk * nparts - nsweeps), (0, 0), (0, 0)))
+    ta_p = jnp.pad(taus, ((0, blk * nparts - nsweeps), (0, 0)))
+    ncols = z.shape[1]
+    cpad = (-ncols) % nparts
+    zp = jnp.pad(z, ((0, 0), (0, cpad)))
+    out = _chase_apply_dist_jit(vs_p, ta_p, zp, mesh, p, q, n, w, blk)
+    return out[:, :ncols]
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8))
+def _chase_apply_dist_jit(vs, taus, z, mesh, p, q, n, w, blk):
+    from ..linalg.eig import _chase_sweep_apply
+
+    nparts = p * q
+    both = (ROW_AXIS, COL_AXIS)
+
+    def kernel(vs_loc, ta_loc, z_loc):
+        me = lax.axis_index(ROW_AXIS) * q + lax.axis_index(COL_AXIS)
+
+        def body(b, z_loc):
+            src = nparts - 1 - b  # reverse chronological block order
+            sel = me == src
+            vs_b = lax.psum(jnp.where(sel, vs_loc, 0), both)
+            ta_b = lax.psum(jnp.where(sel, ta_loc, 0), both)
+            return _chase_sweep_apply(vs_b, ta_b, z_loc, n, w, False, j0=src * blk)
+
+        return lax.fori_loop(0, nparts, body, z_loc)
+
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(both), P(both), P(None, both)),
+        out_specs=P(None, both),
+        check_vma=False,
+    )(vs, taus, z)

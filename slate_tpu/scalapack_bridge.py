@@ -578,6 +578,11 @@ def _r_sgesv(dt, rdt, p):
         x, f = gesv_array(a, b)
         info = int(f.info)  # singular A must surface (LAPACK dsgesv INFO)
         iters = -1
+        # dsgesv exit contract: on ITER < 0 the caller may reuse A/IPIV as
+        # the FULL-precision factors (e.g. via p?getrs for another RHS) —
+        # overwrite the f32 factorization written above
+        aview[...] = np.asarray(f.lu, dt)
+        _tview(pipiv, (n,), _INT)[...] = _perm_to_ipiv(np.asarray(f.perm))
     xview[...] = np.asarray(x, dt)
     _tview(piter, (1,), _INT)[0] = int(iters)
     _tview(pinfo, (1,), _INT)[0] = info

@@ -194,3 +194,28 @@ def test_potrf_scan_ill_conditioned(cond):
     eps = np.finfo(np.float64).eps
     assert resid < 8 * n * eps * np.sqrt(cond), (resid, cond)
     assert np.isfinite(l).all()
+
+
+@pytest.mark.parametrize("cond", [None, 1e8])
+def test_potrf_ll_ozaki_cached(cond):
+    # The digit-cache left-looking f64 path (potrf_array dispatches here on
+    # TPU at 4096 <= n <= 20480): panels split once into int8 planes on a
+    # fixed sqrt(diag)-bounded row grid, each update one plane-level GEMM.
+    # Gate: n*eps-class residual on well- AND ill-conditioned fixtures
+    # (the bound slack costs <= log2 sqrt(n) top bits; S=10 absorbs it).
+    from slate_tpu.linalg.chol import _potrf_ll_ozaki
+
+    rng = np.random.default_rng(11)
+    n, nb = 384, 128
+    g = rng.standard_normal((n, n))
+    if cond is None:
+        a = (g + g.T) / (2 * np.sqrt(n)) + 3 * np.eye(n)
+    else:
+        q, _ = np.linalg.qr(g)
+        a = (q * cond ** (-np.arange(n) / (n - 1))) @ q.T
+        a = (a + a.T) / 2
+    l = np.tril(np.asarray(_potrf_ll_ozaki(jnp.asarray(a), nb=nb)))
+    resid = np.linalg.norm(l @ l.T - a) / np.linalg.norm(a)
+    eps = np.finfo(np.float64).eps
+    gate = 8 * n * eps * (1 if cond is None else np.sqrt(cond))
+    assert resid < gate, (resid, gate)

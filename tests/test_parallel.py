@@ -934,3 +934,42 @@ def test_tbsm_pbsv_gbsv_mesh(rng):
     xg, info2 = gbsv_mesh(jnp.asarray(gb), jnp.asarray(b), 4, 7, mesh, nb=16)
     assert int(info2) == 0
     assert np.abs(gb @ np.asarray(xg) - b).max() / np.abs(b).max() < 1e-12
+
+
+def test_chase_apply_dist_matches_replicated(rng):
+    # streamed sharded stage-2 back-transform == the single-program apply
+    from slate_tpu.linalg.eig import _chase_sweep_apply, hb2st
+    from slate_tpu.parallel.dist_twostage import chase_apply_dist
+
+    n, w = 96, 8
+    g = _rand(rng, n, n)
+    band = np.tril(np.triu(g + g.T, -w), w)
+    d, e, f2, _ = hb2st(jnp.asarray(band), w)
+    z = jnp.asarray(_rand(rng, n, n))
+    ref = np.asarray(_chase_sweep_apply(f2.vs, f2.taus, z, n, w, False))
+    got = np.asarray(chase_apply_dist(f2.vs, f2.taus, z, n, w, mesh24()))
+    assert np.abs(got - ref).max() < 1e-12
+
+
+def test_chase_apply_dist_memory():
+    # VERDICT r3 item 4 gate: peak per-device memory of the distributed
+    # stage-2 back-transform is O(n^2/p), not the O(n^2) of replication.
+    # memory_analysis reports PER-DEVICE sizes for the partitioned program.
+    from slate_tpu.parallel.dist_twostage import _chase_apply_dist_jit
+
+    mesh = mesh24()
+    n, w = 512, 8
+    nparts = 8
+    max_hops = -(-(n - 1) // w)
+    nsweeps = n - 2
+    blk = -(-nsweeps // nparts)
+    vs = jnp.zeros((blk * nparts, max_hops, w), jnp.float64)
+    taus = jnp.zeros((blk * nparts, max_hops), jnp.float64)
+    z = jnp.zeros((n, n), jnp.float64)
+    c = _chase_apply_dist_jit.lower(vs, taus, z, mesh, 2, 4, n, w, blk).compile()
+    ma = c.memory_analysis()
+    per_dev = ma.argument_size_in_bytes + ma.temp_size_in_bytes + ma.output_size_in_bytes
+    repl = (vs.size + taus.size + 2 * z.size) * 8  # replicated footprint
+    # sharded run must stay well under half the replicated footprint
+    # (measures: z/8 + vs/8 + one streamed block + slack)
+    assert per_dev < 0.45 * repl, (per_dev, repl)

@@ -35,13 +35,16 @@ def summarize(records, p, q):
 
     Ring-lowering receive estimates per executed collective with local
     payload B over an axis of size s: psum (all-reduce) ~ 2 B (s-1)/s,
+    psum_scatter (reduce-scatter, TrsmA's epilogue) ~ B (s-1)/s,
     all_gather ~ B (s-1).
     """
     payload = recv = calls = 0
     by_op = {}
     for op, nbytes, mult in records:
         s = p if "[p]" in op else q
-        if op.startswith("psum"):
+        if op.startswith("psum_scatter"):
+            r = nbytes * (s - 1) / s
+        elif op.startswith("psum"):
             r = 2 * nbytes * (s - 1) / s
         else:  # all_gather
             r = nbytes * (s - 1)
