@@ -171,19 +171,15 @@ def _make(prefix):
 
     def gesv_mixed(a, b):
         # lapack_api/lapack_gesv_mixed.cc (slate_dsgesv): f32 factor +
-        # f64 iterative refinement; returns (x, iters, info).  The third
-        # driver output is the refine-loop's CONVERGED flag, not an info
-        # code (iters = -1 already flags the full-precision fallback, per
-        # dsgesv ITER semantics); LAPACK INFO > 0 means the final solve
-        # hit a singular factor — detected here as a non-finite x.
-        x, iters, _converged = gesv_mixed_array(_cast(dt, a), _cast(dt, b))
-        info = int(jnp.any(~jnp.isfinite(x if not jnp.iscomplexobj(x) else jnp.abs(x))))
-        return x, int(iters), info
+        # f64 iterative refinement; returns (x, iters, info) with dsgesv
+        # semantics: iters = -1 flags the full-precision fallback and info
+        # is that factorization's first-zero-pivot index (0 on success)
+        x, iters, _converged, info = gesv_mixed_array(_cast(dt, a), _cast(dt, b))
+        return x, int(iters), int(info)
 
     def posv_mixed(a, b, uplo="L"):
-        x, iters, _converged = posv_mixed_array(_cast(dt, a), _cast(dt, b), _uplo(uplo))
-        info = int(jnp.any(~jnp.isfinite(x if not jnp.iscomplexobj(x) else jnp.abs(x))))
-        return x, int(iters), info
+        x, iters, _converged, info = posv_mixed_array(_cast(dt, a), _cast(dt, b), _uplo(uplo))
+        return x, int(iters), int(info)
 
     _NORMC = {"M": Norm.Max, "1": Norm.One, "O": Norm.One, "I": Norm.Inf,
               "F": Norm.Fro, "E": Norm.Fro}

@@ -117,6 +117,94 @@ def _potrf_scan(a: jax.Array, nb: int = 256, nbuckets: int = 4) -> jax.Array:
     return ap[:n, :n]
 
 
+def _potrf_and_inv(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(L, L^-1) of a full Hermitian block, jointly, ALL-GEMM.
+
+    The plain recursive factor (_potrf_lower) spends its time in f64
+    triangular solves (XLA's emulated trsm crawls: measured 52 GF/s for
+    the whole 4096 diag factor while the surrounding Ozaki updates run
+    2-3 TF/s-eq).  Computing the inverse ALONGSIDE the factor removes
+    every solve: l21 = a21 inv11^H and inv21 = -inv22 l21 inv11 are
+    gemms, so the recursion's O(n^3) all rides the matmul dispatch
+    (Ozaki above the win gate, tuned f32-pair emulation below), and the
+    panel solve gets L^-1 for free — no separate trtri recursion.
+    Error class is the explicit-inverse O(eps cond) trade already used by
+    the scan panels (ADVICE r3: bounded by the ill-conditioned fixture
+    tests)."""
+    n = a.shape[0]
+    cplx = jnp.issubdtype(a.dtype, jnp.complexfloating)
+    if n <= _NB:
+        if a.dtype == jnp.dtype(jnp.float64):
+            return _potrf_inv_base_f64(a)
+        l = jax.lax.linalg.cholesky(a)
+        eye = jnp.eye(n, dtype=a.dtype)
+        linv = jax.lax.linalg.triangular_solve(
+            l[None], eye[None], left_side=True, lower=True, transpose_a=False
+        )[0]
+        return l, linv
+    h = _split(n)
+    l11, i11 = _potrf_and_inv(a[:h, :h])
+    l21 = matmul(a[h:, :h], jnp.conj(i11).T if cplx else i11.T).astype(a.dtype)
+    upd = matmul(l21, jnp.conj(l21).T if cplx else l21.T)
+    l22, i22 = _potrf_and_inv(a[h:, h:] - upd.astype(a.dtype))
+    i21 = -matmul(i22, matmul(l21, i11).astype(a.dtype)).astype(a.dtype)
+    z = jnp.zeros((h, n - h), a.dtype)
+    l = jnp.block([[l11, z], [l21, l22]])
+    linv = jnp.block([[i11, z], [i21, i22]])
+    return l, linv
+
+
+def _potrf_inv_base_f64(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """f32-seeded, f64-refined (L, L^-1) of a small f64 block.
+
+    TPU has no native f64 LAPACK ops: lax.linalg.cholesky/triangular_solve
+    under the x64 rewriter unroll into ~16k serialized micro-ops per
+    256-block (profiled: the leaf chains were 1.8s of the 2.0s n = 16384
+    f64 factorization — the MXU gemms around them are ~0.4s).  Here the
+    leaf runs the NATIVE f32 cholesky + inverse (fast, few ops), then
+    three coupled refinement sweeps in f64 — each a handful of vectorized
+    small gemms:
+
+        E = X (A - L L^T) X^T          (backward error in L-coordinates)
+        L <- L (I + low(E)),  low = strict lower + half diagonal
+        X <- X (2 I - L X)             (Newton resync of the inverse)
+
+    ||E|| starts at ~eps32 * cond(block) and squares per sweep, so three
+    sweeps reach the eps64 * cond floor for cond(block) up to ~1e4; a
+    residual-gated lax.cond falls back to the exact (slow) f64 path for
+    blocks where the seed failed or refinement stalled — correctness never
+    depends on the block's conditioning, only speed does."""
+    n = a.shape[0]
+    dt = a.dtype
+    a32 = a.astype(jnp.float32)
+    l32 = jax.lax.linalg.cholesky(a32)
+    x32 = jax.lax.linalg.triangular_solve(
+        l32[None], jnp.eye(n, dtype=jnp.float32)[None], left_side=True, lower=True
+    )[0]
+    seed_ok = jnp.all(jnp.isfinite(l32))
+    l = jnp.tril(jnp.where(jnp.isfinite(l32), l32, 0)).astype(dt)
+    x = jnp.tril(jnp.where(jnp.isfinite(x32), x32, 0)).astype(dt)
+    eye = jnp.eye(n, dtype=dt)
+    half_low = jnp.tril(jnp.ones((n, n), dt), -1) + 0.5 * eye
+    for _ in range(3):
+        r = a - l @ l.T
+        e = x @ r @ x.T
+        l = l + l @ (e * half_low)
+        x = x @ (2.0 * eye - l @ x)
+    resid = jnp.linalg.norm(a - l @ l.T)
+    tol = 1e3 * n * jnp.finfo(dt).eps * jnp.linalg.norm(a)
+    good = seed_ok & jnp.isfinite(resid) & (resid <= tol)
+
+    def exact():
+        le = jax.lax.linalg.cholesky(a)
+        xe = jax.lax.linalg.triangular_solve(
+            le[None], eye[None], left_side=True, lower=True
+        )[0]
+        return le, xe
+
+    return jax.lax.cond(good, lambda: (jnp.tril(l), jnp.tril(x)), exact)
+
+
 def _potrf_left_looking(a: jax.Array, nb: Optional[int] = None) -> jax.Array:
     """Left-looking blocked lower Cholesky with STATIC per-panel shapes.
 
@@ -146,27 +234,26 @@ def _potrf_left_looking(a: jax.Array, nb: Optional[int] = None) -> jax.Array:
     else:
         ap = a
     cplx = jnp.issubdtype(a.dtype, jnp.complexfloating)
-    cols = []  # factored (np_ - j*nb, nb) panels, top-aligned at row j*nb
+    # IN-PLACE: factored panels overwrite ap's lower triangle, so the
+    # update reads ap[r0:, :r0] directly and peak memory stays ~one matrix
+    # (+ transients) — this is what lets n = 32768 f64 (8 GB) run inside
+    # v5e's 15.75 GB HBM
     for j in range(nsteps):
         r0 = j * nb
         panel = ap[r0:, r0 : r0 + nb]
         if j:
-            left = jnp.concatenate([c[r0 - (k * nb) : , :] for k, c in enumerate(cols)], axis=1)
+            left = ap[r0:, :r0]  # factored L[r0:, :r0]
             lrow = left[:nb]  # rows r0..r0+nb of L's first j*nb columns
             upd = matmul(left, jnp.conj(lrow).T if cplx else lrow.T)
             panel = panel - upd.astype(ap.dtype)
-        dblk = _potrf_lower(panel[:nb])
+        dblk, linv = _potrf_and_inv(panel[:nb])
         if panel.shape[0] > nb:
-            linv = _trtri_nb(dblk)
             below = matmul(panel[nb:], jnp.conj(linv).T if cplx else linv.T)
             panel = jnp.concatenate([dblk, below.astype(ap.dtype)], axis=0)
         else:
             panel = dblk
-        cols.append(panel)
-    out = jnp.zeros((np_, np_), ap.dtype)
-    for j, c in enumerate(cols):
-        out = jax.lax.dynamic_update_slice(out, c, (j * nb, j * nb))
-    return out[:n, :n]
+        ap = jax.lax.dynamic_update_slice(ap, panel, (r0, r0))
+    return tri_project(ap[:n, :n], Uplo.Lower)
 
 
 def _potrf_ll_ozaki(a: jax.Array, nb: Optional[int] = None, n_slices: int = 9) -> jax.Array:
@@ -215,17 +302,15 @@ def _potrf_ll_ozaki(a: jax.Array, nb: Optional[int] = None, n_slices: int = 9) -
     # fixed per-row digit grid from the exact row bound sqrt(diag)
     e = _row_exp(jnp.sqrt(jnp.maximum(jnp.real(jnp.diagonal(ap)), 0)).astype(jnp.float32))[:, None]
     q = jnp.zeros((n_slices, np_, np_), jnp.int8)
-    cols = []
     for j in range(nsteps):
         r0 = j * nb
         panel = ap[r0:, r0 : r0 + nb]
         if j:
             upd = matmul_planes(q[:, r0:, :r0], e[r0:], q[:, r0 : r0 + nb, :r0], e[r0 : r0 + nb])
             panel = panel - upd
-        dblk = _potrf_lower(panel[:nb])
+        dblk, linv = _potrf_and_inv(panel[:nb])
         dblk = jnp.tril(dblk)
         if panel.shape[0] > nb:
-            linv = _trtri_nb(dblk)
             below = matmul(panel[nb:], linv.T)
             cpanel = jnp.concatenate([dblk, below.astype(ap.dtype)], axis=0)
         else:
@@ -233,19 +318,8 @@ def _potrf_ll_ozaki(a: jax.Array, nb: Optional[int] = None, n_slices: int = 9) -
         if j + 1 < nsteps:  # the last panel is never read back
             qc, _ = split_rows(cpanel, n_slices, e[r0:])
             q = jax.lax.dynamic_update_slice(q, qc, (0, r0, r0))
-        cols.append(cpanel)
-    out = jnp.zeros((np_, np_), ap.dtype)
-    for j, c in enumerate(cols):
-        out = jax.lax.dynamic_update_slice(out, c, (j * nb, j * nb))
-    return out[:n, :n]
-
-
-def _trtri_nb(l: jax.Array) -> jax.Array:
-    """Inverse of the nb x nb diagonal block (explicit-inverse panel
-    solve; same O(eps cond(L_kk)) trade as _potrf_scan's panels)."""
-    from .tri import trtri_array
-
-    return trtri_array(l, Uplo.Lower, Diag.NonUnit)
+        ap = jax.lax.dynamic_update_slice(ap, cpanel, (r0, r0))
+    return tri_project(ap[:n, :n], Uplo.Lower)
 
 
 _POTRF_SCAN_MIN_N = 16384  # above this the recursive trace is too large

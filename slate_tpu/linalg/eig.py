@@ -188,9 +188,10 @@ def _dense_to_diagband(a: Array, w: int, pad: int) -> Array:
     """Dense (n, n) -> diagonal-band storage (n + 2*pad, 4w) with
     ba[i, dd] = A[i - pad, i - pad + dd - 2w] (zero outside the band or the
     matrix).  4w diagonals (j - i in [-2w, 2w)) cover the working set of
-    both bulge chases: hb2st fills j - i in (-2w, 2w) (band w + bulge w,
-    both triangles kept), tb2bd fills [-w, 2w] (lower bulge w, upper fill
-    2w).  128 lanes at the default w = 32."""
+    both bulge chases: each hop writes only block rows/cols [w, 2w) of its
+    3w window, so every written offset satisfies |j - i| <= 2w - 1 —
+    strictly inside the frame for hb2st (band w + bulge w, both triangles
+    kept) AND tb2bd (lower bulge, upper fill).  128 lanes at w = 32."""
     n = a.shape[0]
     D = 4 * w
     i = jnp.arange(n)[:, None]
@@ -198,6 +199,37 @@ def _dense_to_diagband(a: Array, w: int, pad: int) -> Array:
     ok = (j >= 0) & (j < n)
     vals = jnp.where(ok, a[i, jnp.clip(j, 0, n - 1)], 0)
     return jnp.zeros((n + 2 * pad, D), a.dtype).at[pad : pad + n].set(vals)
+
+
+def _chase_frame(band: Array, w: int, pad: int, diag_storage: bool) -> Array:
+    """The (n + 2*pad, 4w) working frame for a bulge chase, from either a
+    dense (n, n) band matrix or prebuilt diagonal storage (n, 4w).  Owned
+    here so the two chase entry points (hb2st, svd.tb2bd) share one
+    prelude."""
+    if diag_storage:
+        if band.shape[1] != 4 * w:
+            raise ValueError(f"diag storage needs (n, {4 * w}), got {band.shape}")
+        n = band.shape[0]
+        return jnp.zeros((n + 2 * pad, 4 * w), band.dtype).at[pad : pad + n].set(band)
+    return _dense_to_diagband(band, w, pad)
+
+
+def symmetrize_diagband(bandd: Array, w: int) -> Array:
+    """Hermitian-average a diagonal-band frame (n, 4w): element (i, dd)
+    holds A[i, i+o] (o = dd - 2w); its mirror conj(A[i+o, i]) lives at
+    frame position (i+o, 2w - o).  Keeps the frame-layout knowledge next
+    to _dense_to_diagband; used by the mesh drivers to shave the
+    O(eps * nsteps) rounding asymmetry of the distributed two-sided
+    update before the chase."""
+    n, D = bandd.shape
+    assert D == 4 * w, (bandd.shape, w)
+    cplx = jnp.issubdtype(bandd.dtype, jnp.complexfloating)
+    o = jnp.arange(D) - 2 * w
+    src_r = jnp.arange(n)[:, None] + o[None, :]
+    src_c = 2 * w - o
+    ok = (src_r >= 0) & (src_r < n) & ((src_c >= 0) & (src_c < D))[None, :]
+    g = bandd[jnp.clip(src_r, 0, n - 1), jnp.clip(src_c, 0, D - 1)[None, :]]
+    return 0.5 * (bandd + jnp.where(ok, jnp.conj(g) if cplx else g, bandd))
 
 
 def _wavefront_chase_band(
@@ -323,12 +355,7 @@ def hb2st(band: Array, w: int = _EIG_NB, segments: int = 1, diag_storage: bool =
     dtype = band.dtype
     cplx = jnp.issubdtype(dtype, jnp.complexfloating)
     pad = 4 * w
-    if diag_storage:
-        if band.shape[1] != 4 * w:
-            raise ValueError(f"diag storage needs (n, {4*w}), got {band.shape}")
-        ba = jnp.zeros((n + 2 * pad, 4 * w), dtype).at[pad : pad + n].set(band)
-    else:
-        ba = _dense_to_diagband(band, w, pad)
+    ba = _chase_frame(band, w, pad, diag_storage)
     max_hops = max(1, -(-(n - 1) // w))
     nsweeps = max(n - 2, 1)
     vs = jnp.zeros((max(n - 1, 1), max_hops, w), dtype)

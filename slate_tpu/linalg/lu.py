@@ -117,14 +117,120 @@ def _split_panel(n: int) -> int:
     return split_pow2(n, _PANEL_W)
 
 
+def _getrf_rec_inv(a: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Recursive LU of (m, w), m >= w, that ALSO returns inv(unit L11).
+
+    The f64 analogue of _getrf_rec: the U12 triangular solve becomes a
+    gemm against the child's unit-L inverse, and the combined inverse is
+    assembled block-wise (inv([[L11,0],[L21,L22]]) has i21 = -i22 L21 i11)
+    — so every O(m w^2) flop is a matmul riding the f64 dispatch (Ozaki /
+    tuned emulation) instead of XLA's crawling emulated trsm (cf.
+    chol._potrf_and_inv, same redesign).  Error class: explicit-inverse
+    O(eps cond(L11)); partial pivoting keeps |L| <= 1 so unit-L blocks are
+    well conditioned in practice (cond growth is the usual pivot-growth
+    factor)."""
+    m, w = a.shape
+    if w <= _PANEL_W:
+        lu, perm = _panel_lu(a)
+        l11 = jnp.tril(lu[:w], -1) + jnp.eye(w, dtype=a.dtype)
+        linv = jax.lax.linalg.triangular_solve(
+            l11[None], jnp.eye(w, dtype=a.dtype)[None],
+            left_side=True, lower=True, unit_diagonal=True,
+        )[0]
+        return lu, perm, linv
+    h = _split_panel(w)
+    lu1, p1, i1 = _getrf_rec_inv(a[:, :h])
+    a2 = a[:, h:][p1]
+    u12 = matmul(i1, a2[:h]).astype(a.dtype)
+    s = a2[h:] - matmul(lu1[h:, :h], u12).astype(a.dtype)
+    lu2, p2, i2 = _getrf_rec_inv(s)
+    l21 = lu1[h:, :h][p2]
+    i21 = -matmul(i2, matmul(l21[: w - h], i1).astype(a.dtype)).astype(a.dtype)
+    top = jnp.concatenate([lu1[:h], u12.reshape(h, w - h)], axis=1)
+    bot = jnp.concatenate([l21, lu2], axis=1)
+    perm = jnp.concatenate([p1[:h], p1[h:][p2]])
+    z = jnp.zeros((h, w - h), a.dtype)
+    linv = jnp.block([[i1, z], [i21, i2]])
+    return jnp.concatenate([top, bot], axis=0), perm, linv
+
+
+def _getrf_left_looking(a: jax.Array, nb: Optional[int] = None) -> Tuple[jax.Array, jax.Array]:
+    """Left-looking blocked partial-pivot LU for f64 on TPU (VERDICT r4
+    item 1, cf. chol._potrf_left_looking).  Per panel: (1) U rows above
+    the panel by blocked forward substitution — gemms against the CACHED
+    unit-L diagonal-block inverses from _getrf_rec_inv; (2) one big Schur
+    gemm  A[r0:, pj] -= L[r0:, :r0] U[:r0, pj]  whose k = r0 contraction
+    is exactly the Ozaki-dispatch win shape; (3) recursive all-gemm panel
+    LU with partial pivoting; (4) the panel's row permutation applied to
+    the factored history (the permuteRows data motion, src/getrf.cc:161-178,
+    as one row gather).  Same 2n^3/3 flops as the right-looking form, but
+    the big-k products land where f64 is fast.  Returns (lu, perm)."""
+    m, n = a.shape
+    if nb is None:
+        nb = 4096 if n >= 16384 else 2048
+    if n <= nb or m != n:
+        return _getrf_rec(a)
+    nsteps = -(-n // nb)
+    np_ = nsteps * nb
+    if np_ != n:
+        ap = jnp.pad(a, ((0, np_ - n), (0, np_ - n)))
+        dpad = jnp.arange(n, np_)
+        ap = ap.at[dpad, dpad].set(1)
+    else:
+        ap = a
+    perm = jnp.arange(np_)
+    linvs = []  # unit-L diagonal-block inverses, one per factored panel
+    for j in range(nsteps):
+        r0 = j * nb
+        panel = ap[:, r0 : r0 + nb]
+        if j:
+            # U[:r0, pj]: blocked forward substitution through the factored
+            # diagonal blocks (each step one small + one growing gemm)
+            urows = []
+            for k in range(j):
+                k0 = k * nb
+                bk = panel[k0 : k0 + nb]
+                if k:
+                    bk = bk - matmul(ap[k0 : k0 + nb, :k0], jnp.concatenate(urows, axis=0)).astype(ap.dtype)
+                urows.append(matmul(linvs[k], bk).astype(ap.dtype))
+            u_top = jnp.concatenate(urows, axis=0)  # (r0, nb)
+            # Schur complement of the panel below r0: one big-k gemm
+            sc = panel[r0:] - matmul(ap[r0:, :r0], u_top).astype(ap.dtype)
+            panel = jnp.concatenate([u_top, sc], axis=0)
+        lu_p, pv, linv = _getrf_rec_inv(panel[r0:])
+        linvs.append(linv)
+        # permute the history + trailing columns FIRST (lu_p is already in
+        # pivoted row order), then write the factored panel
+        gpv = jnp.concatenate([jnp.arange(r0), r0 + pv])
+        ap = ap[gpv]
+        perm = perm[gpv]
+        ap = jax.lax.dynamic_update_slice(
+            ap, jnp.concatenate([panel[:r0], lu_p], axis=0), (0, r0)
+        )
+    return ap[:n, :n], perm[:n]
+
+
 def _lu_info(lu: jax.Array) -> jax.Array:
     d = jnp.diagonal(lu)
     bad = (d == 0) | ~jnp.isfinite(d)
     return jnp.where(jnp.any(bad), jnp.argmax(bad) + 1, 0).astype(jnp.int32)
 
 
+_GETRF_LL_MIN_N = 4096  # f64 on TPU: left-looking from here
+
+
 def getrf_array(a: jax.Array) -> LUFactors:
     """Partial-pivot LU, PA = LU (src/getrf.cc)."""
+    if (
+        a.dtype in (jnp.dtype(jnp.float64), jnp.dtype(jnp.complex128))
+        and a.ndim == 2
+        and a.shape[0] == a.shape[1] >= _GETRF_LL_MIN_N
+    ):
+        from ..ops.matmul import _tpu_is_default
+
+        if _tpu_is_default():
+            lu, perm = _getrf_left_looking(a)
+            return LUFactors(lu, perm, _lu_info(lu))
     lu, perm = _getrf_rec(a)
     return LUFactors(lu, perm, _lu_info(lu))
 

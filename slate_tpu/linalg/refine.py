@@ -66,25 +66,31 @@ def _refine_loop(
 def _fallback(done, x, iters, full_solve):
     """Run the full high-precision solver only on non-convergence.  Eagerly,
     ``bool(done)`` is concrete and the expensive path is skipped entirely;
-    under jit it falls back to lax.cond (one branch *executes*)."""
+    under jit it falls back to lax.cond (one branch *executes*).
+    ``full_solve`` returns (x, info); the converged path reports info 0
+    (the f32 factor succeeded and the refinement met its gate)."""
+    zero = jnp.zeros((), jnp.int32)
     try:
         if bool(done):
-            return x, iters
-        return full_solve(), jnp.asarray(-1, iters.dtype)
+            return x, iters, zero
+        xf, info = full_solve()
+        return xf, jnp.asarray(-1, iters.dtype), jnp.asarray(info, jnp.int32)
     except jax.errors.TracerBoolConversionError:
         return jax.lax.cond(
             done,
-            lambda: (x, iters),
-            lambda: (full_solve(), jnp.asarray(-1, iters.dtype)),
+            lambda: (x, iters, zero),
+            lambda: (lambda out: (out[0], jnp.asarray(-1, iters.dtype),
+                                  jnp.asarray(out[1], jnp.int32)))(full_solve()),
         )
 
 
 def gesv_mixed_array(
     a: Array, b: Array, opts: Optional[Options] = None
-) -> Tuple[Array, Array, Array]:
+) -> Tuple[Array, Array, Array, Array]:
     """FP32-factor + high-precision-refine LU solve (src/gesv_mixed.cc).
-    Returns (x, iters, converged); on non-convergence with fallback enabled
-    the result is the full-precision solve and iters = -1."""
+    Returns (x, iters, converged, info); on non-convergence with fallback
+    enabled the result is the full-precision solve, iters = -1, and info
+    is that factorization's LAPACK code (first zero pivot index)."""
     from .lu import gesv_array, getrf_array, getrs_array
 
     lo_dtype = jnp.complex64 if jnp.issubdtype(a.dtype, jnp.complexfloating) else jnp.float32
@@ -92,15 +98,18 @@ def gesv_mixed_array(
     f32 = getrf_array(a.astype(lo_dtype))
     solve = lambda rhs: getrs_array(f32, rhs.astype(lo_dtype))
     x, iters, done = _refine_loop(a, b, solve, max_iter)
+    info = jnp.zeros((), jnp.int32)
     if get_option(opts, Option.UseFallbackSolver, True):
-        x, iters = _fallback(done, x, iters, lambda: gesv_array(a, b)[0])
-    return x, iters, done
+        x, iters, info = _fallback(
+            done, x, iters, lambda: (lambda o: (o[0], o[1].info))(gesv_array(a, b))
+        )
+    return x, iters, done, info
 
 
 def posv_mixed_array(
     a: Array, b: Array, uplo: Uplo = Uplo.Lower, opts: Optional[Options] = None
-) -> Tuple[Array, Array, Array]:
-    """src/posv_mixed.cc analogue."""
+) -> Tuple[Array, Array, Array, Array]:
+    """src/posv_mixed.cc analogue.  Returns (x, iters, converged, info)."""
     from .chol import posv_array, potrf_array, potrs_array
 
     lo_dtype = jnp.complex64 if jnp.issubdtype(a.dtype, jnp.complexfloating) else jnp.float32
@@ -110,9 +119,12 @@ def posv_mixed_array(
     conj = jnp.issubdtype(a.dtype, jnp.complexfloating)
     a_full = symmetrize(a, uplo, conj=conj)
     x, iters, done = _refine_loop(a_full, b, solve, max_iter)
+    info = jnp.zeros((), jnp.int32)
     if get_option(opts, Option.UseFallbackSolver, True):
-        x, iters = _fallback(done, x, iters, lambda: posv_array(a, b, uplo)[0])
-    return x, iters, done
+        x, iters, info = _fallback(
+            done, x, iters, lambda: (lambda o: (o[0], o[2]))(posv_array(a, b, uplo))
+        )
+    return x, iters, done, info
 
 
 # ---------------------------------------------------------------------------

@@ -185,18 +185,13 @@ def tb2bd(band: Array, w: int = _SVD_NB, segments: int = 1, diag_storage: bool =
     gather/scatter harness are eig._wavefront_chase_band; per hop the in-block
     update is one right Householder eliminating a row tail followed by one
     left Householder eliminating the created column bulge."""
-    from .eig import _dense_to_diagband, _wavefront_chase_segmented
+    from .eig import _chase_frame, _wavefront_chase_segmented
 
     n = band.shape[0]
     dtype = band.dtype
     cplx = jnp.issubdtype(dtype, jnp.complexfloating)
     pad = 4 * w
-    if diag_storage:
-        if band.shape[1] != 4 * w:
-            raise ValueError(f"diag storage needs (n, {4*w}), got {band.shape}")
-        ba = jnp.zeros((n + 2 * pad, 4 * w), dtype).at[pad : pad + n].set(band)
-    else:
-        ba = _dense_to_diagband(band, w, pad)
+    ba = _chase_frame(band, w, pad, diag_storage)
     nsweeps = max(n - 1, 1)
     max_hops = max(1, -(-(n - 1) // w))
     lvs = jnp.zeros((nsweeps, max_hops, w), dtype)

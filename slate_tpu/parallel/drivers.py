@@ -140,15 +140,10 @@ def heev_mesh(
     f = he2hb_dist(from_dense(a, mesh, nb))
     bandd = gather_diagband(f.band, nb)  # (n, 4nb) replicated, O(n nb)
     # the distributed two-sided update is Hermitian in exact arithmetic;
-    # shave the O(eps * nsteps) rounding asymmetry before the band chase:
-    # element (i, dd) holds A[i, i+o] (o = dd - 2nb); its mirror
-    # conj(A[i+o, i]) lives at frame position (i+o, 2nb - o)
-    o = jnp.arange(4 * nb) - 2 * nb
-    src_r = jnp.arange(n)[:, None] + o[None, :]
-    src_c = 2 * nb - o
-    ok = (src_r >= 0) & (src_r < n) & ((src_c >= 0) & (src_c < 4 * nb))[None, :]
-    g = bandd[jnp.clip(src_r, 0, n - 1), jnp.clip(src_c, 0, 4 * nb - 1)[None, :]]
-    bandd = 0.5 * (bandd + jnp.where(ok, jnp.conj(g) if cplx else g, bandd))
+    # shave the O(eps * nsteps) rounding asymmetry before the band chase
+    from ..linalg.eig import symmetrize_diagband
+
+    bandd = symmetrize_diagband(bandd, nb)
     d, e, f2, phases = hb2st(bandd, nb, diag_storage=True)
     if not want_vectors:
         return sterf(d, e)

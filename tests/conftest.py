@@ -14,6 +14,13 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 import jax
 
 jax.config.update("jax_enable_x64", True)
+# persistent compile cache: the suite re-compiles hundreds of CPU programs
+# per run (33 min wall on one core); the disk cache cuts warm reruns
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
 # The axon TPU plugin registers itself as default backend even under
 # JAX_PLATFORMS=cpu; pin default placement to CPU explicitly so tests are

@@ -128,7 +128,7 @@ def test_posv_mixed():
     n = 100
     a = generate("spd", n, dtype=np.float64, seed=11)
     b = generate("rands", n, 1, np.float64, seed=12)
-    x, iters, done = posv_mixed_array(jnp.asarray(a), jnp.asarray(b), Uplo.Lower)
+    x, iters, done, info = posv_mixed_array(jnp.asarray(a), jnp.asarray(b), Uplo.Lower)
     assert bool(done)
     resid = np.abs(a @ np.asarray(x) - b).max()
     assert resid / np.abs(b).max() < 1e-12  # refined to f64 accuracy

@@ -120,7 +120,7 @@ def test_gesv_mixed():
     n = 100
     a = generate("rands", n, n, np.float64, seed=16) + n * np.eye(n)
     b = generate("rands", n, 1, np.float64, seed=17)
-    x, iters, done = gesv_mixed_array(jnp.asarray(a), jnp.asarray(b))
+    x, iters, done, info = gesv_mixed_array(jnp.asarray(a), jnp.asarray(b))
     assert bool(done)
     assert int(iters) >= 0
     assert np.abs(a @ np.asarray(x) - b).max() / np.abs(b).max() < 1e-12
@@ -242,3 +242,21 @@ def test_getri_oop():
     ainv, info = getri_oop_array(jnp.asarray(a))
     assert int(info) == 0
     assert np.abs(a @ np.asarray(ainv) - np.eye(96)).max() < 1e-11
+
+
+def test_getrf_left_looking():
+    # the f64 TPU path (getrf_array dispatches here on-chip at n >= 4096):
+    # blocked forward-substitution U rows, big-k Schur gemm, all-gemm
+    # recursive panel with fused unit-L inverses, history row permutes
+    from slate_tpu.linalg.lu import _getrf_left_looking
+
+    rng = np.random.default_rng(17)
+    for n, nb in [(300, 96), (640, 256)]:
+        a = rng.standard_normal((n, n))
+        lu, perm = _getrf_left_looking(jnp.asarray(a), nb=nb)
+        lu, perm = np.asarray(lu), np.asarray(perm)
+        low = np.tril(lu, -1) + np.eye(n)
+        up = np.triu(lu)
+        resid = np.linalg.norm(a[perm] - low @ up) / np.linalg.norm(a)
+        assert resid < 8 * n * np.finfo(np.float64).eps, (n, nb, resid)
+        assert np.abs(np.tril(low, -1)).max() <= 1 + 1e-12  # partial pivoting
