@@ -134,26 +134,24 @@ def bench_getrf():
     return 2.0 * N**3 / 3.0 / t / 1e9
 
 
-# f64 factorizations: round-3 measurement showed XLA's f64 emulation beats
-# the Ozaki path at every factorization-relevant shape (thin-k trailing
-# updates: 178 GF/s-1.6 TF/s emulated vs 34-440 GF/s Ozaki at m=n=4096),
-# so matmul() gates Ozaki to the huge-square-GEMM win region and DPOTRF/
-# DGETRF ride the tuned emulation; the scanned forms keep every O(n^3)
-# flop in a matmul (explicit-inverse panels).
+# f64 factorizations: the shipped dispatch routes f64 (n >= 4096) to the
+# LEFT-LOOKING forms (round 4) whose panel updates are large-k gemms — the
+# shape where the Ozaki int8-MXU path wins — with digit-plane caching for
+# potrf and f32-seeded all-gemm panels; these benches time exactly that
+# dispatch (potrf_array / getrf_array), not the superseded scan paths.
 N_F64 = 8192
 
 
 def bench_potrf_f64():
-    # the SCANNED form: its panels are explicit-inverse gemms, so every
-    # O(n^3) flop is a matmul — which the dispatch routes to XLA's tuned
-    # f64 emulation at these thin-k shapes (the recursive form's trsm base
-    # cases fall to the wide emulated triangular_solve and crawl)
-    from slate_tpu.linalg.chol import _potrf_scan
+    # the SHIPPED dispatch (potrf_array): f64 at this size routes to the
+    # left-looking digit-cached Ozaki form, whose big-k panel updates ride
+    # the int8 MXU (chol.py _potrf_ll_ozaki) — the path users actually get
+    from slate_tpu.linalg.chol import potrf_array
 
     n = N_F64
     g = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.float64)
-    a = (g @ g.T) / n + 2 * jnp.eye(n, dtype=jnp.float64)
-    run = jax.jit(lambda x: jnp.sum(jnp.abs(jnp.diagonal(_potrf_scan(x)))))
+    a = (g + g.T) / (2.0 * jnp.sqrt(float(n))) + 3 * jnp.eye(n, dtype=jnp.float64)
+    run = jax.jit(lambda x: jnp.sum(jnp.abs(jnp.diagonal(potrf_array(x)[0]))))
     t = _timeit_perturbed(run, a)
     return n**3 / 3.0 / t / 1e9
 
@@ -186,11 +184,13 @@ def bench_gemm_f64_emulated():
 
 
 def bench_getrf_f64():
-    from slate_tpu.linalg.lu import getrf_scan_array
+    # the SHIPPED dispatch (getrf_array): f64 at this size routes to the
+    # left-looking form whose big-k Schur gemms ride the f64 dispatch
+    from slate_tpu.linalg.lu import getrf_array
 
     n = N_F64
     m = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float64) / 64
-    run = jax.jit(lambda x: jnp.sum(jnp.abs(jnp.diagonal(getrf_scan_array(x).lu))))
+    run = jax.jit(lambda x: jnp.sum(jnp.abs(jnp.diagonal(getrf_array(x).lu))))
     t = _timeit_perturbed(run, m)
     return 2.0 * n**3 / 3.0 / t / 1e9
 

@@ -29,6 +29,7 @@ from .lu import (
     getrs_array,
 )
 from .refine import (
+    RefineResult,
     gesv_mixed_array,
     gesv_mixed_gmres_array,
     posv_mixed_array,

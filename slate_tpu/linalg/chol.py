@@ -193,7 +193,18 @@ def _potrf_inv_base_f64(a: jax.Array) -> Tuple[jax.Array, jax.Array]:
         x = x @ (2.0 * eye - l @ x)
     resid = jnp.linalg.norm(a - l @ l.T)
     tol = 1e3 * n * jnp.finfo(dt).eps * jnp.linalg.norm(a)
-    good = seed_ok & jnp.isfinite(resid) & (resid <= tol)
+    # gate the INVERSE too (ADVICE r4): X feeds every panel solve via
+    # below = panel @ linv.T, and a stalled Newton resync can leave X
+    # several digits behind while L alone passes its residual gate
+    resid_x = jnp.linalg.norm(eye - l @ x)
+    tol_x = 1e3 * n * jnp.finfo(dt).eps * jnp.linalg.norm(x) * jnp.linalg.norm(l)
+    good = (
+        seed_ok
+        & jnp.isfinite(resid)
+        & (resid <= tol)
+        & jnp.isfinite(resid_x)
+        & (resid_x <= tol_x)
+    )
 
     def exact():
         le = jax.lax.linalg.cholesky(a)
@@ -256,7 +267,7 @@ def _potrf_left_looking(a: jax.Array, nb: Optional[int] = None) -> jax.Array:
     return tri_project(ap[:n, :n], Uplo.Lower)
 
 
-def _potrf_ll_ozaki(a: jax.Array, nb: Optional[int] = None, n_slices: int = 9) -> jax.Array:
+def _potrf_ll_ozaki(a: jax.Array, nb: Optional[int] = None, n_slices: Optional[int] = None) -> jax.Array:
     """Left-looking f64 lower Cholesky with a persistent Ozaki digit cache.
 
     The plain left-looking form (above) re-splits the factored history into
@@ -271,12 +282,13 @@ def _potrf_ll_ozaki(a: jax.Array, nb: Optional[int] = None, n_slices: int = 9) -
     single epilogue — no per-use splits, no per-panel partial sums.
 
     The bound is looser than the true row max by at most sqrt(row length)
-    (mass-spread worst case), i.e. <= 7 lost top bits at n = 16384.  The
-    default S = 9 matches the split-per-call path's measured accuracy on
-    well- AND ill-conditioned fixtures (the residual floor is the
-    explicit-inverse panel solve, not the digit tail; test_chol.py gates
-    both); S = 10 covers even the mass-spread worst case at +22% MXU work.
-    Cache memory is S n^2 bytes (2.4 GB at n = 16384, S = 9) — the
+    (mass-spread worst case), i.e. <= 7 lost top bits at n = 16384.  S = 9
+    matches the split-per-call path's measured accuracy on well- AND
+    ill-conditioned fixtures (the residual floor is the explicit-inverse
+    panel solve, not the digit tail; test_chol.py gates both); above
+    n = 8192 the default is S = 10 (+22% MXU work), which covers even the
+    mass-spread worst case where the bound's slack exceeds one 6-bit plane.
+    Cache memory is S n^2 bytes (2.7 GB at n = 16384, S = 10) — the
     dispatch in potrf_array gates this path to sizes where cache + matrix
     fit HBM and falls back to the split-per-call form above.
 
@@ -287,6 +299,13 @@ def _potrf_ll_ozaki(a: jax.Array, nb: Optional[int] = None, n_slices: int = 9) -
     from ..ops.ozaki import _row_exp, matmul_planes, split_rows
 
     n = a.shape[0]
+    if n_slices is None:
+        # ADVICE r4: the sqrt(diag) row bound can be loose by up to
+        # log2(sqrt(n)) bits in the mass-spread worst case — more than one
+        # 6-bit plane past n ~ 8192 — so S = 10 there (+22% MXU work)
+        # keeps the worst case inside the digit tail; S = 9 matches the
+        # split-per-call accuracy below that
+        n_slices = 10 if n > 8192 else 9
     if nb is None:
         nb = 4096 if n >= 16384 else 2048
     if n <= nb:
@@ -325,8 +344,11 @@ def _potrf_ll_ozaki(a: jax.Array, nb: Optional[int] = None, n_slices: int = 9) -
 _POTRF_SCAN_MIN_N = 16384  # above this the recursive trace is too large
 _POTRF_LL_MIN_N = 4096  # f64/c128: left-looking beats recursion from here
 # Digit-cache ceiling: S n^2 int8 cache + ~4 f64 n^2 buffers must fit v5e's
-# 15.75G HBM (n = 16384: 2.7G + 8G); past this the split-per-call form runs.
-_POTRF_OZCACHE_MAX_N = 20480
+# 15.75G HBM.  At the S = 10 default (n > 8192) the 16384 case is
+# 2.7G + 8.6G = 11.3G (validated on chip); 20480 would be 4.2G + 13.4G,
+# over budget, so the ceiling is 16384 and larger sizes take the
+# split-per-call in-place form.
+_POTRF_OZCACHE_MAX_N = 16384
 
 
 def _is_f64(dtype) -> bool:

@@ -536,7 +536,7 @@ def getrf_tntpiv_array(a: jax.Array, nb: int = _PANEL_W) -> LUFactors:
     internal_getrf_tntpiv.cc)."""
     m, n = a.shape
     nmin = min(m, n)
-    nb = min(nb, _PANEL_W, nmin)
+    nb = min(nb, nmin)
     nsteps = -(-nmin // nb)
     mp = max(m, nsteps * nb)
     np_ = max(n, nsteps * nb)
@@ -624,7 +624,14 @@ def getrf(a: ArrayLike, opts: Optional[Options] = None) -> Tuple[Matrix, LUFacto
     ad = a.array if isinstance(a, BaseMatrix) else jnp.asarray(a)
     method = get_option(opts, Option.MethodLU, MethodLU.PartialPiv)
     if method == MethodLU.CALU:
-        f = getrf_tntpiv_array(ad)
+        # MaxPanelThreads (reference: threads cooperating on one panel,
+        # internal_getrf.cc) maps to the tournament panel-width
+        # multiplier: wider panels amortize per-step latency against
+        # bigger trailing updates, the same trade the reference makes by
+        # adding panel threads (PartialPiv/NoPiv panels are recursive and
+        # take no width knob)
+        threads = int(get_option(opts, Option.MaxPanelThreads, 1))
+        f = getrf_tntpiv_array(ad, nb=_PANEL_W * max(1, threads))
     elif method == MethodLU.NoPiv:
         f = getrf_nopiv_array(ad)
     else:

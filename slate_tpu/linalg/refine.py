@@ -15,7 +15,7 @@ high-precision solver after max_iter failures when UseFallbackSolver is set.
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable, Optional, Tuple, Union
+from typing import Callable, NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +26,20 @@ from ..ops.tile_ops import genorm
 from ..types import Norm, Option, Options, Uplo, get_option
 
 Array = jax.Array
+
+
+class RefineResult(NamedTuple):
+    """Result of a mixed-precision refined solve (ADVICE r4: the public
+    return grew from 3 to 4 fields in round 4; the NamedTuple documents the
+    arity in one place and keeps positional unpacking explicit).
+
+    ``iters`` is -1 when the fallback full-precision solver produced ``x``;
+    ``info`` is then that factorization's LAPACK code."""
+
+    x: Array
+    iters: Array
+    converged: Array
+    info: Array
 
 
 def _refine_loop(
@@ -86,11 +100,12 @@ def _fallback(done, x, iters, full_solve):
 
 def gesv_mixed_array(
     a: Array, b: Array, opts: Optional[Options] = None
-) -> Tuple[Array, Array, Array, Array]:
+) -> RefineResult:
     """FP32-factor + high-precision-refine LU solve (src/gesv_mixed.cc).
-    Returns (x, iters, converged, info); on non-convergence with fallback
-    enabled the result is the full-precision solve, iters = -1, and info
-    is that factorization's LAPACK code (first zero pivot index)."""
+    Returns RefineResult(x, iters, converged, info); on non-convergence
+    with fallback enabled the result is the full-precision solve, iters =
+    -1, and info is that factorization's LAPACK code (first zero pivot
+    index)."""
     from .lu import gesv_array, getrf_array, getrs_array
 
     lo_dtype = jnp.complex64 if jnp.issubdtype(a.dtype, jnp.complexfloating) else jnp.float32
@@ -103,13 +118,14 @@ def gesv_mixed_array(
         x, iters, info = _fallback(
             done, x, iters, lambda: (lambda o: (o[0], o[1].info))(gesv_array(a, b))
         )
-    return x, iters, done, info
+    return RefineResult(x, iters, done, info)
 
 
 def posv_mixed_array(
     a: Array, b: Array, uplo: Uplo = Uplo.Lower, opts: Optional[Options] = None
-) -> Tuple[Array, Array, Array, Array]:
-    """src/posv_mixed.cc analogue.  Returns (x, iters, converged, info)."""
+) -> RefineResult:
+    """src/posv_mixed.cc analogue.  Returns RefineResult(x, iters,
+    converged, info)."""
     from .chol import posv_array, potrf_array, potrs_array
 
     lo_dtype = jnp.complex64 if jnp.issubdtype(a.dtype, jnp.complexfloating) else jnp.float32
@@ -124,7 +140,7 @@ def posv_mixed_array(
         x, iters, info = _fallback(
             done, x, iters, lambda: (lambda o: (o[0], o[2]))(posv_array(a, b, uplo))
         )
-    return x, iters, done, info
+    return RefineResult(x, iters, done, info)
 
 
 # ---------------------------------------------------------------------------

@@ -132,6 +132,35 @@ def bcast_diag_tile(
     return psum_a(psum_a(dtile, ROW_AXIS), COL_AXIS)
 
 
+def route_to_block_cyclic_rows(
+    part: jax.Array, targets: jax.Array, p: int, mtl_out: int,
+    extra: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Deliver per-target-row partials to their block-cyclic owners.
+
+    ``part`` is (t, q, ntl, nb, nb): slot t carries the contribution to
+    logical output row ``targets[t]`` for all q column shards.  The
+    partials are scattered into per-target-row slots (row ``g`` lives at
+    mesh row ``g % p``, local slot ``g // p``), the column shards are
+    psum-scattered to their mesh columns, and the per-row slots are
+    psum-scattered to their mesh rows — the stationary-operand
+    delivery pattern shared by trsmA's transposed path and hemmA
+    (src/trsmA.cc / src/hemmA.cc).  ``extra``, when given, is a
+    (mtl_out, q, ntl, nb, nb) contribution already belonging to the
+    calling device's own mesh row (hemmA's stored part)."""
+    q_, ntl = part.shape[1], part.shape[2]
+    nb = part.shape[-1]
+    r = lax.axis_index(ROW_AXIS)
+    routed = jnp.zeros((p, mtl_out, q_, ntl, nb, nb), part.dtype)
+    if extra is not None:
+        routed = routed.at[r].add(extra)
+    routed = routed.at[targets % p, targets // p].add(part, mode="drop")
+    out = psum_scatter_a(routed, COL_AXIS, scatter_dimension=2, tiled=False)
+    # scatter the per-row slots too (dim 0 size == p): each mesh row
+    # receives only its own slot — p x less data than psum + slice
+    return psum_scatter_a(out, ROW_AXIS, scatter_dimension=0, tiled=False)
+
+
 def bucket_plan(nt: int, p: int, q: int, nbuckets: int = BUCKETS):
     """Static trailing-update segmentation shared by the bucketed
     factorization kernels: yields (k0, k1, s0r, s0c) per bucket, where

@@ -137,13 +137,19 @@ def hemm_summa(
     c: Optional[DistMatrix] = None,
     uplo: Uplo = Uplo.Lower,
     conj: bool = True,
+    method=None,
 ) -> DistMatrix:
     """C := alpha A B + beta C with A Hermitian (conj=True, src/hemm.cc) or
     symmetric (conj=False, src/symm.cc), A referenced through its ``uplo``
     triangle only.  side=Right runs the Left schedule on transposed
     operands (C = B A  <=>  C^T = A^T B^T, with A^T symmetric in the other
-    triangle; the Hermitian case conjugates around the same identity)."""
-    from ..types import Side
+    triangle; the Hermitian case conjugates around the same identity).
+
+    ``method`` selects the stationary operand (slate::hemm's MethodHemm):
+    HemmC is the k-loop broadcast pipeline (_hemm_jit); HemmA keeps A's
+    stored triangle in place and reduces C (src/hemmA.cc) — the win when
+    B/C are panels far thinner than A.  None = auto-select by shape."""
+    from ..types import MethodHemm, Side, select_hemm_method
 
     p, q = mesh_shape(a.mesh)
     if side == Side.Right:
@@ -154,13 +160,88 @@ def hemm_summa(
         ct_ = transpose_dist(c, conj=conj) if c is not None else None
         al = jnp.conj(alpha) if conj else alpha
         be = jnp.conj(beta) if conj else beta
-        prod_t = hemm_summa(Side.Left, al, a, bt_, be, ct_, uplo=uplo, conj=conj)
+        prod_t = hemm_summa(Side.Left, al, a, bt_, be, ct_, uplo=uplo,
+                            conj=conj, method=method)
         return transpose_dist(prod_t, conj=conj)
     if b.grid != (p, q) or b.nb != a.nb or a.n != b.m:
         raise ValueError("hemm_summa operands must share mesh/nb and dims")
+    if method is None:
+        method = select_hemm_method(a.mt, b.nt)
     ct = None if c is None else c.tiles
-    out = _hemm_jit(a.tiles, b.tiles, ct, alpha, beta, a.mesh, p, q, a.nt, uplo, conj)
+    if method == MethodHemm.HemmA:
+        out = _hemm_a_jit(a.tiles, b.tiles, ct, alpha, beta, a.mesh, p, q, uplo, conj)
+    else:
+        out = _hemm_jit(a.tiles, b.tiles, ct, alpha, beta, a.mesh, p, q, a.nt, uplo, conj)
     return DistMatrix(tiles=out, m=a.m, n=b.n, nb=a.nb, mesh=a.mesh)
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9))
+def _hemm_a_jit(at, bt, ct, alpha, beta, mesh, p, q, uplo, conj):
+    """Stationary-A hemm/symm (slate::hemmA, src/hemmA.cc semantics): A's
+    stored triangle never moves.  The (thin) B is replicated to every
+    device with two all_gathers; each device multiplies its OWN stored
+    tiles — tile (i, j) contributes A[i,j] @ B[j] to C[i] and, strictly
+    off-diagonal, op(A[i,j]) @ B[i] to C[j] (the mirror) — and the
+    partials are routed to C's block-cyclic owners by the shared
+    ``comm.route_to_block_cyclic_rows`` delivery (also trsmA's
+    transposed path).
+    Communication is |B| replication + p|C| reduction instead of the
+    k-loop's |A|-scale row-panel gathers — the hemmA win for thin B/C."""
+    spec = P(ROW_AXIS, COL_AXIS)
+    lower = uplo == Uplo.Lower
+
+    def kernel(a_loc, b_loc):
+        from .comm import all_gather_a, route_to_block_cyclic_rows
+
+        mtl, ntl, nb, _ = a_loc.shape
+        ntl_b = b_loc.shape[1]
+        dtype = a_loc.dtype
+        r, c_, i_log, j_log = local_indices(p, q, mtl, ntl)
+
+        # replicate B: bfull[r', kappa, c', nu] = B(r' + p*kappa, c' + q*nu)
+        bfull = all_gather_a(b_loc, COL_AXIS, axis=0)  # (q, ktl_b, ntl_b, ...)
+        bfull = all_gather_a(bfull, ROW_AXIS, axis=0)  # (p, q, ktl_b, ntl_b, ...)
+        bfull = jnp.moveaxis(bfull, 2, 1)              # (p, ktl_b, q, ntl_b, ...)
+        brow_j = bfull[j_log % p, j_log // p]  # B rows j_log: (ntl, q, ntl_b, nb, nb)
+        brow_i = bfull[i_log % p, i_log // p]  # B rows i_log: (mtl, q, ntl_b, nb, nb)
+
+        stored = (
+            (i_log[:, None] > j_log[None, :]) if lower
+            else (i_log[:, None] < j_log[None, :])
+        )
+        on_diag = i_log[:, None] == j_log[None, :]
+        a_strict = jnp.where(stored[:, :, None, None], a_loc, 0)
+        # diagonal tiles rebuilt from the stored triangle alone
+        tri = jnp.tril if lower else jnp.triu
+        stri = (lambda x: jnp.tril(x, -1)) if lower else (lambda x: jnp.triu(x, 1))
+        dstored = tri(a_loc)
+        dmir = jnp.swapaxes(stri(a_loc), -1, -2)
+        if conj:
+            dmir = jnp.conj(dmir)
+            ddiag = jnp.einsum("ijaa->ija", dstored)
+            dstored = _set_diag(dstored, jnp.real(ddiag).astype(dtype))
+        a_diag = jnp.where(on_diag[:, :, None, None], dstored + dmir, 0)
+
+        # contributions to C[i_log[il]] from my stored column tiles
+        part_own = jnp.einsum(
+            "ikab,kJjbc->iJjac", a_strict + a_diag, brow_j, precision=PRECISE
+        )  # (mtl, q, ntl_b, nb, nb)
+        # mirror contributions to C[j_log[jl]] from my strict tiles
+        amir = jnp.conj(a_strict) if conj else a_strict
+        part_mir = jnp.einsum(
+            "ikba,iJjbc->kJjac", amir, brow_i, precision=PRECISE
+        )  # (ntl, q, ntl_b, nb, nb)
+
+        # part_own already belongs to my own mesh row (tile (i, j) lives
+        # at row i % p == r); part_mir routes to rows j_log % p
+        return route_to_block_cyclic_rows(part_mir, j_log, p, mtl, extra=part_own)
+
+    prod = shard_map(
+        kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
+    )(at, bt)
+    if ct is None:
+        return (alpha * prod).astype(at.dtype)
+    return (alpha * prod + beta * ct).astype(at.dtype)
 
 
 @functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10))

@@ -34,6 +34,7 @@ from .comm import (
     bcast_from_row,
     local_indices,
     psum_scatter_a,
+    route_to_block_cyclic_rows,
     shard_map,
 )
 
@@ -54,11 +55,11 @@ def trsm_dist(
     ``method`` picks the communication schedule (slate::trsm's MethodTrsm,
     method.hh:88-99): TrsmB broadcasts the A panel to B's owners each
     step; TrsmA keeps A's tiles stationary — the solved X row is
-    replicated, A's owner column computes the update partials, and one
-    reduce-scatter over the column axis delivers each owner's tiles — the
-    win when B is far thinner than A.  None = auto-select by shape; the
-    TrsmA schedule covers op == NoTrans (transposed solves re-route
-    through TrsmB, whose transpose-gather already moves no A panel)."""
+    replicated, A's owners compute the update partials in place, and a
+    reduce-scatter (plus, for transposed ops, a row broadcast of the
+    routed partials) delivers each owner its tiles — the win when B is
+    far thinner than A.  All (uplo, op) combinations run the stationary
+    schedule (src/trsmA.cc covers every op).  None = auto-select."""
     p, q = mesh_shape(a.mesh)
     if b.grid != a.grid or b.nb != a.nb or b.mt != a.nt or b.m != a.n:
         raise ValueError(
@@ -68,8 +69,8 @@ def trsm_dist(
     a.require_diag_pad("trsm_dist")
     if method is None:
         method = select_trsm_method(Side.Left, b.mt, b.nt)
-    if method == MethodTrsm.TrsmA and op == Op.NoTrans:
-        xt = _trsm_a_jit(a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, diag)
+    if method == MethodTrsm.TrsmA:
+        xt = _trsm_a_jit(a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag)
     else:
         xt = _trsm_jit(
             a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag
@@ -77,28 +78,39 @@ def trsm_dist(
     return DistMatrix(tiles=xt, m=b.m, n=b.n, nb=b.nb, mesh=b.mesh)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
-def _trsm_a_jit(at, bt, mesh, p, q, nt, uplo, diag):
-    """Stationary-A left solve, op = NoTrans (slate::trsmA,
-    src/trsmA.cc semantics): per step the solved X row is all-gathered,
-    the update partials A[i,k] @ X[k,:] are computed only where A's
-    column-k tiles live, and a psum-scatter over the column axis hands
-    every device exactly its own block-cyclic update — A never moves."""
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8))
+def _trsm_a_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
+    """Stationary-A left solve, all ops (slate::trsmA, src/trsmA.cc
+    semantics): per step the solved X row is all-gathered and multiplied
+    against A's stationary tiles where they live — column k of A for
+    op = NoTrans, row k (transposed per tile) otherwise — then the
+    partials are routed to B's block-cyclic owners: a psum-scatter over
+    the column axis for NoTrans, plus a scatter into target-row slots
+    and a row broadcast for the transposed ops (whose source row k % p
+    differs from the destination rows i % p).  A never moves."""
     spec = P(ROW_AXIS, COL_AXIS)
-    eff_lower = uplo == Uplo.Lower
+    trans = op != Op.NoTrans
+    conj = op == Op.ConjTrans
+    eff_lower = (uplo == Uplo.Lower) != trans
     forward = eff_lower
     unit = diag == Diag.Unit
 
     def kernel(a_loc, b_loc):
         mtl, ntl, nb, _ = a_loc.shape
-        ntl_b = b_loc.shape[1]
-        r, c, i_log, _ = local_indices(p, q, mtl, ntl)
+        mtl_b, ntl_b = b_loc.shape[0], b_loc.shape[1]
+        r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
+
+        def opt(t):  # apply op to one tile (or a stack of tiles)
+            t = jnp.swapaxes(t, -1, -2)
+            return jnp.conj(t) if conj else t
 
         def step(s, b_loc):
             k = s if forward else nt - 1 - s
             kr, kc = k // p, k // q
 
             dtile = bcast_diag_tile(a_loc, k, p, q, nb)
+            if trans:
+                dtile = opt(dtile)
 
             # solve X[k,:] on the owning mesh row, write back
             brow = lax.dynamic_slice_in_dim(b_loc, kr, 1, axis=0)[0]
@@ -111,24 +123,40 @@ def _trsm_a_jit(at, bt, mesh, p, q, nt, uplo, diag):
             b_loc = lax.dynamic_update_slice_in_dim(
                 b_loc, jnp.where(mine_r, xrow, brow)[None], kr, axis=0
             )
-            # replicate the solved row: every column of the mesh needs it
-            # to multiply against A's stationary column-k tiles
+            # replicate the solved row: every device needs it to multiply
+            # against A's stationary tiles
             xrow = bcast_from_row(jnp.where(mine_r, xrow, 0), k % p)
             xfull = all_gather_a(xrow, COL_AXIS, axis=0)  # (q, ntl_b, nb, nb)
 
-            # owner-computes: only mesh column k % q holds A[:, k]
-            remaining = (i_log > k) if forward else (i_log < k)
-            acol = lax.dynamic_slice_in_dim(a_loc, kc, 1, axis=1)[:, 0]
-            mine_c = (c == k % q)
-            acol = jnp.where(remaining[:, None, None] & mine_c, acol, 0)
+            if not trans:
+                # owner-computes: only mesh column k % q holds A[:, k]
+                remaining = (i_log > k) if forward else (i_log < k)
+                acol = lax.dynamic_slice_in_dim(a_loc, kc, 1, axis=1)[:, 0]
+                mine_c = (c == k % q)
+                acol = jnp.where(remaining[:, None, None] & mine_c, acol, 0)
+                part = jnp.einsum(
+                    "iab,Jjbc->iJjac", acol, xfull, precision=PRECISE
+                )  # (mtl, q, ntl_b, nb, nb)
+                # reduce the partials over columns, scattering slice J to
+                # mesh column J (each device receives only its own tiles)
+                upd = psum_scatter_a(
+                    part, COL_AXIS, scatter_dimension=1, tiled=False
+                )
+                return b_loc - upd.astype(b_loc.dtype)
+
+            # op != NoTrans: op(A)[i, k] = op(A[k, i]) — the stationary
+            # tiles are A's ROW k, held by mesh row k % p spread over the
+            # columns i % q; the partial for output row i must reach mesh
+            # row i % p (generally != k % p), so partials are scattered
+            # into per-target-row slots, column-reduced, then row-broadcast
+            remaining = (j_log > k) if forward else (j_log < k)
+            arow = lax.dynamic_slice_in_dim(a_loc, kr, 1, axis=0)[0]  # (ntl,nb,nb)
+            pan = opt(arow)
+            pan = jnp.where(remaining[:, None, None] & mine_r, pan, 0)
             part = jnp.einsum(
-                "iab,Jjbc->iJjac", acol, xfull, precision=PRECISE
-            )  # (mtl, q, ntl_b, nb, nb)
-            # reduce the partials over columns, scattering slice J to
-            # mesh column J (each device receives only its own tiles)
-            upd = psum_scatter_a(
-                part, COL_AXIS, scatter_dimension=1, tiled=False
-            )
+                "tab,Jjbc->tJjac", pan, xfull, precision=PRECISE
+            )  # (ntl, q, ntl_b, nb, nb); slot t targets output row j_log[t]
+            upd = route_to_block_cyclic_rows(part, j_log, p, mtl_b)
             return b_loc - upd.astype(b_loc.dtype)
 
         with audit_scope(nt):

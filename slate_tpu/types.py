@@ -174,6 +174,14 @@ def select_trsm_method(side: Side, m: int, n: int) -> MethodTrsm:
     return MethodTrsm.TrsmB
 
 
+def select_hemm_method(m: int, n: int) -> MethodHemm:
+    """method.hh MethodHemm::select_algo: a thin B/C panel next to a big
+    Hermitian A favours the stationary-A schedule (hemmA.cc)."""
+    if n <= m // 4:
+        return MethodHemm.HemmA
+    return MethodHemm.HemmC
+
+
 # ---------------------------------------------------------------------------
 # Options (reference types.hh:60 Options = map<Option, OptionValue>)
 # ---------------------------------------------------------------------------
@@ -184,7 +192,11 @@ class Option(enum.Enum):
     Lookahead = "lookahead"
     BlockSize = "block_size"  # nb (reference Option::TileSize analog)
     InnerBlocking = "inner_blocking"  # ib
-    MaxPanelThreads = "max_panel_threads"  # kept for API parity; unused
+    # Reference: threads cooperating on one LU panel (internal_getrf.cc).
+    # TPU analogue: the CALU tournament panel is ib * MaxPanelThreads
+    # columns wide, trading per-step latency against update size exactly
+    # as panel threads do (linalg/lu.py getrf, MethodLU.CALU).
+    MaxPanelThreads = "max_panel_threads"
     Tolerance = "tolerance"
     Target = "target"
     MaxIterations = "max_iterations"

@@ -105,20 +105,24 @@ def test_gemm_summa_stationary_a(rng):
 
 
 @pytest.mark.parametrize("uplo", [Uplo.Lower, Uplo.Upper])
-def test_trsm_dist_stationary_a(rng, uplo):
-    # TrsmA (src/trsmA.cc): stationary-A schedule, thin RHS
+@pytest.mark.parametrize("op", [Op.NoTrans, Op.Trans, Op.ConjTrans])
+def test_trsm_dist_stationary_a(rng, uplo, op):
+    # TrsmA (src/trsmA.cc): stationary-A schedule, thin RHS, ALL ops —
+    # the transposed ops route partials across mesh rows (r5 item 7)
     from slate_tpu.types import MethodTrsm, Side, select_trsm_method
 
     mesh = mesh24()
     n, nrhs = 96, 8
-    t = np.tril(np.asarray(_rand(rng, n, n))) + n * np.eye(n)
+    # complex operands so ConjTrans is distinguishable from Trans
+    t = np.tril(np.asarray(_rand(rng, n, n, np.complex128))) + n * np.eye(n)
     if uplo == Uplo.Upper:
         t = t.T
-    b = _rand(rng, n, nrhs)
+    b = _rand(rng, n, nrhs, np.complex128)
     ad = from_dense(jnp.asarray(t), mesh, nb=8, diag_pad_one=True)
     bd = from_dense(b, mesh, nb=8)
-    x = to_dense(trsm_dist(ad, bd, uplo, Op.NoTrans, method=MethodTrsm.TrsmA))
-    err = np.linalg.norm(t @ np.asarray(x) - np.asarray(b)) / np.linalg.norm(np.asarray(b))
+    x = to_dense(trsm_dist(ad, bd, uplo, op, method=MethodTrsm.TrsmA))
+    opt = {Op.NoTrans: t, Op.Trans: t.T, Op.ConjTrans: t.conj().T}[op]
+    err = np.linalg.norm(opt @ np.asarray(x) - np.asarray(b)) / np.linalg.norm(np.asarray(b))
     assert err < 1e-12
     assert select_trsm_method(Side.Left, n // 8, nrhs // 8) == MethodTrsm.TrsmA
 
@@ -629,6 +633,32 @@ def test_hemm_symm_dist_left(rng, uplo, conj):
     out = np.asarray(to_dense(hemm_summa(Side.Left, 2.0, ad, bd, uplo=uplo, conj=conj)))
     ref = 2.0 * herm @ b
     assert np.abs(out - ref).max() / np.abs(ref).max() < 1e-12
+
+
+@pytest.mark.parametrize("uplo", [Uplo.Lower, Uplo.Upper])
+@pytest.mark.parametrize("conj", [True, False])
+def test_hemm_stationary_a(rng, uplo, conj):
+    # hemmA (src/hemmA.cc): stationary-A schedule, thin B/C (r5 item 7);
+    # the auto-selector must pick it for a thin panel
+    from slate_tpu.parallel.dist_blas3 import hemm_summa
+    from slate_tpu.types import MethodHemm, Side, select_hemm_method
+
+    mesh = mesh24()
+    n, nrhs, nb = 96, 8, 8
+    g = np.asarray(_rand(rng, n, n, np.complex128))
+    herm = (g + g.conj().T) / 2 if conj else (g + g.T) / 2
+    b = np.asarray(_rand(rng, n, nrhs, np.complex128))
+    stored = herm.copy()
+    dead = np.triu(np.ones((n, n), bool), 1) if uplo == Uplo.Lower else np.tril(np.ones((n, n), bool), -1)
+    stored[dead] = 1e6  # the kernel must never read the dead triangle
+    ad = from_dense(jnp.asarray(stored), mesh, nb)
+    bd = from_dense(jnp.asarray(b), mesh, nb)
+    out = np.asarray(to_dense(hemm_summa(
+        Side.Left, 2.0, ad, bd, uplo=uplo, conj=conj, method=MethodHemm.HemmA
+    )))
+    ref = 2.0 * herm @ b
+    assert np.abs(out - ref).max() / np.abs(ref).max() < 1e-12
+    assert select_hemm_method(n // nb, nrhs // nb) == MethodHemm.HemmA
 
 
 def test_hemm_dist_right(rng):

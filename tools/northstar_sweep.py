@@ -19,6 +19,15 @@ import subprocess
 import sys
 
 CASES = [
+    # round 5: artifact-first ordering — the rows that verify round 4's
+    # claims (VERDICT r5 item 1) run before the f32 refreshes
+    ("potrf_f64", 16384, 7200),
+    ("getrf_f64", 16384, 7200),
+    ("heev_vec", 8192, 3600),
+    ("heev_vec", 16384, 7200),
+    ("svd", 16384, 7200),
+    ("svd_vec", 16384, 9000),
+    ("potrf_f64", 32768, 9000),
     ("getrf_scan", 32768, 900),
     ("getrf_scan", 16384, 600),
     ("potrf_scan", 32768, 900),
@@ -26,38 +35,16 @@ CASES = [
     ("geqrf", 32768, 900),
     ("geqrf", 16384, 600),
     ("gemm_f32", 16384, 600),
-    # round 3: the full eig/SVD chains now complete at n = 8192 WITH
-    # vectors (the round-2 worker faults were a giant 2D scatter in the
-    # wavefront chase and a batch-1 vmap lowering in the stedc merges,
-    # both fixed; large merges run chunked + level-staged)
+    # no-vector eig/SVD chains + remaining driver families
     ("heev", 8192, 3600),
-    ("heev_vec", 8192, 3600),
     ("svd", 8192, 3600),
     ("svd_vec", 8192, 3600),
-    # n = 16384 heev: unlocked late in round 3 by SEGMENTING the wavefront
-    # chase (one jitted program per step range) — the fused chase's step
-    # count, not any single op, was what killed the worker past 8192.
-    # svd 16384 still faults (ge2tb or the 2n = 32768 GK solve) — round 4.
     ("heev", 16384, 5400),
     ("heev", 4096, 1800),
     ("svd", 4096, 1800),
-    # round 4: every remaining driver family gets a real-TPU datapoint
-    # (VERDICT r4 item 9)
     ("hesv", 4096, 1800),
     ("pbsv", 16384, 900),
     ("gbsv", 16384, 900),
-    # round 4: f64 factorizations at north-star sizes (VERDICT r4 item 1)
-    # — left-looking forms whose big-k updates ride the Ozaki int8-MXU
-    # dispatch; generous timeouts, the unrolled programs compile in
-    # O(10 min) through the tunnel helper
-    ("potrf_f64", 16384, 7200),
-    ("potrf_f64", 32768, 9000),
-    ("getrf_f64", 16384, 7200),
-    # round 4: eig/svd at 16384 WITH vectors (VERDICT r4 item 2) on the
-    # band-storage chase
-    ("heev_vec", 16384, 7200),
-    ("svd", 16384, 7200),
-    ("svd_vec", 16384, 9000),
 ]
 
 CHILD = r"""
@@ -367,7 +354,7 @@ def main():
     only = None
     if len(sys.argv) > 2 and sys.argv[1] == "--only":
         only = set(sys.argv[2].split(","))
-    out = os.path.join(root, "SWEEP_r04.json")
+    out = os.path.join(root, "SWEEP_r05.json")
     results = []
     if only and os.path.exists(out):
         with open(out) as f:  # keep other routines' existing rows
