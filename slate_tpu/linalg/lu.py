@@ -629,9 +629,11 @@ def getrf(a: ArrayLike, opts: Optional[Options] = None) -> Tuple[Matrix, LUFacto
         # multiplier: wider panels amortize per-step latency against
         # bigger trailing updates, the same trade the reference makes by
         # adding panel threads (PartialPiv/NoPiv panels are recursive and
-        # take no width knob)
+        # take no width knob).  Clamped to 8x: past ~512-wide panels the
+        # tournament factors without interchanges over too many columns
+        # (pivot-growth risk) and the block LUs blow up compile time.
         threads = int(get_option(opts, Option.MaxPanelThreads, 1))
-        f = getrf_tntpiv_array(ad, nb=_PANEL_W * max(1, threads))
+        f = getrf_tntpiv_array(ad, nb=_PANEL_W * min(max(1, threads), 8))
     elif method == MethodLU.NoPiv:
         f = getrf_nopiv_array(ad)
     else:

@@ -160,7 +160,7 @@ def from_dense_nonuniform(
     exact for multiply-class ops and norms: gemm's tile products align
     because row k of B and column k of A pad identically; factorizations
     require uniform tiling (interior pad would make diag tiles singular) —
-    use redistribute()/from_dense for those.
+    ``redistribute_nonuniform`` retiles onto a uniform nb for those.
 
     Returns a DistMatrix with nb = max of all sizes and the logical
     (m, n) = sums of sizes; recover the dense array with
@@ -243,3 +243,21 @@ def redistribute(d: DistMatrix, mesh: Mesh, nb: Optional[int] = None) -> DistMat
     # nb change: retile through a device-resident (sharded) dense view
     dense = from_tiles(from_cyclic(d.tiles, *mesh_shape(d.mesh)), d.m, d.n)
     return from_dense(dense, mesh, nb2)
+
+
+def redistribute_nonuniform(
+    d: DistMatrix, row_sizes, col_sizes, nb: Optional[int] = None,
+    diag_pad_one: bool = False,
+) -> DistMatrix:
+    """Re-distribute a ``from_dense_nonuniform`` matrix onto a UNIFORM
+    nb tiling of the same mesh — the bridge that lets every factorization
+    run on non-uniformly tiled input (reference ex13 runs algorithms on
+    func.hh:39-78 non-uniform distributions; here the uniform retile is
+    the algorithm-facing canonical form because interior tile padding
+    would make diagonal tiles singular).  Entirely device-resident: the
+    per-tile unpad/reassembly works on global (sharded) arrays, the
+    analogue of redistribute.cc's tile-by-tile MPI moves.  Pass
+    ``diag_pad_one=True`` when the result feeds a factorization (the
+    from_dense padding contract)."""
+    dense = to_dense_nonuniform(d, row_sizes, col_sizes)
+    return from_dense(dense, d.mesh, nb or d.nb, diag_pad_one=diag_pad_one)

@@ -338,129 +338,280 @@ def getrf_pp_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array, jax.Array]:
     )
 
 
+def _pp_panel_and_swaps(t_loc, rowperm, k, p, q, r, c, nt, m_true,
+                        s_r, wlr, s_cw, wlsw):
+    """Shared partial-pivot panel factor + cross-shard row-swap machinery
+    (the internal_getrf.cc + internal_swap.cc pair), used by the dense
+    (getrf_pp_dist) and band (gbtrf_band_dist) kernels so the pivot
+    tie-break / sentinel / swap-write logic lives in ONE place.
+
+    ``s_r``/``wlr`` restrict the panel's candidate rows to the local slot
+    window [s_r, s_r + wlr) — the band kernel's O(kl)-row panel; the
+    dense kernel passes the full height (0, mtl).  ``s_cw``/``wlsw``
+    restrict the swap application to that local column window (a band
+    row's nonzeros — L history in columns >= g - kl, U fill up to
+    g + kl + ku — live inside it); the dense kernel passes (0, ntl).
+
+    Returns (t_loc, rowperm): all nb transpositions applied and the
+    factored panel written back into the owning column's window rows."""
+    mtl, ntl, nb, _ = t_loc.shape
+    dtype = t_loc.dtype
+    mglob = nt * nb
+    base = k * nb
+    kc32 = jnp.asarray(k // q, jnp.int32)
+    zero = jnp.zeros((), jnp.int32)
+    i_win = r + (s_r + jnp.arange(wlr)) * p
+    win_gids = (i_win[:, None] * nb + jnp.arange(nb)[None, :]).reshape(-1)
+    col_ids = jnp.arange(nb)
+
+    # ---- panel factor with per-column pivoting (getrf panel) ----
+    pcolw = lax.dynamic_slice(
+        t_loc, (s_r, kc32, zero, zero), (wlr, 1, nb, nb)
+    )[:, 0]
+    pan = bcast_from_col(jnp.where(c == k % q, pcolw, 0), k % q)
+    flat = pan.reshape(wlr * nb, nb)
+
+    def colstep(j, fc):
+        flat, piv_pos = fc
+        gcol = base + j
+        colv = flat[:, j]
+        active = (win_gids >= gcol) & (win_gids < m_true)
+        absv = jnp.where(active, jnp.abs(colv), -1.0)
+        li = jnp.argmax(absv)
+        lv, lgid = absv[li], win_gids[li]
+
+        gv = all_gather_a(lv, ROW_AXIS)  # (p,)
+        gg = all_gather_a(lgid, ROW_AXIS)
+        maxv = jnp.max(gv)
+        # winner: max |v|; ties -> smallest global row (deterministic,
+        # matches the scan/recursive single-chip tie policy).  No
+        # active candidate (pad column block / gcol >= m_true):
+        # pivot on gcol itself so the identity pad stays intact.
+        piv = jnp.min(jnp.where(gv == maxv, gg, mglob))
+        piv = jnp.where(maxv < 0, gcol, jnp.minimum(piv, mglob - 1))
+        piv_pos = piv_pos.at[j].set(piv)
+
+        # in-panel cross-shard swap rows piv <-> gcol (masked psum)
+        def owner_val(g):
+            slot = (g // nb) // p - s_r
+            own = ((g // nb) % p == r) & (slot >= 0) & (slot < wlr)
+            slot = jnp.clip(slot, 0, wlr - 1)
+            v = flat[slot * nb + g % nb]
+            return own, slot * nb + g % nb, jnp.where(own, v, 0)
+
+        own_p, idx_p, vp = owner_val(piv)
+        own_g, idx_g, vg = owner_val(gcol)
+
+        rows2 = psum_a(jnp.stack([vp, vg]), ROW_AXIS)  # (2, nb)
+        row_piv, row_gcol = rows2[0], rows2[1]
+        flat = flat.at[idx_p].set(jnp.where(own_p, row_gcol, flat[idx_p]))
+        flat = flat.at[idx_g].set(jnp.where(own_g, row_piv, flat[idx_g]))
+
+        # eliminate below gcol: multipliers + rank-1 on cols > j
+        pivval = row_piv[j]
+        safe = jnp.where(pivval == 0, 1.0, pivval).astype(dtype)
+        belowr = win_gids > gcol
+        mult = jnp.where(belowr, flat[:, j] / safe, 0)
+        flat = flat.at[:, j].set(jnp.where(belowr, mult, flat[:, j]))
+        urow = jnp.where(col_ids > j, row_piv, 0)
+        flat = flat - mult[:, None] * urow[None, :]
+        return flat, piv_pos
+
+    with audit_scope(nb):
+        flat, piv_pos = lax.fori_loop(
+            0, nb, colstep, (flat, jnp.zeros((nb,), win_gids.dtype))
+        )
+
+    # ---- apply the nb transpositions to the stored rows (restricted to
+    # the swap column window; the panel column is overwritten below) ----
+    ident = jnp.arange(mglob)
+
+    def sim(j, sc):
+        pos2row, rp = sc
+        tgt, cur = base + j, piv_pos[j]
+        r1, r2 = pos2row[tgt], pos2row[cur]
+        pos2row = pos2row.at[tgt].set(r2).at[cur].set(r1)
+        pa_, pb_ = rp[tgt], rp[cur]
+        rp = rp.at[tgt].set(pb_).at[cur].set(pa_)
+        return pos2row, rp
+
+    pos2row, rowperm = lax.fori_loop(0, nb, sim, (ident, rowperm))
+    pos = jnp.concatenate([base + jnp.arange(nb), piv_pos])
+    slot_ok = jnp.concatenate([jnp.ones(nb, bool), piv_pos >= base + nb])
+    occ = pos2row[jnp.minimum(pos, mglob - 1)]
+    src = jnp.minimum(occ, mglob - 1)
+    src_t, src_r = src // nb, src % nb
+    own_src = (src_t % p == r) & slot_ok
+    tcols = lax.dynamic_slice(
+        t_loc, (zero, s_cw, zero, zero), (mtl, wlsw, nb, nb)
+    )
+    vals = tcols[jnp.minimum(src_t // p, mtl - 1), :, src_r, :]
+    vals = jnp.where(own_src[:, None, None], vals, 0)
+
+    rows_data = psum_a(vals, ROW_AXIS)
+    dst = jnp.minimum(pos, mglob - 1)
+    dst_t, dst_r = dst // nb, dst % nb
+    own_dst = (dst_t % p == r) & slot_ok
+    dst_loc = jnp.where(own_dst, dst_t // p, mtl)  # mtl -> dropped
+    tcols = tcols.at[dst_loc, :, dst_r, :].set(
+        rows_data.astype(dtype), mode="drop"
+    )
+    t_loc = lax.dynamic_update_slice(t_loc, tcols, (zero, s_cw, zero, zero))
+
+    # ---- write the factored panel into the owning column ----
+    newcol = flat.reshape(wlr, nb, nb)
+    pcol_now = lax.dynamic_slice(
+        t_loc, (s_r, kc32, zero, zero), (wlr, 1, nb, nb)
+    )[:, 0]
+    t_loc = lax.dynamic_update_slice(
+        t_loc,
+        jnp.where(c == k % q, newcol, pcol_now)[:, None],
+        (s_r, kc32, zero, zero),
+    )
+    return t_loc, rowperm
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
 def _pp_jit(at, mesh, p, q, nt, m_true):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
         mtl, ntl, nb, _ = t_loc.shape
-        dtype = t_loc.dtype
         r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
         mglob = nt * nb
-        flat_gids = (i_log[:, None] * nb + jnp.arange(nb)[None, :]).reshape(-1)
-        col_ids = jnp.arange(nb)
+        zero = jnp.zeros((), jnp.int32)
 
         def step(k, carry):
             t_loc, rowperm = carry
-            base = k * nb
-            kc = k // q
-
-            # ---- panel factor with per-column pivoting (getrf panel) ----
-            pcol = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)[:, 0]
-            pan = bcast_from_col(jnp.where(c == k % q, pcol, 0), k % q)
-            flat = pan.reshape(mtl * nb, nb)
-
-            def colstep(j, fc):
-                flat, piv_pos = fc
-                gcol = base + j
-                colv = flat[:, j]
-                active = (flat_gids >= gcol) & (flat_gids < m_true)
-                absv = jnp.where(active, jnp.abs(colv), -1.0)
-                li = jnp.argmax(absv)
-                lv, lgid = absv[li], flat_gids[li]
-
-                gv = all_gather_a(lv, ROW_AXIS)  # (p,)
-                gg = all_gather_a(lgid, ROW_AXIS)
-                maxv = jnp.max(gv)
-                # winner: max |v|; ties -> smallest global row (deterministic,
-                # matches the scan/recursive single-chip tie policy).  No
-                # active candidate (pad column block / gcol >= m_true):
-                # pivot on gcol itself so the identity pad stays intact.
-                piv = jnp.min(jnp.where(gv == maxv, gg, mglob))
-                piv = jnp.where(maxv < 0, gcol, jnp.minimum(piv, mglob - 1))
-                piv_pos = piv_pos.at[j].set(piv)
-
-                # in-panel cross-shard swap rows piv <-> gcol (masked psum)
-                def owner_val(g):
-                    lt = jnp.minimum((g // nb) // p, mtl - 1)
-                    own = ((g // nb) % p == r)
-                    v = flat[lt * nb + g % nb]
-                    return own, lt * nb + g % nb, jnp.where(own, v, 0)
-
-                own_p, idx_p, vp = owner_val(piv)
-                own_g, idx_g, vg = owner_val(gcol)
-
-                rows2 = psum_a(jnp.stack([vp, vg]), ROW_AXIS)  # (2, nb)
-                row_piv, row_gcol = rows2[0], rows2[1]
-                flat = flat.at[idx_p].set(jnp.where(own_p, row_gcol, flat[idx_p]))
-                flat = flat.at[idx_g].set(jnp.where(own_g, row_piv, flat[idx_g]))
-
-                # eliminate below gcol: multipliers + rank-1 on cols > j
-                pivval = row_piv[j]
-                safe = jnp.where(pivval == 0, 1.0, pivval).astype(dtype)
-                belowr = flat_gids > gcol
-                mult = jnp.where(belowr, flat[:, j] / safe, 0)
-                flat = flat.at[:, j].set(jnp.where(belowr, mult, flat[:, j]))
-                urow = jnp.where(col_ids > j, row_piv, 0)
-                flat = flat - mult[:, None] * urow[None, :]
-                return flat, piv_pos
-
-
-            with audit_scope(nb):
-                flat, piv_pos = lax.fori_loop(
-                    0, nb, colstep, (flat, jnp.zeros((nb,), flat_gids.dtype))
-                )
-
-            # ---- apply the nb transpositions to the full rows (all column
-            # blocks; the panel column is overwritten below) ----
-            ident = jnp.arange(mglob)
-
-            def sim(j, sc):
-                pos2row, rp = sc
-                tgt, cur = base + j, piv_pos[j]
-                r1, r2 = pos2row[tgt], pos2row[cur]
-                pos2row = pos2row.at[tgt].set(r2).at[cur].set(r1)
-                pa_, pb_ = rp[tgt], rp[cur]
-                rp = rp.at[tgt].set(pb_).at[cur].set(pa_)
-                return pos2row, rp
-
-            pos2row, rowperm = lax.fori_loop(0, nb, sim, (ident, rowperm))
-            pos = jnp.concatenate([base + jnp.arange(nb), piv_pos])
-            slot_ok = jnp.concatenate(
-                [jnp.ones(nb, bool), piv_pos >= base + nb]
+            t_loc, rowperm = _pp_panel_and_swaps(
+                t_loc, rowperm, k, p, q, r, c, nt, m_true,
+                zero, mtl, zero, ntl,
             )
-            occ = pos2row[jnp.minimum(pos, mglob - 1)]
-            src = jnp.minimum(occ, mglob - 1)
-            src_t, src_r = src // nb, src % nb
-            own_src = (src_t % p == r) & slot_ok
-            vals = t_loc[jnp.minimum(src_t // p, mtl - 1), :, src_r, :]
-            vals = jnp.where(own_src[:, None, None], vals, 0)
-
-            rows_data = psum_a(vals, ROW_AXIS)
-            dst = jnp.minimum(pos, mglob - 1)
-            dst_t, dst_r = dst // nb, dst % nb
-            own_dst = (dst_t % p == r) & slot_ok
-            dst_loc = jnp.where(own_dst, dst_t // p, mtl)  # mtl -> dropped
-            t_loc = t_loc.at[dst_loc, :, dst_r, :].set(
-                rows_data.astype(dtype), mode="drop"
-            )
-
-            # ---- write the factored panel into the owning column ----
-            newcol = flat.reshape(mtl, nb, nb)
-            pcol_now = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)[:, 0]
-            t_loc = lax.dynamic_update_slice_in_dim(
-                t_loc,
-                jnp.where(c == k % q, newcol, pcol_now)[:, None],
-                kc,
-                axis=1,
-            )
-
             # ---- shared tail: row solve + trailing update ----
             return (
                 _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c, panel_done=True),
                 rowperm,
             )
 
+        rowperm0 = jnp.arange(mglob)
+        with audit_scope(nt):
+            t_loc, rowperm = lax.fori_loop(0, nt, step, (t_loc, rowperm0))
+        info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
+        return t_loc, rowperm[None], info[None, None]
+
+    lut, perm, info = shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=(spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS)),
+        check_vma=False,
+    )(at)
+    return lut, perm[0], jnp.max(info)
+
+
+def gbtrf_band_dist(
+    a: DistMatrix, kl: int, ku: int
+) -> Tuple[DistMatrix, jax.Array, jax.Array]:
+    """Band partial-pivot LU on the mesh at band cost (src/gbtrf.cc):
+    the shared getrf_pp_dist pivoting/swap machinery (_pp_panel_and_swaps)
+    with every phase windowed to the band envelope — the panel's candidate
+    rows to the wd_l tile rows that can be nonzero, the swap application
+    to the column window holding a band row's L history (columns
+    >= g - kl) and U fill (columns <= g + kl + ku), and the row solve +
+    trailing update to the wd_l x wd_u tile window.  Tiles outside the
+    envelope are never read or written (VERDICT r5 item 8); total work is
+    O(n (kl + nb)(kl + ku + nb)) — the band-cost class at tile
+    granularity (the nb terms are the blocking overhead every blocked
+    band LU pays)."""
+    p, q = mesh_shape(a.mesh)
+    if a.mt != a.nt:
+        raise ValueError("gbtrf_band_dist needs a square tile grid")
+    a.require_diag_pad("gbtrf_band_dist")
+    nb = a.nb
+    wd_l = min(((nb - 1) + kl) // nb + 1, a.nt)  # rows touched per panel
+    wd_u = min(((nb - 1) + kl + ku) // nb + 1, a.nt)  # U fill-in width
+    # swap column window: L history of an in-window row reaches left to
+    # tile k - (wd_l - 1); its U fill right to tile k + wd_usw - 1
+    wd_usw = min(((nb - 1) + 2 * kl + ku) // nb + 1, a.nt)
+    lut, perm, info = _gb_pp_jit(
+        a.tiles, a.mesh, p, q, a.nt, a.m, wd_l, wd_u, wd_usw
+    )
+    return (
+        DistMatrix(tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True),
+        perm,
+        info,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8))
+def _gb_pp_jit(at, mesh, p, q, nt, m_true, wd_l, wd_u, wd_usw):
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(t_loc):
+        mtl, ntl, nb, _ = t_loc.shape
+        # local slots covering the wd_l-row / wd_u-col windows and the
+        # swap column window (clamped: a wide band degenerates to the
+        # dense schedule)
+        wlr = min(-(-wd_l // p) + 1, mtl)
+        wlc = min(-(-wd_u // q) + 1, ntl)
+        wlsw = min(-(-((wd_l - 1) + wd_usw) // q) + 1, ntl)
+        dtype = t_loc.dtype
+        eye = jnp.eye(nb, dtype=dtype)
+        r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
+        mglob = nt * nb
+
+        def step(k, carry):
+            t_loc, rowperm = carry
+            kc = k // q
+            kr = k // p
+            zero = jnp.zeros((), jnp.int32)
+            kr32 = jnp.asarray(kr, jnp.int32)
+
+            # ---- shared pivot panel + swaps, windowed to the band: the
+            # candidate rows live in tiles [k, k+wd_l); a swapped row's
+            # nonzeros in tiles [k-(wd_l-1), k+wd_usw) ----
+            s_r = jnp.asarray(
+                jnp.clip((k - r + p - 1) // p, 0, mtl - wlr), jnp.int32
+            )
+            k0 = jnp.maximum(k - (wd_l - 1), 0)
+            s_cw = jnp.asarray(
+                jnp.clip((k0 - c + q - 1) // q, 0, ntl - wlsw), jnp.int32
+            )
+            t_loc, rowperm = _pp_panel_and_swaps(
+                t_loc, rowperm, k, p, q, r, c, nt, m_true,
+                s_r, wlr, s_cw, wlsw,
+            )
+
+            # ---- windowed tail: row solve + trailing update only inside
+            # the band envelope (the band-cost skip) ----
+            luk = bcast_diag_tile(t_loc, k, p, q, nb)
+            s_c = jnp.asarray(jnp.clip((k - c + q - 1) // q, 0, ntl - wlc), jnp.int32)
+            j_win = c + (s_c + jnp.arange(wlc)) * q
+            roww = lax.dynamic_slice(t_loc, (kr32, s_c, zero, zero), (1, wlc, nb, nb))[0]
+            usolved = lax.linalg.triangular_solve(
+                jnp.broadcast_to(jnp.tril(luk, -1) + eye, roww.shape), roww,
+                left_side=True, lower=True, transpose_a=False,
+                unit_diagonal=True,
+            )
+            right = (j_win > k)[:, None, None]
+            newrow = jnp.where(right, usolved, roww)
+            mine_r = r == k % p
+            t_loc = lax.dynamic_update_slice(
+                t_loc, jnp.where(mine_r, newrow, roww)[None], (kr32, s_c, zero, zero)
+            )
+
+            i_win = r + (s_r + jnp.arange(wlr)) * p
+            kc32 = jnp.asarray(kc, jnp.int32)
+            colw = lax.dynamic_slice(t_loc, (s_r, kc32, zero, zero), (wlr, 1, nb, nb))[:, 0]
+            below = (i_win > k)[:, None, None]
+            mine_c = c == k % q
+            pan = bcast_from_col(jnp.where(below & mine_c, colw, 0), k % q)
+            urow = bcast_from_row(jnp.where(right & mine_r, newrow, 0), k % p)
+            upd = jnp.einsum("iab,jbc->ijac", pan, urow, precision=PRECISE)
+            win = lax.dynamic_slice(t_loc, (s_r, s_c, zero, zero), (wlr, wlc, nb, nb))
+            win = win - upd.astype(dtype)
+            t_loc = lax.dynamic_update_slice(t_loc, win, (s_r, s_c, zero, zero))
+            return t_loc, rowperm
 
         rowperm0 = jnp.arange(mglob)
         with audit_scope(nt):

@@ -55,11 +55,12 @@ def trsm_dist(
     ``method`` picks the communication schedule (slate::trsm's MethodTrsm,
     method.hh:88-99): TrsmB broadcasts the A panel to B's owners each
     step; TrsmA keeps A's tiles stationary — the solved X row is
-    replicated, A's owners compute the update partials in place, and a
-    reduce-scatter (plus, for transposed ops, a row broadcast of the
-    routed partials) delivers each owner its tiles — the win when B is
-    far thinner than A.  All (uplo, op) combinations run the stationary
-    schedule (src/trsmA.cc covers every op).  None = auto-select."""
+    replicated, A's owners compute the update partials in place, and
+    psum-scatters deliver each owner exactly its own tiles (for the
+    transposed ops, routed per target row by
+    comm.route_to_block_cyclic_rows) — the win when B is far thinner
+    than A.  All (uplo, op) combinations run the stationary schedule
+    (src/trsmA.cc covers every op).  None = auto-select."""
     p, q = mesh_shape(a.mesh)
     if b.grid != a.grid or b.nb != a.nb or b.mt != a.nt or b.m != a.n:
         raise ValueError(
@@ -85,9 +86,10 @@ def _trsm_a_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
     against A's stationary tiles where they live — column k of A for
     op = NoTrans, row k (transposed per tile) otherwise — then the
     partials are routed to B's block-cyclic owners: a psum-scatter over
-    the column axis for NoTrans, plus a scatter into target-row slots
-    and a row broadcast for the transposed ops (whose source row k % p
-    differs from the destination rows i % p).  A never moves."""
+    the column axis for NoTrans, and the shared slot-scatter +
+    double-psum-scatter delivery (comm.route_to_block_cyclic_rows) for
+    the transposed ops, whose source row k % p differs from the
+    destination rows i % p.  A never moves."""
     spec = P(ROW_AXIS, COL_AXIS)
     trans = op != Op.NoTrans
     conj = op == Op.ConjTrans
@@ -148,7 +150,7 @@ def _trsm_a_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
             # tiles are A's ROW k, held by mesh row k % p spread over the
             # columns i % q; the partial for output row i must reach mesh
             # row i % p (generally != k % p), so partials are scattered
-            # into per-target-row slots, column-reduced, then row-broadcast
+            # into per-target-row slots and psum-scattered on both axes
             remaining = (j_log > k) if forward else (j_log < k)
             arow = lax.dynamic_slice_in_dim(a_loc, kr, 1, axis=0)[0]  # (ntl,nb,nb)
             pan = opt(arow)

@@ -898,6 +898,41 @@ def test_nonuniform_size_mismatch_raises(rng):
         from_dense_nonuniform(_rand(rng, 64, 64), mesh22(), [32, 16], [32, 32])
 
 
+def test_nonuniform_factorizations(rng):
+    # ex13 parity (VERDICT r5 item 6): real algorithms on non-uniformly
+    # tiled input — Cholesky and pivoted LU end-to-end through the
+    # device-resident non-uniform -> uniform redistribution
+    from slate_tpu.parallel import (
+        from_dense_nonuniform, redistribute_nonuniform, to_dense,
+        trsm_dist, from_dense,
+    )
+    from slate_tpu.parallel.dist_chol import potrf_dist
+    from slate_tpu.parallel.dist_lu import getrf_pp_dist, permute_rows_dist
+
+    mesh = mesh24()
+    n = 96
+    rowsz = [16, 8, 24, 16, 8, 24]
+    a = _spd(rng, n)
+    ad_nu = from_dense_nonuniform(a, mesh, rowsz, rowsz)
+    ad = redistribute_nonuniform(ad_nu, rowsz, rowsz, nb=16, diag_pad_one=True)
+    l, info = potrf_dist(ad)
+    assert int(info) == 0
+    ld = np.tril(np.asarray(to_dense(l)))
+    assert np.abs(ld @ ld.T - np.asarray(a)).max() / np.abs(np.asarray(a)).max() < 1e-12
+
+    g = _rand(rng, n, n)
+    gd_nu = from_dense_nonuniform(g, mesh, rowsz, rowsz)
+    gd = redistribute_nonuniform(gd_nu, rowsz, rowsz, nb=16, diag_pad_one=True)
+    lu, perm, info2 = getrf_pp_dist(gd)
+    assert int(info2) == 0
+    b = _rand(rng, n, 4)
+    bd = permute_rows_dist(from_dense(b, mesh, 16), perm)
+    y = trsm_dist(lu, bd, Uplo.Lower, Op.NoTrans, Diag.Unit)
+    x = to_dense(trsm_dist(lu, y, Uplo.Upper, Op.NoTrans))
+    resid = np.abs(np.asarray(g) @ np.asarray(x) - np.asarray(b)).max()
+    assert resid / np.abs(np.asarray(b)).max() < 1e-10
+
+
 def test_grid_order_col(rng):
     from slate_tpu.parallel import gemm_mesh
     from slate_tpu.types import GridOrder
@@ -966,6 +1001,50 @@ def test_tbsm_pbsv_gbsv_mesh(rng):
     assert np.abs(gb @ np.asarray(xg) - b).max() / np.abs(b).max() < 1e-12
 
 
+def test_band_mesh_kernels_band_cost(rng):
+    # VERDICT r5 item 8 gate: the windowed band kernels do O(n k^2)-class
+    # work — their compiled flop count must sit far below the dense mesh
+    # factorization's O(n^3)-class count at the same size
+    from slate_tpu.parallel.dist_chol import _pbtrf_band_jit, _potrf_jit
+    from slate_tpu.parallel.dist_lu import _gb_pp_jit, _pp_jit
+    from slate_tpu.parallel import from_dense
+
+    mesh = mesh24()
+    n, nb, kd = 512, 16, 32
+    tiles = from_dense(jnp.eye(n), mesh, nb, diag_pad_one=True).tiles
+    nt = n // nb
+    wd = ((nb - 1) + kd) // nb + 1
+
+    def flops(compiled):
+        return compiled.cost_analysis()["flops"]
+
+    dense = _potrf_jit.lower(tiles, mesh, 2, 4, nt).compile()
+    band = _pbtrf_band_jit.lower(tiles, mesh, 2, 4, nt, wd).compile()
+    assert flops(band) < flops(dense) / 4, (flops(band), flops(dense))
+
+    dense_lu = _pp_jit.lower(tiles, mesh, 2, 4, nt, n).compile()
+    wd_u = ((nb - 1) + 2 * kd) // nb + 1
+    wd_usw = ((nb - 1) + 3 * kd) // nb + 1
+    band_lu = _gb_pp_jit.lower(tiles, mesh, 2, 4, nt, n, wd, wd_u, wd_usw).compile()
+    assert flops(band_lu) < flops(dense_lu) / 4, (flops(band_lu), flops(dense_lu))
+
+
+def test_band_mesh_wide_band(rng):
+    # windowed kernels with kd wide enough that the window IS the grid:
+    # degenerates to the dense schedule, stays correct
+    from slate_tpu.parallel import pbsv_mesh
+
+    mesh = mesh22()
+    n, kd = 64, 60
+    hb = _band(rng, n, kd, kd)
+    spd = hb @ hb.T + n * np.eye(n)
+    spd = np.where(np.abs(np.subtract.outer(np.arange(n), np.arange(n))) <= kd, spd, 0)
+    b = np.asarray(_rand(rng, n, 3))
+    x, info = pbsv_mesh(jnp.asarray(spd), jnp.asarray(b), kd, mesh, nb=16)
+    assert int(info) == 0
+    assert np.abs(spd @ np.asarray(x) - b).max() / np.abs(b).max() < 1e-9
+
+
 def test_chase_apply_dist_matches_replicated(rng):
     # streamed sharded stage-2 back-transform == the single-program apply
     from slate_tpu.linalg.eig import _chase_sweep_apply, hb2st
@@ -1003,3 +1082,24 @@ def test_chase_apply_dist_memory():
     # sharded run must stay well under half the replicated footprint
     # (measures: z/8 + vs/8 + one streamed block + slack)
     assert per_dev < 0.45 * repl, (per_dev, repl)
+
+
+def test_stedc_finale_memory():
+    # VERDICT r4 item 6 gate: the stedc -> chase handoff is sharded, so
+    # the whole heev_mesh stage-2 chain (merge tree out-spec, finale,
+    # chase) keeps per-device peak O(n^2/p) — no replicated (n, n) Z at
+    # the driver boundary.  memory_analysis reports PER-DEVICE sizes.
+    from slate_tpu.parallel.dist_stedc import _stedc_finale_jit
+
+    mesh = mesh24()
+    p, q, n, N = 2, 4, 960, 1024
+    z = jnp.zeros((N, N), jnp.float64)
+    inv = jnp.arange(N)
+    order = jnp.arange(n)
+    c = _stedc_finale_jit.lower(z, inv, order, mesh, p, q, n).compile()
+    ma = c.memory_analysis()
+    per_dev = ma.argument_size_in_bytes + ma.temp_size_in_bytes + ma.output_size_in_bytes
+    repl = 2 * N * N * 8  # replicated in+out footprint
+    # input shard N^2/p + one N*(n/q) gather buffer + small temps: the
+    # per-device peak must stay under the replicated INPUT alone (N^2)
+    assert per_dev < 0.5 * repl, (per_dev, repl)
