@@ -36,7 +36,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..linalg.eig import _he2hb_panel_count
 from ..linalg.qr import _larft_v, _panel_qr_offset
-from .comm import PRECISE, bcast_from_col, bcast_from_row, local_indices, shard_map
+from .comm import (PRECISE, all_gather_a, bcast_from_col, bcast_from_row,
+                   local_indices, psum_a, shard_map)
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 
@@ -48,7 +49,7 @@ def _to_global_rows(x_loc: jax.Array, nparts: int, nb: int, axis_name: str):
     to logical tile index i = slot * nparts + r."""
     mfl, w = x_loc.shape
     mtl = mfl // nb
-    ag = lax.all_gather(x_loc, axis_name, axis=0)  # (nparts, mfl, w)
+    ag = all_gather_a(x_loc, axis_name, axis=0)  # (nparts, mfl, w)
     ag = ag.reshape(nparts, mtl, nb, w).transpose(1, 0, 2, 3)
     return ag.reshape(mtl * nparts * nb, w)
 
@@ -137,7 +138,7 @@ def _he2hb_jit(at, mesh, p, q, n_true, nb, nsteps):
             v_rows = v[rg]
             v_cols = jnp.where(cg_ok, v[jnp.minimum(cg, mglob - 1)], 0)
             y_part = jnp.einsum("rc,ci->ri", a, v_cols, precision=PRECISE)
-            y = lax.psum(y_part, COL_AXIS)
+            y = psum_a(y_part, COL_AXIS)
             y = jnp.where((rg >= c0)[:, None], y, 0).astype(dtype)
             yg = _to_global_rows(y, p, nb, ROW_AXIS)
             wmat = jnp.einsum("ri,ij->rj", yg, t, precision=PRECISE)
@@ -200,7 +201,7 @@ def _apply_row_panels_jit(vqs, tqs, zt, mesh, p, q, adjoint):
             k = i if adjoint else nsteps - 1 - i
             v = vq_loc[k]
             t = jnp.conj(tq[k]).T if adjoint else tq[k]
-            w1 = lax.psum(
+            w1 = psum_a(
                 jnp.einsum("ri,rc->ic", jnp.conj(v), z, precision=PRECISE),
                 ROW_AXIS,
             )
@@ -270,7 +271,7 @@ def _ge2tb_jit(at, mesh, p, q, m_true, n_true, nb, nblocks):
             tq = _larft_v(vq, tauq)
             # left trailing update on cols >= j1: A -= Vq T^H (Vq^H A)
             vq_rows = vq[rg]
-            w1 = lax.psum(
+            w1 = psum_a(
                 jnp.einsum("ri,rc->ic", jnp.conj(vq_rows), a, precision=PRECISE),
                 ROW_AXIS,
             )
@@ -300,7 +301,7 @@ def _ge2tb_jit(at, mesh, p, q, m_true, n_true, nb, nblocks):
             tl = tl * jnp.asarray(lq_active, dtype)
             # right trailing update on rows >= j1: A -= (A Vl) Tl Vl^H
             vl_cols = vl[cg]
-            w2 = lax.psum(
+            w2 = psum_a(
                 jnp.einsum("rc,ci->ri", a, vl_cols, precision=PRECISE), COL_AXIS
             )
             upd2 = jnp.einsum(
@@ -382,7 +383,7 @@ def _apply_col_panels_jit(vls, tls, zt, mesh, p, q):
             gvl = _to_global_rows(vl_loc[k], q, nb, COL_AXIS)
             v = gvl[jnp.minimum(rg, gvl.shape[0] - 1)]
             v = jnp.where((rg < gvl.shape[0])[:, None], v, 0)
-            w1 = lax.psum(
+            w1 = psum_a(
                 jnp.einsum("ri,rc->ic", jnp.conj(v), z, precision=PRECISE),
                 ROW_AXIS,
             )
@@ -448,7 +449,7 @@ def _gather_diagband_jit(tiles, mesh, p, q, nb, w):
         out = out.at[flat_rows, flat_dd].add(
             vals.transpose(0, 2, 1, 3).reshape(-1), mode="drop"
         )
-        return lax.psum(out, (ROW_AXIS, COL_AXIS))
+        return psum_a(out, (ROW_AXIS, COL_AXIS))
 
     return shard_map(
         kernel,
@@ -497,8 +498,8 @@ def _chase_apply_dist_jit(vs, taus, z, mesh, p, q, n, w, blk):
         def body(b, z_loc):
             src = nparts - 1 - b  # reverse chronological block order
             sel = me == src
-            vs_b = lax.psum(jnp.where(sel, vs_loc, 0), both)
-            ta_b = lax.psum(jnp.where(sel, ta_loc, 0), both)
+            vs_b = psum_a(jnp.where(sel, vs_loc, 0), both)
+            ta_b = psum_a(jnp.where(sel, ta_loc, 0), both)
             return _chase_sweep_apply(vs_b, ta_b, z_loc, n, w, False, j0=src * blk)
 
         return lax.fori_loop(0, nparts, body, z_loc)

@@ -41,7 +41,12 @@ def summarize(records, p, q):
     payload = recv = calls = 0
     by_op = {}
     for op, nbytes, mult in records:
-        s = p if "[p]" in op else q
+        if "[p]" in op:
+            s = p
+        elif "[q]" in op:
+            s = q
+        else:  # tuple axis, e.g. psum[('p', 'q')] (chase_apply streaming)
+            s = p * q
         if op.startswith("psum_scatter"):
             r = nbytes * (s - 1) / s
         elif op.startswith("psum"):
@@ -91,17 +96,68 @@ def main():
         payload, recv, calls, by_op = summarize(recs, p, q)
         rows.append((name, payload, recv, calls, by_op, flops))
 
+    from slate_tpu.parallel import heev_mesh, trsm_dist
+    from slate_tpu.parallel.dist_blas3 import hemm_summa
+    from slate_tpu.parallel.dist_chol import pbtrf_band_dist
+    from slate_tpu.parallel.dist_lu import gbtrf_band_dist
+    from slate_tpu.types import MethodHemm, MethodTrsm, Op, Side, Uplo
+
+    nrhs = max(nb, n // 16)  # thin RHS: the stationary-A regime
+    b_thin = jnp.asarray(rng.standard_normal((n, nrhs)).astype(np.float32))
+
     ad = from_dense(a, mesh, nb)
     bd = from_dense(a, mesh, nb)
     run("gemm_summa (C-stationary)",
         lambda: gemm_summa(1.0, ad, bd, method=MethodGemm.GemmC).tiles.block_until_ready(),
         2 * n**3)
+    btd = from_dense(b_thin, mesh, nb)
+    run("gemm_summa (A-stationary, thin C)",
+        lambda: gemm_summa(1.0, ad, btd, method=MethodGemm.GemmA).tiles.block_until_ready(),
+        2 * n**2 * nrhs)
     sd = from_dense(spd, mesh, nb, diag_pad_one=True)
     run("potrf_dist", lambda: potrf_dist(sd)[0].tiles.block_until_ready(),
         n**3 / 3)
     gd = from_dense(a, mesh, nb, diag_pad_one=True)
     run("getrf_pp_dist", lambda: getrf_pp_dist(gd)[0].tiles.block_until_ready(),
         2 * n**3 / 3)
+    # stationary-A solves/multiplies (VERDICT r5 item 7): thin B
+    tlow = jnp.asarray((np.tril(np.asarray(a)) + n * np.eye(n)).astype(np.float32))
+    td = from_dense(tlow, mesh, nb, diag_pad_one=True)
+    run("trsm_dist TrsmA (NoTrans, thin B)",
+        lambda: trsm_dist(td, btd, Uplo.Lower, Op.NoTrans,
+                          method=MethodTrsm.TrsmA).tiles.block_until_ready(),
+        n**2 * nrhs)
+    run("trsm_dist TrsmA (Trans, thin B)",
+        lambda: trsm_dist(td, btd, Uplo.Lower, Op.Trans,
+                          method=MethodTrsm.TrsmA).tiles.block_until_ready(),
+        n**2 * nrhs)
+    hd = from_dense(spd, mesh, nb)
+    run("hemm_summa HemmA (thin B)",
+        lambda: hemm_summa(Side.Left, 1.0, hd, btd, uplo=Uplo.Lower,
+                           conj=False, method=MethodHemm.HemmA).tiles.block_until_ready(),
+        2 * n**2 * nrhs)
+    # band kernels at band cost (VERDICT r5 item 8)
+    kd = 2 * nb
+    iv = np.arange(n)
+    bmask = np.abs(np.subtract.outer(iv, iv)) <= kd
+    spd_band = jnp.asarray(np.where(bmask, np.asarray(spd), 0).astype(np.float32)
+                           + kd * np.eye(n, dtype=np.float32))
+    sbd = from_dense(spd_band, mesh, nb, diag_pad_one=True)
+    run(f"pbtrf_band_dist (kd={kd})",
+        lambda: pbtrf_band_dist(sbd, kd)[0].tiles.block_until_ready(),
+        n * kd * kd)
+    gb = jnp.asarray(np.where(bmask, np.asarray(a), 0).astype(np.float32)
+                     + kd * np.eye(n, dtype=np.float32))
+    gbd = from_dense(gb, mesh, nb, diag_pad_one=True)
+    run(f"gbtrf_band_dist (kl=ku={kd})",
+        lambda: gbtrf_band_dist(gbd, kd, kd)[0].tiles.block_until_ready(),
+        2 * n * kd * 2 * kd)
+    # the full distributed eig chain (VERDICT r5 item 7): he2hb + band
+    # gather + sharded stedc + streamed chase + stage-1 back-transform
+    heig = jnp.asarray(((np.asarray(a) + np.asarray(a).T) / 2).astype(np.float32))
+    run("heev_mesh (vectors, full chain)",
+        lambda: jax.block_until_ready(heev_mesh(heig, mesh, nb=16)[1]),
+        4 * n**3 / 3)
 
     lines = [
         "# Collective-volume audit (8-device CPU mesh, trace-time byte counters)",
@@ -140,6 +196,15 @@ def main():
         "all_gathers dominate call counts at O(n) tiny messages, the",
         "documented cost of partial pivoting (reference: per-column",
         "MPI exchanges in Tile_getrf.hh / internal_swap.cc).",
+        "",
+        "Stationary-A rows (trsmA / gemmA / hemmA, thin B): received",
+        "volume is B/C-sized, far below the n^2-class stationary-C rows —",
+        "A never moves, the stationary-A win (src/trsmA.cc, hemmA.cc).",
+        "Band rows: volumes collapse to the O(n k)-class window traffic",
+        "(tiles outside the band are never communicated).  The heev_mesh",
+        "row audits the whole distributed eig chain — he2hb two-sided",
+        "updates, band gather, sharded stedc merges, the streamed chase",
+        "back-transform (psum over both axes), and unmtr_he2hb.",
     ]
     out = os.path.abspath(args.out)
     os.makedirs(os.path.dirname(out), exist_ok=True)

@@ -317,16 +317,23 @@ elif routine == "getrf_f64":
     rng = _np.random.default_rng(0)
     a = jax.device_put(rng.standard_normal((n, n)) / 64)
     _ = float(jnp.sum(a[:1, :4]))
-    f = jax.jit(lambda x: getrf_array(x))
+    # donate the input: the 16384 f64 program peaks ~14.4 GB un-donated
+    # (memory_analysis) — aliasing the 2 GB input is what fits v5e HBM
+    f = jax.jit(lambda x: getrf_array(x), donate_argnums=0)
     out = f(a)
     dmin = float(jnp.min(jnp.abs(jnp.diagonal(out.lu))))
-    a2 = jax.block_until_ready(a + 1e-9)
-    _ = float(jnp.sum(a2[:1, :4]))
+    del out, a
+    # timed run on a donated input; the matrix is rebuilt from its seed
+    # AFTER the factorization for the residual check (nothing but the
+    # program's own buffers is resident while it runs)
+    a2_in = jax.device_put(_np.random.default_rng(7).standard_normal((n, n)) / 64)
+    _ = float(jnp.sum(a2_in[:1, :4]))
     t0 = time.perf_counter()
-    out = f(a2)
+    out = f(a2_in)
     dmin = float(jnp.min(jnp.abs(jnp.diagonal(out.lu))))
     t1 = time.perf_counter()
     info = int(out.info)
+    a2 = jax.device_put(_np.random.default_rng(7).standard_normal((n, n)) / 64)
     # residual via matvec columns, CHUNKED (see potrf_f64 note): P A x vs
     # L (U x) with triangles taken per row chunk
     xv = jax.device_put(rng.standard_normal((n, 4)))
