@@ -257,20 +257,58 @@ def _wavefront_chase_band(
     K row slabs (3w, 4w), shears them into dense (3w, 3w) windows for the
     vmapped ``one`` update, shears back, and scatters.  Entries of a slab
     row outside its 3w window (band columns left of the window) are
-    preserved by the shear-back mask.  This is the TPU answer to the
-    reference's cache-resident pipelined taskloop (hb2st.cc:170-281):
-    the working set now FITS fast memory instead of restreaming HBM."""
+    preserved by the shear-back mask.
+
+    The shears run as PAD + FLATTEN + STRIDED-RESHAPE moves, not as
+    take_along_axis and not as matmuls: element-wise gathers execute on
+    the TPU scalar unit at ~30 ms per step for these shapes (measured on
+    chip, round 5) — slow enough that the worker's long-program watchdog
+    killed every chase past ~1500 steps — and a one-hot einsum shear is
+    fast but NOT bit-exact (XLA's dgemm reassociation adds a few ulp of
+    noise per hop, which the chase's eliminated-entry bookkeeping
+    amplifies catastrophically; observed as O(1) singular-value errors).
+    A row shift by r is index algebra: padding each length-D row to
+    width W and reading the flat buffer at offset 2w with row stride
+    W - 1 realigns every row's band columns to block columns in one
+    reshape — exact data movement, zero flops.  This is the TPU answer
+    to the reference's cache-resident pipelined taskloop
+    (hb2st.cc:170-281): the working set FITS fast memory and every
+    reshape is a layout move."""
     D = 4 * w
     k_slots = max_hops // 4 + 1
     islot = jnp.arange(k_slots)
     w3 = 3 * w
     pad = 4 * w
     rr = jnp.arange(w3)
-    # shear indices: block[r, c] = slab[r, c - r + 2w]; slab[r, dd] = block[r, r + dd - 2w]
-    dd_idx = rr[None, :] - rr[:, None] + 2 * w  # (3w, 3w) band col per (r, c)
-    ok_g = (dd_idx >= 0) & (dd_idx < D)
     cidx = rr[:, None] + jnp.arange(D)[None, :] - 2 * w  # (3w, D) block col per (r, dd)
     ok_s = (cidx >= 0) & (cidx < w3)
+
+    def shear_in(slabs):
+        """block[k, r, c] = slab[k, r, c - r + 2w], 0 outside [0, D).
+
+        Pad rows to width W = 5w; in the flat row-major buffer the wanted
+        entry sits at r*W + (c - r + 2w) = 2w + r*(W - 1) + c, so a
+        reshape with row stride W - 1 starting at offset 2w IS the shear;
+        out-of-band reads land in a neighbor row's zero padding."""
+        K = slabs.shape[0]
+        W = 5 * w
+        p = jnp.concatenate([slabs, jnp.zeros((K, w3, W - D), slabs.dtype)], axis=2)
+        flat = p.reshape(K, w3 * W)
+        return flat[:, 2 * w : 2 * w + w3 * (W - 1)].reshape(K, w3, W - 1)[:, :, :w3]
+
+    def shear_out(blocks):
+        """raw[k, r, d] = block[k, r, r + d - 2w] (junk outside [0, 3w),
+        masked by ok_s after).  Same trick with the opposite shift: pad
+        rows to width W2 = 5w, prepend 2w zeros, read with row stride
+        W2 + 1."""
+        K = blocks.shape[0]
+        W2 = 5 * w
+        p = jnp.concatenate([blocks, jnp.zeros((K, w3, W2 - w3), blocks.dtype)], axis=2)
+        flat = jnp.concatenate(
+            [jnp.zeros((K, 2 * w), blocks.dtype), p.reshape(K, w3 * W2),
+             jnp.zeros((K, w), blocks.dtype)], axis=1,
+        )
+        return flat[:, : w3 * (W2 + 1)].reshape(K, w3, W2 + 1)[:, :, :D]
 
     def step_body(s, carry):
         ba, *fs = carry
@@ -281,16 +319,11 @@ def _wavefront_chase_band(
         nact = jnp.where(valid, jnp.clip(n - r0, 0, w), 0)
         b0 = jnp.where(valid, pad + r0 - w, 0)
         slabs = jax.vmap(lambda b: lax.dynamic_slice(ba, (b, 0), (w3, D)))(b0)
-        blocks = jnp.where(
-            ok_g[None], jnp.take_along_axis(slabs, jnp.clip(dd_idx, 0, D - 1)[None].repeat(k_slots, 0), axis=2), 0
-        )
+        blocks = shear_in(slabs)
         idx0 = jnp.where(t == 0, w - 1, 0)
         blocks, *vals = jax.vmap(one)(blocks, idx0, nact)
-        newslabs = jnp.where(
-            ok_s[None],
-            jnp.take_along_axis(blocks, jnp.clip(cidx, 0, w3 - 1)[None].repeat(k_slots, 0), axis=2),
-            slabs,
-        )
+        # band columns outside the 3w window keep their slab values
+        newslabs = jnp.where(ok_s[None], shear_out(blocks), slabs)
 
         def put(i, ba):
             return lax.dynamic_update_slice(ba, newslabs[i], (b0[i], 0))

@@ -36,8 +36,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..linalg.eig import _he2hb_panel_count
 from ..linalg.qr import _larft_v, _panel_qr_offset
-from .comm import (PRECISE, all_gather_a, bcast_from_col, bcast_from_row,
-                   local_indices, psum_a, shard_map)
+from .comm import (PRECISE, all_gather_a, audit_scope, bcast_from_col,
+                   bcast_from_row, local_indices, psum_a, shard_map)
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 
@@ -160,7 +160,8 @@ def _he2hb_jit(at, mesh, p, q, n_true, nb, nsteps):
         vqs0 = jnp.zeros((max(nsteps, 1), mfl, nb), dtype)
         tqs0 = jnp.zeros((max(nsteps, 1), nb, nb), dtype)
         if nsteps:
-            a, vqs, tqs = lax.fori_loop(0, nsteps, step, (a, vqs0, tqs0))
+            with audit_scope(nsteps):
+                a, vqs, tqs = lax.fori_loop(0, nsteps, step, (a, vqs0, tqs0))
         else:
             vqs, tqs = vqs0, tqs0
         t_out = jnp.transpose(a.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
@@ -208,7 +209,8 @@ def _apply_row_panels_jit(vqs, tqs, zt, mesh, p, q, adjoint):
             upd = jnp.einsum("ri,ij,jc->rc", v, t, w1, precision=PRECISE)
             return z - upd.astype(dtype)
 
-        z = lax.fori_loop(0, nsteps, body, z)
+        with audit_scope(nsteps):
+            z = lax.fori_loop(0, nsteps, body, z)
         return jnp.transpose(z.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
 
     return shard_map(
@@ -328,9 +330,10 @@ def _ge2tb_jit(at, mesh, p, q, m_true, n_true, nb, nblocks):
         tqs0 = jnp.zeros((nblocks, nb, nb), dtype)
         vls0 = jnp.zeros((nblocks, nfl, nb), dtype)
         tls0 = jnp.zeros((nblocks, nb, nb), dtype)
-        a, vqs, tqs, vls, tls = lax.fori_loop(
-            0, nblocks, step, (a, vqs0, tqs0, vls0, tls0)
-        )
+        with audit_scope(nblocks):
+            a, vqs, tqs, vls, tls = lax.fori_loop(
+                0, nblocks, step, (a, vqs0, tqs0, vls0, tls0)
+            )
         t_out = jnp.transpose(a.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
         return t_out, vqs, tqs, vls, tls
 
@@ -390,7 +393,8 @@ def _apply_col_panels_jit(vls, tls, zt, mesh, p, q):
             upd = jnp.einsum("ri,ij,jc->rc", v, tl[k], w1, precision=PRECISE)
             return z - upd.astype(dtype)
 
-        z = lax.fori_loop(0, nsteps, body, z)
+        with audit_scope(nsteps):
+            z = lax.fori_loop(0, nsteps, body, z)
         return jnp.transpose(z.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
 
     return shard_map(
@@ -502,7 +506,8 @@ def _chase_apply_dist_jit(vs, taus, z, mesh, p, q, n, w, blk):
             ta_b = psum_a(jnp.where(sel, ta_loc, 0), both)
             return _chase_sweep_apply(vs_b, ta_b, z_loc, n, w, False, j0=src * blk)
 
-        return lax.fori_loop(0, nparts, body, z_loc)
+        with audit_scope(nparts):
+            return lax.fori_loop(0, nparts, body, z_loc)
 
     return shard_map(
         kernel,

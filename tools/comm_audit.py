@@ -156,7 +156,7 @@ def main():
     # gather + sharded stedc + streamed chase + stage-1 back-transform
     heig = jnp.asarray(((np.asarray(a) + np.asarray(a).T) / 2).astype(np.float32))
     run("heev_mesh (vectors, full chain)",
-        lambda: jax.block_until_ready(heev_mesh(heig, mesh, nb=16)[1]),
+        lambda: jax.block_until_ready(heev_mesh(heig, mesh, nb=nb)[1]),
         4 * n**3 / 3)
 
     lines = [
