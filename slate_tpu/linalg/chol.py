@@ -17,6 +17,7 @@ diagonal-tile factor to vendor LAPACK (internal_potrf.cc -> lapack::potrf).
 
 from __future__ import annotations
 
+import functools
 from dataclasses import replace
 from typing import Optional, Tuple, Union
 
@@ -237,33 +238,81 @@ def _potrf_left_looking(a: jax.Array, nb: Optional[int] = None) -> jax.Array:
     if n <= nb:
         return _potrf_lower(a)
     nsteps = -(-n // nb)
-    np_ = nsteps * nb
-    if np_ != n:
-        ap = jnp.pad(a, ((0, np_ - n), (0, np_ - n)))
-        dpad = jnp.arange(n, np_)
-        ap = ap.at[dpad, dpad].set(1)
-    else:
-        ap = a
-    cplx = jnp.issubdtype(a.dtype, jnp.complexfloating)
-    # IN-PLACE: factored panels overwrite ap's lower triangle, so the
-    # update reads ap[r0:, :r0] directly and peak memory stays ~one matrix
-    # (+ transients) — this is what lets n = 32768 f64 (8 GB) run inside
-    # v5e's 15.75 GB HBM
+    ap, _ = _potrf_ll_pad(a, nsteps, nb)
     for j in range(nsteps):
-        r0 = j * nb
-        panel = ap[r0:, r0 : r0 + nb]
-        if j:
-            left = ap[r0:, :r0]  # factored L[r0:, :r0]
-            lrow = left[:nb]  # rows r0..r0+nb of L's first j*nb columns
-            upd = matmul(left, jnp.conj(lrow).T if cplx else lrow.T)
-            panel = panel - upd.astype(ap.dtype)
-        dblk, linv = _potrf_and_inv(panel[:nb])
-        if panel.shape[0] > nb:
-            below = matmul(panel[nb:], jnp.conj(linv).T if cplx else linv.T)
-            panel = jnp.concatenate([dblk, below.astype(ap.dtype)], axis=0)
-        else:
-            panel = dblk
-        ap = jax.lax.dynamic_update_slice(ap, panel, (r0, r0))
+        ap = _potrf_ll_panel_step(ap, j * nb, nb)
+    return tri_project(ap[:n, :n], Uplo.Lower)
+
+
+def _potrf_ll_pad(a: jax.Array, nsteps: int, nb: int):
+    """Shared left-looking prelude: pad to a panel multiple with a unit
+    diagonal in the pad block (exact: diag(A, I) factors to diag(L, I)).
+    Returns (padded matrix, fresh_buffer) — fresh_buffer False means the
+    result IS the caller's array."""
+    n = a.shape[0]
+    np_ = nsteps * nb
+    if np_ == n:
+        return a, False
+    ap = jnp.pad(a, ((0, np_ - n), (0, np_ - n)))
+    dpad = jnp.arange(n, np_)
+    return ap.at[dpad, dpad].set(1), True
+
+
+def _potrf_ll_panel_step(ap: jax.Array, r0: int, nb: int) -> jax.Array:
+    """One left-looking panel step on the padded in-place matrix: subtract
+    the factored history's contribution (a large-k gemm), factor the
+    diagonal block jointly with its inverse, solve the below-panel rows
+    as a gemm, write back."""
+    cplx = jnp.issubdtype(ap.dtype, jnp.complexfloating)
+    panel = ap[r0:, r0 : r0 + nb]
+    if r0:
+        left = ap[r0:, :r0]  # factored L[r0:, :r0]
+        lrow = left[:nb]  # rows r0..r0+nb of L's first r0 columns
+        upd = matmul(left, jnp.conj(lrow).T if cplx else lrow.T)
+        panel = panel - upd.astype(ap.dtype)
+    dblk, linv = _potrf_and_inv(panel[:nb])
+    if panel.shape[0] > nb:
+        below = matmul(panel[nb:], jnp.conj(linv).T if cplx else linv.T)
+        panel = jnp.concatenate([dblk, below.astype(ap.dtype)], axis=0)
+    else:
+        panel = dblk
+    return jax.lax.dynamic_update_slice(ap, panel, (r0, r0))
+
+
+@functools.partial(jax.jit, static_argnames=("r0", "nb"), donate_argnums=0)
+def _potrf_ll_step_jit(ap, r0: int, nb: int):
+    return _potrf_ll_panel_step(ap, r0, nb)
+
+
+def potrf_left_looking_staged(
+    a: jax.Array, nb: Optional[int] = None, donate: bool = False
+) -> jax.Array:
+    """Left-looking f64 Cholesky with ONE DONATED XLA PROGRAM PER PANEL.
+
+    The fused single-program form keeps ~5 live copies of the matrix
+    (XLA's buffer assignment across the unrolled panel chain: measured
+    14.4 GB peak for the 2 GB n = 16384 problem), which OOMs v5e at
+    n = 32768 (8 GB matrix).  Dispatching each panel as its own jit with
+    the matrix donated caps peak HBM at one matrix + one panel's
+    transients.  Call EAGERLY (under an outer jit the stages inline and
+    the fused-liveness problem returns) — cf. eig.heev_staged.
+
+    ``donate=True`` CONSUMES the caller's array (required at n = 32768 on
+    v5e: a defensive copy next to the 8 GB input would itself OOM; the
+    caller must not reuse ``a``).  The default keeps the input intact by
+    copying when the padding step would not already produce a fresh
+    buffer."""
+    n = a.shape[0]
+    if nb is None:
+        nb = 4096 if n >= 16384 else 2048
+    if n <= nb:
+        return _potrf_lower(a)
+    nsteps = -(-n // nb)
+    ap, fresh = _potrf_ll_pad(a, nsteps, nb)
+    if not fresh and not donate:
+        ap = jnp.array(ap, copy=True)  # first step's donation eats a copy
+    for j in range(nsteps):
+        ap = _potrf_ll_step_jit(ap, r0=j * nb, nb=nb)
     return tri_project(ap[:n, :n], Uplo.Lower)
 
 

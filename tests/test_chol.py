@@ -173,6 +173,21 @@ def test_potrf_left_looking(dtype):
         assert resid < 1e-13, (n, nb, resid)
 
 
+def test_potrf_left_looking_staged():
+    # the staged per-panel-program variant (the n > 20480 f64 chip path:
+    # one donated XLA program per panel caps peak HBM at ~one matrix)
+    # must match the fused left-looking form exactly in math
+    from slate_tpu.linalg.chol import potrf_left_looking_staged
+
+    rng = np.random.default_rng(5)
+    n, nb = 300, 64
+    g = rng.standard_normal((n, n))
+    a = g @ g.T + n * np.eye(n)
+    l = np.tril(np.asarray(potrf_left_looking_staged(jnp.asarray(a), nb)))
+    resid = np.linalg.norm(l @ l.T - a) / np.linalg.norm(a)
+    assert resid < 1e-13, resid
+
+
 @pytest.mark.parametrize("cond", [1e6, 1e12])
 def test_potrf_scan_ill_conditioned(cond):
     # ADVICE r3: the explicit-inverse panel solve trades the trsm's

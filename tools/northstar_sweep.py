@@ -21,12 +21,15 @@ import sys
 CASES = [
     # round 5: artifact-first ordering — the rows that verify round 4's
     # claims (VERDICT r5 item 1) run before the f32 refreshes
-    ("potrf_f64", 16384, 7200),
-    ("getrf_f64", 16384, 7200),
     ("heev_vec", 8192, 3600),
+    ("getrf_f64", 16384, 7200),
     ("heev_vec", 16384, 7200),
     ("svd", 16384, 7200),
     ("svd_vec", 16384, 9000),
+    ("potrf_f64", 16384, 7200),
+    # 32768 runs the STAGED per-panel-program form with a donated carry:
+    # the fused program's ~5 live matrix copies OOM v5e at 8 GB/matrix
+    # (measured r5); per-panel programs cap peak at ~one matrix.
     ("potrf_f64", 32768, 9000),
     ("getrf_scan", 32768, 900),
     ("getrf_scan", 16384, 600),
@@ -264,7 +267,7 @@ elif routine == "potrf_f64":
     # matrix exceed HBM) — VERDICT r4 item 1
     jax.config.update("jax_enable_x64", True)
     import numpy as _np
-    from slate_tpu.linalg.chol import potrf_array, _potrf_left_looking
+    from slate_tpu.linalg.chol import potrf_array
     rng = _np.random.default_rng(0)
     ah = rng.standard_normal((n, n))
     ah = (ah + ah.T) / (2.0 * _np.sqrt(n)) + 3.0 * _np.eye(n)
@@ -292,9 +295,10 @@ elif routine == "potrf_f64":
         den = jnp.linalg.norm(mv(a2, xv))
         resid = float(num / den)
     else:
-        # donated in-place form; input must arrive pre-symmetrized
-        f = jax.jit(_potrf_left_looking, donate_argnums=0)
-        l = f(a)
+        # STAGED per-panel programs with donation (the fused form keeps
+        # ~5 live matrix copies and OOMs at 32768); input pre-symmetrized
+        from slate_tpu.linalg.chol import potrf_left_looking_staged
+        l = potrf_left_looking_staged(a, donate=True)
         dmin = float(jnp.min(jnp.real(jnp.diagonal(l))))
         del l, a
         ah = rng.standard_normal((n, n))
@@ -302,7 +306,7 @@ elif routine == "potrf_f64":
         a2 = jax.device_put(ah); del ah
         _ = float(jnp.sum(a2[:1, :4]))
         t0 = time.perf_counter()
-        l = f(a2)
+        l = potrf_left_looking_staged(a2, donate=True)
         dmin = float(jnp.min(jnp.real(jnp.diagonal(l))))
         t1 = time.perf_counter()
         resid = float("nan")  # input donated; dmin + 16384-run gate accuracy
