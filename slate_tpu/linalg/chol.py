@@ -284,6 +284,13 @@ def _potrf_ll_step_jit(ap, r0: int, nb: int):
     return _potrf_ll_panel_step(ap, r0, nb)
 
 
+@functools.partial(jax.jit, static_argnames=("n",), donate_argnums=0)
+def _potrf_ll_finale_jit(ap, n: int):
+    # donated: an EAGER tri_project here would allocate a second full
+    # matrix next to ap, breaking the staged form's one-matrix peak
+    return tri_project(ap[:n, :n], Uplo.Lower)
+
+
 def potrf_left_looking_staged(
     a: jax.Array, nb: Optional[int] = None, donate: bool = False
 ) -> jax.Array:
@@ -313,7 +320,7 @@ def potrf_left_looking_staged(
         ap = jnp.array(ap, copy=True)  # first step's donation eats a copy
     for j in range(nsteps):
         ap = _potrf_ll_step_jit(ap, r0=j * nb, nb=nb)
-    return tri_project(ap[:n, :n], Uplo.Lower)
+    return _potrf_ll_finale_jit(ap, n=n)
 
 
 def _potrf_ll_ozaki(a: jax.Array, nb: Optional[int] = None, n_slices: Optional[int] = None) -> jax.Array:

@@ -481,6 +481,36 @@ def unmtr_hb2st(f: Hb2stFactors, z: Array) -> Array:
     return _chase_sweep_apply(f.vs, f.taus, z, f.n, f.w, adjoint=False)
 
 
+# ~4k sweeps per apply program keeps each dispatch well under the worker's
+# long-program watchdog (one 16384-sweep apply ran minutes and was killed)
+_APPLY_SEG_SWEEPS = 4096
+
+
+def _chase_apply_staged(vs, taus, z, n: int, w: int, adjoint: bool) -> Array:
+    """Apply a bulge-chase reflector family to Z in SWEEP-BLOCK programs
+    (eager staged dispatch, cf. _wavefront_chase_segmented): at n = 16384
+    the single-program apply runs minutes of serial sweeps and the TPU
+    worker's watchdog kills it; blocks of ~4k sweeps each dispatch as one
+    jit (identical shapes -> one compile), applied in the order the
+    factored form requires — descending block index for adjoint=False
+    (U = H_1^H H_2^H ... applies last reflectors first), ascending for
+    adjoint=True."""
+    nsweeps = vs.shape[0]
+    nseg = max(1, -(-nsweeps // _APPLY_SEG_SWEEPS))
+    if nseg == 1:
+        return jax.jit(_chase_sweep_apply, static_argnums=(3, 4, 5))(
+            vs, taus, z, n, w, adjoint
+        )
+    # equal-size blocks within 1 (at most two distinct compiled shapes)
+    bounds = [nsweeps * i // nseg for i in range(nseg)] + [nsweeps]
+    order = range(nseg) if adjoint else range(nseg - 1, -1, -1)
+    apply = jax.jit(_chase_sweep_apply, static_argnums=(3, 4, 5))
+    for i in order:
+        b0, b1 = bounds[i], bounds[i + 1]
+        z = apply(vs[b0:b1], taus[b0:b1], z, n, w, adjoint, b0)
+    return z
+
+
 # ---------------------------------------------------------------------------
 # Drivers: heev / hegst / hegv (src/heev.cc, hegst.cc, hegv.cc)
 # ---------------------------------------------------------------------------
@@ -555,10 +585,9 @@ def heev_staged(
     z = ztri.astype(a.dtype)
     if jnp.issubdtype(a.dtype, jnp.complexfloating):
         z = phases[:, None] * z
-    # factor-tuple ints (n, w) shape the apply kernels -> pass static
-    z = jax.jit(_chase_sweep_apply, static_argnums=(3, 4, 5))(
-        f2.vs, f2.taus, z, n, nb, False
-    )
+    # sweep-block staged apply (the fused apply outruns the worker
+    # watchdog at 16384); factor-tuple ints (n, w) are static
+    z = _chase_apply_staged(f2.vs, f2.taus, z, n, nb, False)
     z = jax.jit(unmtr_he2hb)(He2hbFactors(f1.band, f1.v, f1.t, nb), z)
     return w, z
 

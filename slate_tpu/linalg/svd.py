@@ -52,7 +52,7 @@ class Ge2tbFactors(NamedTuple):
     nb: int
 
 
-def ge2tb(a: Array, nb: int = _SVD_NB) -> Ge2tbFactors:
+def ge2tb(a: Array, nb: int = _SVD_NB, segments: int = 1) -> Ge2tbFactors:
     """General (m >= n) -> upper triangular band, alternating QR/LQ panels.
 
     One lax.fori_loop over block columns with static shapes: per step an
@@ -61,6 +61,11 @@ def ge2tb(a: Array, nb: int = _SVD_NB) -> Ge2tbFactors:
     mirrored LQ step on the block row (via QR of its conjugate transpose).
     LQ steps that would destroy the final band (remaining width <= 1) are
     masked to identity, matching the unrolled form's skip.
+
+    ``segments > 1`` runs the block loop as that many donated jit
+    programs over k-ranges (call EAGERLY to benefit) — the chip escape
+    hatch for sizes where one program's serial step chain outruns the
+    TPU worker's watchdog (cf. eig._wavefront_chase_segmented).
     """
     from .qr import _larft_v, _panel_qr_offset
 
@@ -122,7 +127,23 @@ def ge2tb(a: Array, nb: int = _SVD_NB) -> Ge2tbFactors:
         jnp.zeros((nblocks, np2, nb), a.dtype),
         jnp.zeros((nblocks, nb, nb), a.dtype),
     )
-    ap, vqs, tqs, vls, tls = jax.lax.fori_loop(0, nblocks, body, carry0)
+    if segments <= 1:
+        ap, vqs, tqs, vls, tls = jax.lax.fori_loop(0, nblocks, body, carry0)
+    else:
+        import functools
+
+        # lo/hi stay DYNAMIC so every segment reuses one compiled program
+        # (cf. _chase_apply_staged's j0; ragged tails included)
+        @functools.partial(jax.jit, donate_argnums=0)
+        def seg(carry, lo, hi):
+            return jax.lax.fori_loop(lo, hi, body, carry)
+
+        bounds = [nblocks * i // segments for i in range(segments)] + [nblocks]
+        carry = carry0
+        for i in range(segments):
+            if bounds[i] < bounds[i + 1]:
+                carry = seg(carry, bounds[i], bounds[i + 1])
+        ap, vqs, tqs, vls, tls = carry
     return Ge2tbFactors(ap[:m, :n], vqs, tqs, vls, tls, nb)
 
 
@@ -333,18 +354,21 @@ def svd_staged(a: Array, want_vectors: bool = True, nb: int = _SVD_NB):
             return svd_staged(jnp.conj(a).T, False, nb)
         u, s, vh = svd_staged(jnp.conj(a).T, True, nb)
         return jnp.conj(vh).T, s, jnp.conj(u).T
-    f1 = jax.jit(ge2tb, static_argnums=1)(a, nb)
-    band = f1.band[:n, :n]
     from .eig import _chase_segments
 
     segs = _chase_segments(n)
+    if segs > 1:  # segmented ge2tb must dispatch eagerly
+        f1 = ge2tb(a, nb, segments=segs)
+    else:
+        f1 = jax.jit(ge2tb, static_argnums=1)(a, nb)
+    band = f1.band[:n, :n]
     if segs > 1:  # segmented chase must dispatch eagerly
         d, e, f2, pu, pv = tb2bd(band, nb, segments=segs)
     else:
         d, e, f2, pu, pv = jax.jit(tb2bd, static_argnums=(1, 2))(band, nb)
     if not want_vectors:
         return jax.jit(bdsqr, static_argnums=2)(d, e, False)
-    from .eig import _chase_sweep_apply
+    from .eig import _chase_apply_staged
 
     if 2 * n > _STEDC_STAGE_ABOVE:
         # eager: bdsqr internally level-stages its stedc at this scale
@@ -352,11 +376,12 @@ def svd_staged(a: Array, want_vectors: bool = True, nb: int = _SVD_NB):
     else:
         s, ub, vb = jax.jit(bdsqr)(d, e)
     dtype = a.dtype
-    apply = jax.jit(_chase_sweep_apply, static_argnums=(3, 4, 5))
-    u = apply(f2.lvs, f2.ltaus, pu[:, None] * ub.astype(dtype), n, nb, False)
+    # sweep-block staged applies (the fused apply outruns the worker
+    # watchdog at 16384)
+    u = _chase_apply_staged(f2.lvs, f2.ltaus, pu[:, None] * ub.astype(dtype), n, nb, False)
     u_full = jnp.zeros((m, n), dtype).at[:n].set(u)
     u_full = jax.jit(unmbr_ge2tb_u)(f1, u_full)
-    v = apply(f2.rvs, f2.rtaus, pv[:, None] * vb.astype(dtype), n, nb, False)
+    v = _chase_apply_staged(f2.rvs, f2.rtaus, pv[:, None] * vb.astype(dtype), n, nb, False)
     v = jax.jit(unmbr_ge2tb_v)(f1, v)
     return u_full, s, jnp.conj(v).T
 

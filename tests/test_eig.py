@@ -187,3 +187,29 @@ def test_heev_staged_matches_fused():
     assert np.abs(z.T @ z - np.eye(n)).max() < 1e-12 * n
     wv = np.asarray(heev_staged(jnp.asarray(a), want_vectors=False, nb=16))
     assert np.abs(np.sort(wv) - wref).max() < 1e-11 * n
+
+
+def test_chase_apply_staged_matches_fused():
+    # the sweep-block staged apply (heev_staged/svd_staged's chip path at
+    # n >= _APPLY_SEG_SWEEPS; the fused apply outruns the TPU worker
+    # watchdog at 16384) must be numerically identical to the fused form
+    import slate_tpu.linalg.eig as eig
+    from slate_tpu.linalg.eig import (
+        _chase_apply_staged, _chase_sweep_apply, hb2st,
+    )
+
+    rng = np.random.default_rng(11)
+    n, w = 96, 8
+    g = rng.standard_normal((n, n))
+    band = np.tril(np.triu(g + g.T, -w), w)
+    d, e, f2, _ = hb2st(jnp.asarray(band), w)
+    z = jnp.asarray(rng.standard_normal((n, n)))
+    old_seg = eig._APPLY_SEG_SWEEPS
+    eig._APPLY_SEG_SWEEPS = 16  # force ~6 blocks at this size
+    try:
+        for adjoint in (False, True):
+            ref = np.asarray(_chase_sweep_apply(f2.vs, f2.taus, z, n, w, adjoint))
+            got = np.asarray(_chase_apply_staged(f2.vs, f2.taus, z, n, w, adjoint))
+            assert np.abs(ref - got).max() < 1e-12, adjoint
+    finally:
+        eig._APPLY_SEG_SWEEPS = old_seg

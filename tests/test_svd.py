@@ -87,3 +87,17 @@ def test_svd_staged_matches_fused():
         assert np.abs(un.T @ un - np.eye(un.shape[1])).max() < 1e-12 * k
         sv = np.asarray(svd_staged(jnp.asarray(a), want_vectors=False, nb=16))
         assert np.abs(sv - sref).max() < 1e-11 * k
+
+
+def test_ge2tb_segmented_matches_fused():
+    # the segmented ge2tb dispatch (svd_staged's chip path past the chase
+    # segmentation threshold) must match the fused loop exactly
+    from slate_tpu.linalg.svd import ge2tb
+
+    rng = np.random.default_rng(9)
+    a = jnp.asarray(rng.standard_normal((96, 64)))
+    f1 = ge2tb(a, 16)
+    f2 = ge2tb(a, 16, segments=3)
+    assert np.abs(np.asarray(f1.band) - np.asarray(f2.band)).max() == 0.0
+    assert np.abs(np.asarray(f1.vq) - np.asarray(f2.vq)).max() == 0.0
+    assert np.abs(np.asarray(f1.tl) - np.asarray(f2.tl)).max() == 0.0
