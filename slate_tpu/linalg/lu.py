@@ -255,6 +255,17 @@ def _lu_info(lu: jax.Array) -> jax.Array:
 
 
 _GETRF_LL_MIN_N = 4096  # f64 on TPU: left-looking from here
+# Chip-validated ceiling (round 5): the full left-looking program is
+# residual-correct on the real chip at 4096 (1.0e-11) and 8192 (3.7e-11),
+# but the n = 16384 / nb = 4096 run factors WRONG (independent numpy
+# residual 13.7) even though every component — the (12288, 4096) all-gemm
+# panel, the f32-seeded unit-L leaf inverses, Ozaki products at the exact
+# operand shapes/distributions, and the 4-panel driver at 8192 — passes
+# in isolation at matching shapes.  The suspect is an XLA/x64-rewriter
+# lowering issue at the full-program scale (e.g. the ~1.6 GB f64
+# trailing-row gather); until it is root-caused the dispatch is gated to
+# the validated sizes and larger f64 problems take the scanned form.
+_GETRF_LL_MAX_N = 8192
 
 
 def getrf_array(a: jax.Array) -> LUFactors:
@@ -267,8 +278,12 @@ def getrf_array(a: jax.Array) -> LUFactors:
         from ..ops.matmul import _tpu_is_default
 
         if _tpu_is_default():
-            lu, perm = _getrf_left_looking(a)
-            return LUFactors(lu, perm, _lu_info(lu))
+            if a.shape[0] <= _GETRF_LL_MAX_N:
+                lu, perm = _getrf_left_looking(a)
+                return LUFactors(lu, perm, _lu_info(lu))
+            # past the validated ceiling: the scanned single-program form
+            # (correct on chip; the recursive trace is too large here)
+            return getrf_scan_array(a)
     lu, perm = _getrf_rec(a)
     return LUFactors(lu, perm, _lu_info(lu))
 

@@ -314,7 +314,10 @@ elif routine == "potrf_f64":
     emit(t1 - t0, n**3 / 3 / (t1 - t0) / 1e9,
          f"dmin={{dmin:.2e}} resid={{resid:.2e}}", ok)
 elif routine == "getrf_f64":
-    # f64 left-looking partial-pivot LU (getrf_array dispatch on-chip)
+    # f64 partial-pivot LU through the shipped dispatch: left-looking at
+    # the chip-validated sizes (<= 8192), the scanned single-program form
+    # past the _GETRF_LL_MAX_N gate (see lu.py — the 16384 left-looking
+    # program factors wrong on chip despite every component passing)
     jax.config.update("jax_enable_x64", True)
     import numpy as _np
     from slate_tpu.linalg.lu import getrf_array
@@ -392,9 +395,11 @@ def main():
             results.append({"routine": routine, "n": n, "ok": False,
                             "error": f"timeout>{tmo}s"})
         print(json.dumps(results[-1]), flush=True)
-    with open(out, "w") as f:
-        json.dump({"chip": "TPU v5e (1 chip, via tunnel)", "results": results}, f,
-                  indent=1)
+        with open(out, "w") as f:
+            json.dump(
+                {"chip": "TPU v5e (1 chip, via tunnel)", "results": results},
+                f, indent=1,
+            )
     print(f"wrote {out}")
 
 
