@@ -481,22 +481,31 @@ def unmtr_hb2st(f: Hb2stFactors, z: Array) -> Array:
     return _chase_sweep_apply(f.vs, f.taus, z, f.n, f.w, adjoint=False)
 
 
-# ~4k sweeps per apply program keeps each dispatch well under the worker's
-# long-program watchdog (one 16384-sweep apply ran minutes and was killed)
+# ~4k sweeps per apply program at the 8192^2 reference size keeps each
+# dispatch well under the worker's long-program watchdog (measured ~5.4 ms
+# per sweep there; one 16384-sweep apply ran minutes and was killed).  The
+# per-sweep cost scales with the touched area (span x ncols ~ n x ncols),
+# so the block size shrinks proportionally at larger problems.
 _APPLY_SEG_SWEEPS = 4096
+_APPLY_REF_AREA = 8192 * 8192
+_APPLY_MIN_BLOCK = 256  # dispatch-overhead floor
 
 
 def _chase_apply_staged(vs, taus, z, n: int, w: int, adjoint: bool) -> Array:
     """Apply a bulge-chase reflector family to Z in SWEEP-BLOCK programs
     (eager staged dispatch, cf. _wavefront_chase_segmented): at n = 16384
     the single-program apply runs minutes of serial sweeps and the TPU
-    worker's watchdog kills it; blocks of ~4k sweeps each dispatch as one
-    jit (identical shapes -> one compile), applied in the order the
-    factored form requires — descending block index for adjoint=False
-    (U = H_1^H H_2^H ... applies last reflectors first), ascending for
-    adjoint=True."""
+    worker's watchdog kills it; area-scaled blocks of sweeps each
+    dispatch as one jit (identical shapes -> one compile), applied in the
+    order the factored form requires — descending block index for
+    adjoint=False (U = H_1^H H_2^H ... applies last reflectors first),
+    ascending for adjoint=True."""
     nsweeps = vs.shape[0]
-    nseg = max(1, -(-nsweeps // _APPLY_SEG_SWEEPS))
+    area = max(1, n * z.shape[1])
+    per_block = max(
+        _APPLY_MIN_BLOCK, int(_APPLY_SEG_SWEEPS * _APPLY_REF_AREA / area)
+    )
+    nseg = max(1, -(-nsweeps // per_block))
     if nseg == 1:
         return jax.jit(_chase_sweep_apply, static_argnums=(3, 4, 5))(
             vs, taus, z, n, w, adjoint

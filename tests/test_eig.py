@@ -204,12 +204,15 @@ def test_chase_apply_staged_matches_fused():
     band = np.tril(np.triu(g + g.T, -w), w)
     d, e, f2, _ = hb2st(jnp.asarray(band), w)
     z = jnp.asarray(rng.standard_normal((n, n)))
-    old_seg = eig._APPLY_SEG_SWEEPS
-    eig._APPLY_SEG_SWEEPS = 16  # force ~6 blocks at this size
+    saved = (eig._APPLY_SEG_SWEEPS, eig._APPLY_REF_AREA, eig._APPLY_MIN_BLOCK)
+    # shrink all three knobs so the area scaling yields genuinely
+    # multi-block dispatch at this tiny size (the sweep floor would
+    # otherwise collapse it to the single-program fast path)
+    eig._APPLY_SEG_SWEEPS, eig._APPLY_REF_AREA, eig._APPLY_MIN_BLOCK = 16, n * n, 8
     try:
         for adjoint in (False, True):
             ref = np.asarray(_chase_sweep_apply(f2.vs, f2.taus, z, n, w, adjoint))
             got = np.asarray(_chase_apply_staged(f2.vs, f2.taus, z, n, w, adjoint))
             assert np.abs(ref - got).max() < 1e-12, adjoint
     finally:
-        eig._APPLY_SEG_SWEEPS = old_seg
+        eig._APPLY_SEG_SWEEPS, eig._APPLY_REF_AREA, eig._APPLY_MIN_BLOCK = saved
