@@ -1,10 +1,45 @@
 #!/usr/bin/env bash
-# One-command CI: unit/numerical suite on the 8-device virtual CPU mesh,
-# then the example smoke tests (the reference's Jenkins matrix runs
+# One-command CI: static analysis first (fails fast, no kernels run), then
+# the unit/numerical suite on the 8-device virtual CPU mesh, then the
+# example smoke tests (the reference's Jenkins matrix runs
 # test/run_tests.py + examples/run_tests.py the same way, Jenkinsfile:16-26).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+# ---- static gates -------------------------------------------------------
+# slate_lint: jaxpr + AST invariants over every registered distributed
+# driver (see slate_tpu/analysis/).  A lint failure is a CI failure.
+python -m slate_tpu.analysis.lint
+
+# self-check: the gate must actually trip on a seeded violation, otherwise
+# a silent lint regression would wave everything through.  Exit code must
+# be EXACTLY 1 (findings) — 2 means the seeded path itself crashed.
+set +e
+python -m slate_tpu.analysis.lint --skip-trace --seed-violation donation \
+    > /dev/null 2>&1
+seed_rc=$?
+set -e
+if [ "$seed_rc" -ne 1 ]; then
+  echo "slate_lint self-check FAILED: seeded violation run exited" \
+       "$seed_rc (want 1)" >&2
+  exit 1
+fi
+
+# ruff / mypy: configured in pyproject.toml; the container image may not
+# ship them, so gate on availability rather than skipping silently
+if command -v ruff > /dev/null 2>&1; then
+  ruff check slate_tpu tools tests
+else
+  echo "ci: ruff not installed; skipping (config lives in pyproject.toml)"
+fi
+if command -v mypy > /dev/null 2>&1; then
+  mypy --config-file pyproject.toml
+else
+  echo "ci: mypy not installed; skipping (config lives in pyproject.toml)"
+fi
+
+# ---- dynamic suites -----------------------------------------------------
 python -m pytest tests/ -q
 python examples/run_tests.py

@@ -1,0 +1,23 @@
+"""Finding record shared by every slate_lint pass."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint violation.
+
+    ``rule`` is the stable check identifier (waiver files key on it),
+    ``where`` locates the violation (``driver:<name>`` for traced checks,
+    ``path:line`` for AST checks, ``grid:<fn>`` for the map invariants),
+    ``message`` is the human-readable detail.
+    """
+
+    rule: str
+    where: str
+    message: str
+
+    def render(self) -> str:
+        return f"[{self.rule}] {self.where}: {self.message}"

@@ -1,0 +1,145 @@
+"""Invariant 4: partition-of-unity of the block-cyclic maps.
+
+Pure-Python checks over ``core/grid.py`` — no jax involved.  Every tile
+must be owned by exactly one in-range rank, the transpose map must commute
+with index transposition, 1D maps must embed in the 2D family, and the
+blocksize lambdas must tile the extent exactly.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .findings import Finding
+
+GRIDS = [(1, 1), (2, 4), (4, 2), (2, 2), (3, 3), (1, 8), (8, 1), (3, 5)]
+TILE_GRID = 13  # prime-ish: exercises wrap-around unevenly
+BLOCK_CASES = [(96, 8), (100, 16), (1, 7), (7, 7), (129, 64), (64, 64)]
+
+
+def check_grid_maps() -> List[Finding]:
+    from ..core.grid import (
+        num_tiles,
+        process_1d_grid,
+        process_2d_grid,
+        transpose_grid,
+        uniform_blocksize,
+    )
+    from ..types import GridOrder
+
+    out: List[Finding] = []
+    for p, q in GRIDS:
+        size = p * q
+        for order in (GridOrder.Col, GridOrder.Row):
+            f = process_2d_grid(order, p, q)
+            owners = {}
+            for i in range(TILE_GRID):
+                for j in range(TILE_GRID):
+                    r = f((i, j))
+                    if not isinstance(r, int) or not (0 <= r < size):
+                        out.append(
+                            Finding(
+                                "grid",
+                                f"grid:process_2d_grid({order},{p},{q})",
+                                f"tile ({i},{j}) maps to rank {r!r}, outside "
+                                f"[0, {size})",
+                            )
+                        )
+                    owners.setdefault(r, 0)
+                    owners[r] = owners[r] + 1
+            # partition of unity: with tiles >= grid in both dims, every
+            # rank owns at least one tile and counts differ by at most the
+            # cyclic imbalance
+            if TILE_GRID >= p and TILE_GRID >= q and len(owners) != size:
+                out.append(
+                    Finding(
+                        "grid",
+                        f"grid:process_2d_grid({order},{p},{q})",
+                        f"only {len(owners)} of {size} ranks own tiles on a "
+                        f"{TILE_GRID}x{TILE_GRID} grid",
+                    )
+                )
+            g = transpose_grid(f)
+            for i, j in ((0, 1), (3, 7), (12, 5)):
+                if g((i, j)) != f((j, i)):
+                    out.append(
+                        Finding(
+                            "grid",
+                            f"grid:transpose_grid({order},{p},{q})",
+                            f"transpose map disagrees at ({i},{j})",
+                        )
+                    )
+        # 1D maps embed in the 2D family
+        for order, embed in (
+            (GridOrder.Col, process_2d_grid(GridOrder.Col, size, 1)),
+            (GridOrder.Row, process_2d_grid(GridOrder.Row, 1, size)),
+        ):
+            f1 = process_1d_grid(order, size)
+            for ij in ((0, 0), (5, 3), (12, 12)):
+                if f1(ij) != embed(ij):
+                    out.append(
+                        Finding(
+                            "grid",
+                            f"grid:process_1d_grid({order},{size})",
+                            f"1D map disagrees with its 2D embedding at {ij}",
+                        )
+                    )
+
+    for n, nb in BLOCK_CASES:
+        nt = num_tiles(n, nb)
+        f = uniform_blocksize(n, nb)
+        sizes = [f(i) for i in range(nt)]
+        if sum(sizes) != n:
+            out.append(
+                Finding(
+                    "grid",
+                    f"grid:uniform_blocksize({n},{nb})",
+                    f"blocksizes sum to {sum(sizes)}, not n={n}",
+                )
+            )
+        if any(s <= 0 or s > nb for s in sizes):
+            out.append(
+                Finding(
+                    "grid",
+                    f"grid:uniform_blocksize({n},{nb})",
+                    f"blocksize outside (0, nb]: {sizes}",
+                )
+            )
+        if nt * nb < n or (nt - 1) * nb >= n:
+            out.append(
+                Finding(
+                    "grid",
+                    f"grid:num_tiles({n},{nb})",
+                    f"tile count {nt} does not cover n tightly",
+                )
+            )
+    return out
+
+
+def check_mesh_factor() -> List[Finding]:
+    from ..core.grid import grid_2d_factor
+
+    out = []
+    for nranks in (1, 2, 4, 6, 8, 12, 16, 64, 256):
+        p, q = grid_2d_factor(nranks)
+        if p * q != nranks:
+            out.append(
+                Finding(
+                    "grid",
+                    f"grid:grid_2d_factor({nranks})",
+                    f"p*q = {p}*{q} != {nranks}",
+                )
+            )
+        if p > q:
+            out.append(
+                Finding(
+                    "grid",
+                    f"grid:grid_2d_factor({nranks})",
+                    f"p={p} > q={q}: not the canonical near-square ordering",
+                )
+            )
+    return out
+
+
+def run_grid_checks() -> List[Finding]:
+    return check_grid_maps() + check_mesh_factor()

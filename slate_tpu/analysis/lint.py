@@ -1,0 +1,241 @@
+"""slate_lint CLI: ``python -m slate_tpu.analysis.lint``.
+
+Runs, in order: the AST pass over the package sources, the pure-Python
+block-cyclic map invariants, the donation-aliasability contracts, and the
+jaxpr pass over every registered distributed driver (traced abstractly on
+a forced 8-device CPU mesh — no TPU, nothing executes beyond operand
+construction).  Findings not covered by the waiver file fail the run.
+
+Exit codes: 0 clean (or fully waived), 1 findings, 2 internal error.
+
+Options:
+  --waivers PATH      alternate waiver file (default analysis/waivers.cfg)
+  --only PATTERN      restrict traced drivers to names containing PATTERN
+  --skip-trace        AST + grid + donation checks only (fast, no tracing)
+  --list              list registered drivers and exit
+  --seed-violation K  inject a known-bad driver (axis | precision |
+                      donation | loop-audit) — proves the gate trips; used
+                      by tests/test_lint.py and CI self-checks
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+# environment must be pinned before jax is imported anywhere below
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+def _seed_violation(kind: str) -> None:
+    """Register a deliberately-broken driver so the gate has something to
+    trip on.  Each kind violates exactly one invariant."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ..parallel.comm import psum_a, shard_map_compat
+    from .registry import register, register_donation
+
+    if kind == "axis":
+
+        @register("seeded_bad_axis")
+        def _bad_axis(ctx):
+            # a private mesh with non-canonical axis names: traces fine,
+            # but the collectives ride axes no slate kernel declares
+            devs = jax.devices("cpu")[:4]
+            mesh = Mesh(np.asarray(devs).reshape(2, 2), ("row", "col"))
+            x = jnp.zeros((4, 4))
+
+            def fn(x):
+                return shard_map_compat(
+                    lambda t: jax.lax.psum(t, "row"),
+                    mesh=mesh,
+                    in_specs=(P("row", "col"),),
+                    out_specs=P("row", "col"),
+                    check_vma=False,
+                )(x)
+
+            return fn, (x,)
+
+    elif kind == "precision":
+
+        @register("seeded_missing_precision")
+        def _bad_prec(ctx):
+            a = jnp.ones((8, 8))
+            return (lambda x: jnp.einsum("ij,jk->ik", x, x)), (a,)
+
+    elif kind == "loop-audit":
+
+        @register("seeded_unscoped_loop")
+        def _bad_loop(ctx):
+            devs = jax.devices("cpu")[:4]
+            mesh = Mesh(np.asarray(devs).reshape(2, 2), ("p", "q"))
+            x = jnp.zeros((4, 4))
+
+            def fn(x):
+                def kernel(t):
+                    return jax.lax.fori_loop(
+                        0, 3, lambda i, acc: acc + psum_a(acc, "p"), t
+                    )
+
+                return shard_map_compat(
+                    kernel,
+                    mesh=mesh,
+                    in_specs=(P("p", "q"),),
+                    out_specs=P("p", "q"),
+                    check_vma=False,
+                )(x)
+
+            return fn, (x,)
+
+    elif kind == "donation":
+
+        @register_donation("seeded_unusable_donation")
+        def _bad_don(ctx):
+            ap = jnp.zeros((320, 320))
+            # output (300, 300) can never alias the donated (320, 320)
+            return (lambda x: x[:300, :300]), (ap,), (0,)
+
+    else:
+        raise SystemExit(f"unknown --seed-violation kind: {kind}")
+
+
+def run(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(prog="slate_lint")
+    ap.add_argument("--waivers", default=None)
+    ap.add_argument("--only", default=None)
+    ap.add_argument("--skip-trace", action="store_true")
+    ap.add_argument("--list", action="store_true", dest="list_drivers")
+    ap.add_argument(
+        "--seed-violation",
+        default=None,
+        choices=["axis", "precision", "donation", "loop-audit"],
+    )
+    args = ap.parse_args(argv)
+
+    if args.skip_trace and args.seed_violation in ("axis", "precision", "loop-audit"):
+        # those seeds register trace-pass drivers that --skip-trace never
+        # runs: the combination would exit 0 while validating nothing
+        ap.error(
+            f"--seed-violation {args.seed_violation} requires tracing; "
+            "only 'donation' works with --skip-trace"
+        )
+
+    from .ast_checks import check_tree
+    from .findings import Finding
+    from .grid_checks import run_grid_checks
+    from .waivers import load_waivers
+
+    if args.seed_violation:
+        _seed_violation(args.seed_violation)
+
+    from .registry import DONATIONS, REGISTRY
+
+    if args.list_drivers:
+        for name in sorted(REGISTRY):
+            print(f"driver   {name}")
+        for name in sorted(DONATIONS):
+            print(f"donation {name}")
+        return 0
+
+    findings: List[Finding] = []
+    findings += check_tree()
+    findings += run_grid_checks()
+
+    import jax
+
+    # mirror the test suite: drivers are used in f64 on the CPU mesh
+    jax.config.update("jax_enable_x64", True)
+
+    from ..parallel.comm import comm_audit
+    from ..parallel.mesh import COL_AXIS, ROW_AXIS
+    from .jaxpr_checks import (
+        check_collective_axes,
+        check_comm_upcast,
+        check_donation,
+        check_dot_precision,
+        check_loop_audit,
+    )
+    from .registry import make_ctx
+
+    ctx = make_ctx()
+
+    for name, spec in sorted(DONATIONS.items()):
+        if args.only and args.only not in name:
+            continue
+        where = f"donation:{name}"
+        try:
+            fn, dargs, donate = spec.build(ctx)
+            findings += check_donation(fn, dargs, donate, where)
+        except Exception as e:  # a broken contract is itself a finding
+            findings.append(Finding("trace-error", where, f"{type(e).__name__}: {e}"))
+
+    n_traced = 0
+    if not args.skip_trace:
+        allowed = (ROW_AXIS, COL_AXIS)
+        for name, spec in sorted(REGISTRY.items()):
+            if args.only and args.only not in name:
+                continue
+            n_traced += 1
+            where = f"driver:{name}"
+            try:
+                fn, dargs = spec.build(ctx)
+                jax.clear_caches()  # audit hooks record at trace time only
+                with comm_audit() as records:
+                    closed = jax.make_jaxpr(fn)(*dargs)
+            except Exception as e:
+                findings.append(
+                    Finding("trace-error", where, f"{type(e).__name__}: {e}")
+                )
+                continue
+            findings += check_collective_axes(closed, allowed, where)
+            findings += check_dot_precision(closed, where)
+            findings += check_comm_upcast(closed, where)
+            findings += check_loop_audit(closed, list(records), where)
+
+    waivers = load_waivers(args.waivers)
+    hard, waived = [], []
+    for f in findings:
+        w = waivers.match(f)
+        (waived if w else hard).append((f, w))
+
+    print(
+        f"slate_lint: {n_traced} drivers traced, {len(findings)} finding(s), "
+        f"{len(waived)} waived"
+    )
+    for f, w in waived:
+        print(f"  WAIVED {f.render()}  [{w.reason}]")
+    for f, _ in hard:
+        print(f"  FAIL   {f.render()}")
+    from .waivers import DEFAULT_WAIVER_FILE
+
+    wpath = args.waivers or DEFAULT_WAIVER_FILE
+    for w in waivers.unused():
+        print(f"  note: unused waiver at {wpath}:{w.line} ({w.rule} | {w.pattern})")
+    if hard:
+        print(f"slate_lint: FAILED with {len(hard)} unwaived finding(s)")
+        return 1
+    print("slate_lint: OK")
+    return 0
+
+
+def main() -> None:
+    try:
+        sys.exit(run())
+    except SystemExit:
+        raise
+    except Exception as e:  # pragma: no cover
+        print(f"slate_lint: internal error: {type(e).__name__}: {e}")
+        sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Waiver file support for slate_lint.
+
+Format: one waiver per line,
+
+    rule-id | substring-matched-against-where-or-message | reason
+
+Blank lines and ``#`` comments are skipped.  A waiver matches a finding
+when the rule id is equal and the pattern is a substring of either the
+finding's ``where`` or its ``message``.  ``*`` as the pattern matches any
+finding of that rule.  Unused waivers are reported (stale waivers hide
+regressions) but are not themselves failures.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .findings import Finding
+
+DEFAULT_WAIVER_FILE = os.path.join(os.path.dirname(__file__), "waivers.cfg")
+
+
+@dataclass
+class Waiver:
+    rule: str
+    pattern: str
+    reason: str
+    line: int
+    used: bool = False
+
+    def matches(self, f: Finding) -> bool:
+        if self.rule != f.rule:
+            return False
+        return (
+            self.pattern == "*"
+            or self.pattern in f.where
+            or self.pattern in f.message
+        )
+
+
+@dataclass
+class Waivers:
+    entries: List[Waiver] = field(default_factory=list)
+
+    def match(self, f: Finding) -> Optional[Waiver]:
+        for w in self.entries:
+            if w.matches(f):
+                w.used = True
+                return w
+        return None
+
+    def unused(self) -> List[Waiver]:
+        return [w for w in self.entries if not w.used]
+
+
+def load_waivers(path: Optional[str] = None) -> Waivers:
+    path = path or DEFAULT_WAIVER_FILE
+    entries: List[Waiver] = []
+    if not os.path.exists(path):
+        return Waivers(entries)
+    with open(path) as fh:
+        for lineno, raw in enumerate(fh, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = [p.strip() for p in line.split("|")]
+            if len(parts) < 3:
+                raise ValueError(
+                    f"{path}:{lineno}: waiver needs 'rule | pattern | reason'"
+                )
+            entries.append(Waiver(parts[0], parts[1], "|".join(parts[2:]), lineno))
+    return Waivers(entries)

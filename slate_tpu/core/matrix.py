@@ -18,8 +18,8 @@ XLA fuses slices into consumers), mirroring BaseMatrix's offset views
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
-from typing import Any, Optional, Tuple
+from dataclasses import dataclass, replace
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp

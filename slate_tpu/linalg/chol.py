@@ -291,6 +291,14 @@ def _potrf_ll_finale_jit(ap, n: int):
     return tri_project(ap[:n, :n], Uplo.Lower)
 
 
+@functools.partial(jax.jit, static_argnames=("n",))
+def _potrf_ll_finale_pad_jit(ap, n: int):
+    # padded runs: the (n, n) output cannot alias the larger padded buffer,
+    # so donating ap would only trip XLA's unusable-donation warning; the
+    # output here is strictly smaller than ap, keeping peak < 2 matrices
+    return tri_project(ap[:n, :n], Uplo.Lower)
+
+
 def potrf_left_looking_staged(
     a: jax.Array, nb: Optional[int] = None, donate: bool = False
 ) -> jax.Array:
@@ -320,7 +328,9 @@ def potrf_left_looking_staged(
         ap = jnp.array(ap, copy=True)  # first step's donation eats a copy
     for j in range(nsteps):
         ap = _potrf_ll_step_jit(ap, r0=j * nb, nb=nb)
-    return _potrf_ll_finale_jit(ap, n=n)
+    if ap.shape[0] == n:  # donation aliasable only when shapes match
+        return _potrf_ll_finale_jit(ap, n=n)
+    return _potrf_ll_finale_pad_jit(ap, n=n)
 
 
 def _potrf_ll_ozaki(a: jax.Array, nb: Optional[int] = None, n_slices: Optional[int] = None) -> jax.Array:

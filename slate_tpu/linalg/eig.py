@@ -24,15 +24,15 @@ TPU design:
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.matrix import symmetrize, tri_project
+from ..core.matrix import symmetrize
 from ..ops.matmul import matmul
-from ..types import MethodEig, Option, Options, Uplo, get_option
+from ..types import MethodEig, Uplo
 
 from .tridiag import stedc, steqr, sterf
 

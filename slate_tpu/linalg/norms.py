@@ -13,7 +13,7 @@ program (and on a sharded array GSPMD inserts the all-reduce over ICI).
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple, Union
+from typing import Callable, Union
 
 import jax
 import jax.numpy as jnp

@@ -23,7 +23,7 @@ that Q = I - V T V^H.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional, Tuple, Union
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

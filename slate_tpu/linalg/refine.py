@@ -14,8 +14,7 @@ high-precision solver after max_iter failures when UseFallbackSolver is set.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Callable, NamedTuple, Optional, Tuple, Union
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

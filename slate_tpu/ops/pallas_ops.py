@@ -15,7 +15,6 @@ Use :func:`use_pallas_tiles` to gate dispatch exactly like
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

@@ -14,7 +14,7 @@ All functions are pure and jit-safe; `uplo` masks use trace-time shapes.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -23,7 +23,15 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..types import Norm, Uplo
-from .comm import PRECISE, bcast_from_col, local_indices, shard_map
+from .comm import (
+    PRECISE,
+    all_gather_a,
+    audit_scope,
+    bcast_from_col,
+    local_indices,
+    psum_a,
+    shard_map_compat,
+)
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 
@@ -57,23 +65,23 @@ def _norm_jit(at, mesh, p, q, m_true, n_true, norm):
             # global max before squaring so huge entries do not overflow
             amax = allred(jnp.max(absa), lax.pmax)
             scale = jnp.where(amax > 0, amax, 1)
-            ssq = allred(jnp.sum((absa / scale) ** 2), lax.psum)
+            ssq = allred(jnp.sum((absa / scale) ** 2), psum_a)
             out = scale * jnp.sqrt(ssq)
         elif norm == Norm.One:
             colsums = jnp.sum(absa, axis=(0, 2))  # (ntl, nb) local col sums
-            colsums = lax.psum(colsums, ROW_AXIS)
+            colsums = psum_a(colsums, ROW_AXIS)
             out = lax.pmax(jnp.max(colsums), COL_AXIS)
             out = lax.pmax(out, ROW_AXIS)  # replicate across rows too
         elif norm == Norm.Inf:
             rowsums = jnp.sum(absa, axis=(1, 3))  # (mtl, nb)
-            rowsums = lax.psum(rowsums, COL_AXIS)
+            rowsums = psum_a(rowsums, COL_AXIS)
             out = lax.pmax(jnp.max(rowsums), ROW_AXIS)
             out = lax.pmax(out, COL_AXIS)
         else:
             raise ValueError(norm)
         return out[None, None]
 
-    out = shard_map(
+    out = shard_map_compat(
         kernel, mesh=mesh, in_specs=(spec,), out_specs=P(ROW_AXIS, COL_AXIS),
         check_vma=False,
     )(at)
@@ -125,7 +133,7 @@ def _herk_jit(at, ct, alpha, beta, mesh, p, q, kt, k_true, uplo, full):
             kmask = (k * nb + jnp.arange(nb)) < k_true
             acol = acol * kmask[None, None, :].astype(dtype)
             # transposed panel by my C-column indices (dist_chol.py pattern)
-            allpan = lax.all_gather(acol, ROW_AXIS, axis=0)  # (p, mtl, nb, nb)
+            allpan = all_gather_a(acol, ROW_AXIS, axis=0)  # (p, mtl, nb, nb)
             ntl = acc.shape[1]
             jc = lax.axis_index(COL_AXIS) + jnp.arange(ntl) * q
             panT = allpan[jc % p, jc // p]  # (ntl_c, nb, nb)
@@ -136,7 +144,8 @@ def _herk_jit(at, ct, alpha, beta, mesh, p, q, kt, k_true, uplo, full):
         mtl_c = mtl
         ntl_c = -(-at.shape[0] // q)  # C is square (mt x mt tiles)
         acc0 = jnp.zeros((mtl_c, ntl_c, nb, nb), dtype)
-        acc = lax.fori_loop(0, kt, step, acc0)
+        with audit_scope(kt):
+            acc = lax.fori_loop(0, kt, step, acc0)
         if not full:
             jc = lax.axis_index(COL_AXIS) + jnp.arange(ntl_c) * q
             ii = i_log[:, None, None, None] * nb + jnp.arange(nb)[None, None, :, None]
@@ -145,7 +154,7 @@ def _herk_jit(at, ct, alpha, beta, mesh, p, q, kt, k_true, uplo, full):
             acc = jnp.where(keep, acc, 0)
         return acc
 
-    prod = shard_map(
+    prod = shard_map_compat(
         kernel, mesh=mesh, in_specs=(spec,), out_specs=spec, check_vma=False
     )(at)
     if ct is None:

@@ -29,7 +29,15 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..types import Diag, Op, Uplo
-from .comm import PRECISE, bcast_from_col, bcast_from_row, local_indices, shard_map
+from .comm import (
+    PRECISE,
+    all_gather_a,
+    audit_scope,
+    bcast_from_col,
+    bcast_from_row,
+    local_indices,
+    shard_map_compat,
+)
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 
@@ -53,8 +61,8 @@ def _transpose_jit(at, mesh, p, q, conj):
 
     def kernel(t_loc):
         mtl, ntl, nb, _ = t_loc.shape
-        allr = lax.all_gather(t_loc, ROW_AXIS, axis=0)  # (p, mtl, ntl, nb, nb)
-        allrc = lax.all_gather(allr, COL_AXIS, axis=0)  # (q, p, mtl, ntl, nb, nb)
+        allr = all_gather_a(t_loc, ROW_AXIS, axis=0)  # (p, mtl, ntl, nb, nb)
+        allrc = all_gather_a(allr, COL_AXIS, axis=0)  # (q, p, mtl, ntl, nb, nb)
         # transposed grid is (nt_in, mt_in) tiles; grids are padded to
         # lcm(p, q) multiples (dist.from_dense), so both re-tile evenly
         out_mtl = (ntl * q) // p
@@ -68,7 +76,7 @@ def _transpose_jit(at, mesh, p, q, conj):
         out = jnp.swapaxes(picked, -1, -2)
         return jnp.conj(out) if conj else out
 
-    return shard_map(
+    return shard_map_compat(
         kernel, mesh=mesh, in_specs=(spec,), out_specs=spec, check_vma=False
     )(at)
 
@@ -97,7 +105,7 @@ def _mirror_col_panel(a_loc, k, p, q, i_log, uplo, conj, unit_diag=False):
     # stored row panel k -> mirror tiles for the other triangle
     arow_own = lax.dynamic_slice_in_dim(a_loc, k // p, 1, axis=0)[0]
     arow = bcast_from_row(arow_own, k % p)  # (ntl, nb, nb) by my col indices
-    allrow = lax.all_gather(arow, COL_AXIS, axis=0)  # (q, ntl, nb, nb): full row k
+    allrow = all_gather_a(arow, COL_AXIS, axis=0)  # (q, ntl, nb, nb): full row k
     mrr = allrow[i_log % q, i_log // q]  # tile (k, i) for my row indices i
     mirror = jnp.conj(jnp.swapaxes(mrr, -1, -2)) if conj else jnp.swapaxes(mrr, -1, -2)
     keep_mirror = (i_log < k) if lower else (i_log > k)
@@ -236,7 +244,7 @@ def _hemm_a_jit(at, bt, ct, alpha, beta, mesh, p, q, uplo, conj):
         # at row i % p == r); part_mir routes to rows j_log % p
         return route_to_block_cyclic_rows(part_mir, j_log, p, mtl, extra=part_own)
 
-    prod = shard_map(
+    prod = shard_map_compat(
         kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
     )(at, bt)
     if ct is None:
@@ -262,9 +270,10 @@ def _hemm_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, uplo, conj):
             return acc + upd.astype(dtype)
 
         acc0 = jnp.zeros((mtl, ntl, nb, nb), dtype)
-        return lax.fori_loop(0, kt, step, acc0)
+        with audit_scope(kt):
+            return lax.fori_loop(0, kt, step, acc0)
 
-    prod = shard_map(
+    prod = shard_map_compat(
         kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
     )(at, bt)
     if ct is None:
@@ -333,7 +342,7 @@ def _trmm_jit(at, bt, alpha, mesh, p, q, kt, uplo, op, diag):
                 # op(A)[:, k] = conj?(A[k, :])^T: stored row panel k
                 arow_own = lax.dynamic_slice_in_dim(a_loc, k // p, 1, axis=0)[0]
                 arow = bcast_from_row(arow_own, k % p)
-                allrow = lax.all_gather(arow, COL_AXIS, axis=0)
+                allrow = all_gather_a(arow, COL_AXIS, axis=0)
                 mrr = allrow[i_log % q, i_log // q]  # tile (k, i), my rows i
                 pan = jnp.swapaxes(mrr, -1, -2)
                 if op == Op.ConjTrans:
@@ -351,9 +360,10 @@ def _trmm_jit(at, bt, alpha, mesh, p, q, kt, uplo, op, diag):
             return acc + upd.astype(dtype)
 
         acc0 = jnp.zeros((mtl, ntl, nb, nb), dtype)
-        return lax.fori_loop(0, kt, step, acc0)
+        with audit_scope(kt):
+            return lax.fori_loop(0, kt, step, acc0)
 
-    prod = shard_map(
+    prod = shard_map_compat(
         kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
     )(at, bt)
     return (alpha * prod).astype(at.dtype)
@@ -403,7 +413,7 @@ def _her2k_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, k_true, uplo, conj, full
             xcol = bcast_from_col(xcol_own, k % q)
             kmask = (k * nb + jnp.arange(nb)) < k_true
             xcol = xcol * kmask[None, None, :].astype(dtype)
-            allpan = lax.all_gather(xcol, ROW_AXIS, axis=0)
+            allpan = all_gather_a(xcol, ROW_AXIS, axis=0)
             ntl_c = -(-at.shape[0] // q)
             jc = lax.axis_index(COL_AXIS) + jnp.arange(ntl_c) * q
             panT = allpan[jc % p, jc // p]
@@ -419,7 +429,8 @@ def _her2k_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, k_true, uplo, conj, full
 
         ntl_c = -(-at.shape[0] // q)
         acc0 = jnp.zeros((mtl, ntl_c, nb, nb), dtype)
-        acc = lax.fori_loop(0, kt, step, acc0)
+        with audit_scope(kt):
+            acc = lax.fori_loop(0, kt, step, acc0)
         if not full:
             jc = lax.axis_index(COL_AXIS) + jnp.arange(ntl_c) * q
             ii = i_log[:, None, None, None] * nb + jnp.arange(nb)[None, None, :, None]
@@ -428,7 +439,7 @@ def _her2k_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, k_true, uplo, conj, full
             acc = jnp.where(keep, acc, 0)
         return acc
 
-    prod = shard_map(
+    prod = shard_map_compat(
         kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
     )(at, bt)
     if ct is None:

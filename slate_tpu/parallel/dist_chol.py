@@ -6,7 +6,7 @@ broadcast the panel along process rows *and* columns (the symmetric
 listBcastMT pattern, potrf.cc:124-134), herk the trailing matrix.
 
 Design inversion: the OpenMP task graph + MOSI tile migration becomes ONE
-``lax.fori_loop`` inside ``shard_map``.  Per iteration:
+``lax.fori_loop`` inside ``shard_map_compat``.  Per iteration:
 
 - diagonal tile -> all devices via two masked psums; every device factors the
   nb x nb tile redundantly (replicated flops are cheaper than a second
@@ -48,7 +48,7 @@ from .comm import (
     bcast_from_col,
     bucket_plan,
     local_indices,
-    shard_map,
+    shard_map_compat,
 )
 
 def potrf_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array]:
@@ -151,7 +151,7 @@ def _potrf_jit(at, mesh, p, q, nt):
         info = jnp.where(info >= big, 0, info).astype(jnp.int32)
         return t_loc, info[None, None]
 
-    lt, info = shard_map(
+    lt, info = shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(spec,),
@@ -253,7 +253,7 @@ def _pbtrf_band_jit(at, mesh, p, q, nt, wd):
         info = jnp.where(info >= big, 0, info).astype(jnp.int32)
         return t_loc, info[None, None]
 
-    lt, info = shard_map(
+    lt, info = shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(spec,),

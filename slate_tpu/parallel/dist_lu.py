@@ -51,7 +51,7 @@ from .comm import (
     bucket_plan,
     local_indices,
     psum_a,
-    shard_map,
+    shard_map_compat,
 )
 
 def getrf_nopiv_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array]:
@@ -153,13 +153,14 @@ def _lu_jit(at, mesh, p, q, nt):
             def step(k, view, i_v=i_v, j_v=j_v, s0r=s0r, s0c=s0c):
                 return _nopiv_step(view, k, p, q, i_v, j_v, r, c, s0r, s0c)
 
-            view = lax.fori_loop(k0, k1, step, view)
+            with audit_scope(k1 - k0):
+                view = lax.fori_loop(k0, k1, step, view)
             t_loc = t_loc.at[s0r:, s0c:].set(view)
 
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, info[None, None]
 
-    lut, info = shard_map(
+    lut, info = shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(spec,),
@@ -220,8 +221,8 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true):
             vloc, iloc = _tournament_reduce(cand, ids, nb, sent)
 
             # ---- cross-row merge: gather per-device winners, re-reduce ----
-            ga = lax.all_gather(vloc, ROW_AXIS, axis=0).reshape(p * nb, nb)
-            gi = lax.all_gather(iloc, ROW_AXIS, axis=0).reshape(p * nb)
+            ga = all_gather_a(vloc, ROW_AXIS, axis=0).reshape(p * nb, nb)
+            gi = all_gather_a(iloc, ROW_AXIS, axis=0).reshape(p * nb)
             _, win = _tournament_reduce(ga, gi, nb, sent)
             win = bcast_from_col(jnp.where(c == k % q, win, 0), k % q)
 
@@ -290,7 +291,7 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true):
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, rowperm[None], info[None, None]
 
-    lut, perm, info = shard_map(
+    lut, perm, info = shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(spec,),
@@ -499,7 +500,7 @@ def _pp_jit(at, mesh, p, q, nt, m_true):
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, rowperm[None], info[None, None]
 
-    lut, perm, info = shard_map(
+    lut, perm, info = shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(spec,),
@@ -619,7 +620,7 @@ def _gb_pp_jit(at, mesh, p, q, nt, m_true, wd_l, wd_u, wd_usw):
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, rowperm[None], info[None, None]
 
-    lut, perm, info = shard_map(
+    lut, perm, info = shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(spec,),
@@ -655,13 +656,13 @@ def _permute_rows_jit(bt, perm, mesh, p, q):
     def kernel(b_loc, perm):
         mtl, ntl, nb, _ = b_loc.shape
         r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
-        all_b = lax.all_gather(b_loc, ROW_AXIS, axis=0)  # (p, mtl, ntl, nb, nb)
+        all_b = all_gather_a(b_loc, ROW_AXIS, axis=0)  # (p, mtl, ntl, nb, nb)
         g = i_log[:, None] * nb + jnp.arange(nb)[None, :]  # my dest rows
         src = perm[g]
         st, sr = src // nb, src % nb
         new = all_b[st % p, st // p, :, sr, :]  # (mtl, nb, ntl, nb)
         return jnp.transpose(new, (0, 2, 1, 3))
 
-    return shard_map(
+    return shard_map_compat(
         kernel, mesh=mesh, in_specs=(spec, P()), out_specs=spec, check_vma=False
     )(bt, perm)

@@ -37,10 +37,17 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..linalg.qr import _larft, _larft_v, _panel_qr, _panel_qr_offset, _v_of
-from ..types import Diag, Op, Uplo
+from ..types import Op
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
-from .comm import PRECISE, bcast_from_col, local_indices, shard_map
+from .comm import (
+    PRECISE,
+    all_gather_a,
+    audit_scope,
+    bcast_from_col,
+    local_indices,
+    shard_map_compat,
+)
 
 
 class DistQR(NamedTuple):
@@ -191,7 +198,7 @@ def _geqrf_jit(at, mesh, p, q, nt, m_true, n_true):
             # participant order (diag owner = tree root) ----
             rblk = lax.dynamic_slice(r_a, (row0, jnp.zeros_like(row0)), (nb, nb))
             rblk = jnp.where(has_rows, jnp.triu(rblk), 0)
-            rs = lax.all_gather(rblk, ROW_AXIS, axis=0)[_rot(k, p)]
+            rs = all_gather_a(rblk, ROW_AXIS, axis=0)[_rot(k, p)]
             tv = jnp.zeros((nmerge, 2 * nb, nb), dtype)
             tt = jnp.zeros((nmerge, nb, nb), dtype)
             for rnd, midl in zip(_tree_rounds(p), _merge_ids(p)):
@@ -207,7 +214,7 @@ def _geqrf_jit(at, mesh, p, q, nt, m_true, n_true):
             # only: earlier columns hold finished R/V history) ----
             myrow = lax.dynamic_slice(cflat, (row0, jnp.zeros_like(row0)), (nb, ntl * nb))
             myrow0 = jnp.where(has_rows, myrow, 0)
-            tops = lax.all_gather(myrow0, ROW_AXIS, axis=0)  # (p, nb, w)
+            tops = all_gather_a(myrow0, ROW_AXIS, axis=0)  # (p, nb, w)
             tops = _apply_tree_tops(tops, tv, tt, k, p, nb, adjoint=True)
             newrow = jnp.where(has_rows & colmask, tops[r], myrow)
             cflat = lax.dynamic_update_slice(cflat, newrow, (row0, jnp.zeros_like(row0)))
@@ -230,9 +237,10 @@ def _geqrf_jit(at, mesh, p, q, nt, m_true, n_true):
         tls0 = jnp.zeros((nt, nb, nb), dtype)
         tvs0 = jnp.zeros((nt, nmerge, 2 * nb, nb), dtype)
         tts0 = jnp.zeros((nt, nmerge, nb, nb), dtype)
-        t_loc, tls, tvs, tts = lax.fori_loop(
-            0, nt, panel_step, (t_loc, tls0, tvs0, tts0)
-        )
+        with audit_scope(nt):
+            t_loc, tls, tvs, tts = lax.fori_loop(
+                0, nt, panel_step, (t_loc, tls0, tvs0, tts0)
+            )
         # identity on the padded diagonal so R solves stay nonsingular
         diag_tiles = (i_log[:, None] == j_log[None, :])[:, :, None]
         gd = i_log[:, None, None] * nb + jnp.arange(nb)[None, None, :]
@@ -242,7 +250,7 @@ def _geqrf_jit(at, mesh, p, q, nt, m_true, n_true):
         t_loc = jnp.where(dmask, jnp.ones((), at.dtype), t_loc)
         return t_loc, tls, tvs[None, None], tts[None, None]
 
-    return shard_map(
+    return shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(spec,),
@@ -305,7 +313,7 @@ def _unmqr_jit(at, tloc, treev, treet, bt, mesh, p, q, nt, m_true, adjoint):
                 # the untouched rows on write-back — clobbering with the
                 # zeroed copy wipes whatever tile row0 clamped onto
                 myrow0 = jnp.where(has_rows, myrow, 0)
-                tops = lax.all_gather(myrow0, ROW_AXIS, axis=0)
+                tops = all_gather_a(myrow0, ROW_AXIS, axis=0)
                 tops = _apply_tree_tops(tops, tv, tt, k, p, nb, adjoint=adjoint)
                 newrow = jnp.where(has_rows, tops[r], myrow)
                 return lax.dynamic_update_slice(bflat, newrow, (row0, jnp.zeros_like(row0)))
@@ -320,9 +328,10 @@ def _unmqr_jit(at, tloc, treev, treet, bt, mesh, p, q, nt, m_true, adjoint):
             k = s if adjoint else nt - 1 - s
             return apply_panel(k, b_loc)
 
-        return lax.fori_loop(0, nt, step, b_loc)
+        with audit_scope(nt):
+            return lax.fori_loop(0, nt, step, b_loc)
 
-    return shard_map(
+    return shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(spec, P(ROW_AXIS), P(), P(), spec),

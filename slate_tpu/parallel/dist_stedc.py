@@ -41,7 +41,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..linalg.tridiag import _DC_SMALL, _secular_roots_shard, _zhat_shard, steqr
-from .comm import all_gather_a, local_indices, psum_a, shard_map
+from .comm import PRECISE, all_gather_a, psum_a, shard_map_compat
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 
 
@@ -207,8 +207,12 @@ def _stedc_dist_jit(dp, ep, mesh, p, q, N, levels):
             v = jnp.take_along_axis(v, inv[:, :, None], axis=1)
 
             # block-diagonal assembly on my rows x my root columns
-            qn_top = jnp.einsum("mrj,mjk->mrk", qp[:, 0], v[:, :s, :])
-            qn_bot = jnp.einsum("mrj,mjk->mrk", qp[:, 1], v[:, s:, :])
+            qn_top = jnp.einsum(
+                "mrj,mjk->mrk", qp[:, 0], v[:, :s, :], precision=PRECISE
+            )
+            qn_bot = jnp.einsum(
+                "mrj,mjk->mrk", qp[:, 1], v[:, s:, :], precision=PRECISE
+            )
             qn = jnp.concatenate([qn_top, qn_bot], axis=1)  # (m, 2rows, kloc)
             q_loc = all_gather_a(qn, COL_AXIS, axis=3, tiled=False)
             # (m, 2rows, kloc, q) -> (m, 2rows, 2s) in device-column order
@@ -220,7 +224,7 @@ def _stedc_dist_jit(dp, ep, mesh, p, q, N, levels):
         # q_loc: (1, N/p, N) my rows, full cols
         return w[None], q_loc[0][None]
 
-    w, z = shard_map(
+    w, z = shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(P(), P()),
@@ -279,7 +283,7 @@ def _stedc_finale_jit(z, inv, order, mesh, p, q, n):
 
     # device (r, c) holds output column block r*q + c — exactly the
     # P(None, (ROW, COL)) layout chase_apply_dist's in_spec uses
-    out = shard_map(
+    out = shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(P(ROW_AXIS, None), P(), P()),

@@ -15,7 +15,6 @@ needed.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -35,7 +34,7 @@ from .comm import (
     local_indices,
     psum_scatter_a,
     route_to_block_cyclic_rows,
-    shard_map,
+    shard_map_compat,
 )
 
 from typing import Optional
@@ -164,7 +163,7 @@ def _trsm_a_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
         with audit_scope(nt):
             return lax.fori_loop(0, nt, step, b_loc)
 
-    return shard_map(
+    return shard_map_compat(
         kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
     )(at, bt)
 
@@ -232,7 +231,7 @@ def _trsm_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
         with audit_scope(nt):
             return lax.fori_loop(0, nt, step, b_loc)
 
-    return shard_map(
+    return shard_map_compat(
         kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
     )(at, bt)
 
@@ -318,6 +317,6 @@ def _trsm_right_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
         with audit_scope(nt):
             return lax.fori_loop(0, nt, step, b_loc)
 
-    return shard_map(
+    return shard_map_compat(
         kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
     )(at, bt)

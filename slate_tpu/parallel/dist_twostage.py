@@ -27,7 +27,7 @@ runs with one psum per panel and no reflector gathers.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -37,7 +37,7 @@ from jax.sharding import PartitionSpec as P
 from ..linalg.eig import _he2hb_panel_count
 from ..linalg.qr import _larft_v, _panel_qr_offset
 from .comm import (PRECISE, all_gather_a, audit_scope, bcast_from_col,
-                   bcast_from_row, local_indices, psum_a, shard_map)
+                   bcast_from_row, local_indices, psum_a, shard_map_compat)
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 
@@ -167,7 +167,7 @@ def _he2hb_jit(at, mesh, p, q, n_true, nb, nsteps):
         t_out = jnp.transpose(a.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
         return t_out, vqs, tqs
 
-    return shard_map(
+    return shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(spec,),
@@ -213,7 +213,7 @@ def _apply_row_panels_jit(vqs, tqs, zt, mesh, p, q, adjoint):
             z = lax.fori_loop(0, nsteps, body, z)
         return jnp.transpose(z.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
 
-    return shard_map(
+    return shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(P(None, ROW_AXIS), P(), spec),
@@ -337,7 +337,7 @@ def _ge2tb_jit(at, mesh, p, q, m_true, n_true, nb, nblocks):
         t_out = jnp.transpose(a.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
         return t_out, vqs, tqs, vls, tls
 
-    return shard_map(
+    return shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(spec,),
@@ -397,7 +397,7 @@ def _apply_col_panels_jit(vls, tls, zt, mesh, p, q):
             z = lax.fori_loop(0, nsteps, body, z)
         return jnp.transpose(z.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
 
-    return shard_map(
+    return shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(P(None, COL_AXIS), P(), spec),
@@ -455,7 +455,7 @@ def _gather_diagband_jit(tiles, mesh, p, q, nb, w):
         )
         return psum_a(out, (ROW_AXIS, COL_AXIS))
 
-    return shard_map(
+    return shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(P(ROW_AXIS, COL_AXIS),),
@@ -509,7 +509,7 @@ def _chase_apply_dist_jit(vs, taus, z, mesh, p, q, n, w, blk):
         with audit_scope(nparts):
             return lax.fori_loop(0, nparts, body, z_loc)
 
-    return shard_map(
+    return shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(P(both), P(both), P(None, both)),

@@ -4,7 +4,7 @@ TPU-native analogue of ``slate::gemmC`` (src/gemmC.cc:78-192): the reference
 runs a k-loop that broadcasts A's tile-column k along process rows and B's
 tile-row k along process columns (listBcastMT, BaseMatrix.hh:2093), then
 fires batched cuBLAS gemms per device.  Here the same schedule is a
-``shard_map`` kernel: the broadcast is a masked ``lax.psum`` over one mesh
+``shard_map_compat`` kernel: the broadcast is a masked ``lax.psum`` over one mesh
 axis (owner contributes its tiles, everyone else zeros — lowering to an ICI
 all-reduce whose cost equals a broadcast's within 2x, with no tags or
 lifetimes), and the local batched gemm is one einsum over the device's tile
@@ -26,7 +26,7 @@ from ..types import MethodGemm, select_gemm_method
 from .comm import PRECISE as _PRECISE
 from .comm import bcast_from_col as _bcast_from_col
 from .comm import bcast_from_row as _bcast_from_row
-from .comm import shard_map
+from .comm import shard_map_compat
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 
@@ -116,7 +116,7 @@ def _summa_a_jit(at, bt, ct, alpha, beta, mesh, p, q):
         full = psum_a(part, COL_AXIS)
         return lax.dynamic_slice_in_dim(full, cc, 1, axis=1)[:, 0]
 
-    prod = shard_map(
+    prod = shard_map_compat(
         kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
     )(at, bt)
     if ct is None:
@@ -147,7 +147,7 @@ def _summa_jit(at, bt, ct, alpha, beta, mesh, p, q, kt):
         with audit_scope(kt):
             return lax.fori_loop(0, kt, step, acc0)
 
-    prod = shard_map(
+    prod = shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(spec, spec),

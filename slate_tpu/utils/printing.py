@@ -14,7 +14,6 @@ from __future__ import annotations
 import io
 from typing import Optional
 
-import jax
 import numpy as np
 
 from ..types import Uplo

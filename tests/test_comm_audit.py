@@ -1,0 +1,113 @@
+"""comm_audit coverage (ISSUE 1 satellite): nested audit_scope
+multiplicities, the jit-cache-hit-records-nothing contract, and the
+trace-time recording the jaxpr lint's loop-audit check relies on."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from slate_tpu.parallel.comm import audit_scope, comm_audit, psum_a
+
+
+def _psum_i(x):
+    return psum_a(x, "i")
+
+
+def test_audit_records_payload_and_op():
+    with comm_audit() as recs:
+        jax.make_jaxpr(jax.vmap(_psum_i, axis_name="i"))(jnp.zeros((4, 8)))
+    assert len(recs) == 1
+    op, nbytes, mult = recs[0]
+    assert op == "psum[i]"
+    assert nbytes == 8 * jnp.zeros((), jnp.float64).dtype.itemsize
+    assert mult == 1
+
+
+def test_nested_audit_scope_multiplies():
+    def fn(x):
+        with audit_scope(2):
+            a = _psum_i(x)
+            with audit_scope(3):
+                b = _psum_i(x)
+        c = _psum_i(x)
+        return a + b + c
+
+    with comm_audit() as recs:
+        jax.make_jaxpr(jax.vmap(fn, axis_name="i"))(jnp.zeros((4, 8)))
+    mults = [m for _, _, m in recs]
+    assert mults == [2, 6, 1]
+
+
+def test_audit_scope_restored_on_exit():
+    from slate_tpu.parallel.comm import _AUDIT_MULT
+
+    with audit_scope(5):
+        assert _AUDIT_MULT[-1] == 5
+    assert _AUDIT_MULT[-1] == 1
+
+
+def test_jit_cache_hit_records_nothing():
+    jitted = jax.jit(jax.vmap(_psum_i, axis_name="i"))
+    x = jnp.ones((4, 8))
+    jitted(x).block_until_ready()  # compile outside any audit
+    with comm_audit() as recs:
+        jitted(x).block_until_ready()  # cache hit: no re-trace
+    assert recs == []
+    # a fresh trace (cleared caches) records again
+    jax.clear_caches()
+    with comm_audit() as recs2:
+        jax.jit(jax.vmap(_psum_i, axis_name="i"))(x).block_until_ready()
+    assert len(recs2) == 1
+
+
+def test_audit_nesting_restores_outer_audit():
+    with comm_audit() as outer:
+        jax.make_jaxpr(jax.vmap(_psum_i, axis_name="i"))(jnp.zeros((2, 2)))
+        with comm_audit() as inner:
+            jax.make_jaxpr(jax.vmap(_psum_i, axis_name="i"))(jnp.zeros((2, 4)))
+        jax.make_jaxpr(jax.vmap(_psum_i, axis_name="i"))(jnp.zeros((2, 8)))
+    assert len(inner) == 1
+    assert len(outer) == 2  # inner context's record does not leak out
+
+
+def test_lint_flags_unscoped_loop_collective():
+    """Regression: a toy kernel with a loop collective and NO audit_scope
+    must be reported by the slate_lint loop-audit check."""
+    from slate_tpu.analysis.jaxpr_checks import check_loop_audit
+
+    def bad(x):
+        return jax.lax.fori_loop(0, 3, lambda i, a: a + _psum_i(a), x)
+
+    with comm_audit() as recs:
+        closed = jax.make_jaxpr(jax.vmap(bad, axis_name="i"))(jnp.zeros((2, 4)))
+    found = check_loop_audit(closed, list(recs), "driver:toy")
+    assert len(found) == 1 and found[0].rule == "loop-audit"
+
+    def good(x):
+        with audit_scope(3):
+            return jax.lax.fori_loop(0, 3, lambda i, a: a + _psum_i(a), x)
+
+    with comm_audit() as recs2:
+        closed2 = jax.make_jaxpr(jax.vmap(good, axis_name="i"))(jnp.zeros((2, 4)))
+    assert check_loop_audit(closed2, list(recs2), "driver:toy") == []
+
+
+def test_summarize_ring_estimates():
+    """tools/comm_audit.summarize: ring-lowering receive estimates."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "comm_audit_tool",
+        os.path.join(os.path.dirname(__file__), "..", "tools", "comm_audit.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    p, q = 2, 4
+    recs = [("psum[p]", 100, 2), ("all_gather[q]", 50, 1), ("psum_scatter[q]", 80, 3)]
+    payload, recv, calls, by_op = mod.summarize(recs, p, q)
+    assert payload == 100 * 2 + 50 + 80 * 3
+    assert calls == 6
+    expect = 2 * 100 * (p - 1) / p * 2 + 50 * (q - 1) + 80 * (q - 1) / q * 3
+    assert np.isclose(recv, expect)
+    assert set(by_op) == {"psum", "all_gather", "psum_scatter"}

@@ -1016,7 +1016,12 @@ def test_band_mesh_kernels_band_cost(rng):
     wd = ((nb - 1) + kd) // nb + 1
 
     def flops(compiled):
-        return compiled.cost_analysis()["flops"]
+        # cost_analysis returns one dict on newer JAX, a per-device list
+        # of dicts on 0.4.x
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return ca["flops"]
 
     dense = _potrf_jit.lower(tiles, mesh, 2, 4, nt).compile()
     band = _pbtrf_band_jit.lower(tiles, mesh, 2, 4, nt, wd).compile()
