@@ -269,6 +269,32 @@ def main():
             }
         )
     )
+    _emit_obs_report(gflops, extras)
+
+
+def _emit_obs_report(gflops, extras):
+    """RunReport twin of the driver-facing JSON line (slate_tpu.obs):
+    written when SLATE_TPU_OBS=1 or SLATE_TPU_OBS_REPORT=<path> is set,
+    diffable against any prior report (or this BENCH line itself) with
+    ``python -m slate_tpu.obs.report --check``.  stdout stays untouched."""
+    path = _os.environ.get("SLATE_TPU_OBS_REPORT")
+    if not path and _os.environ.get("SLATE_TPU_OBS", "") in ("", "0"):
+        return
+    try:
+        from slate_tpu.obs.report import write_report
+
+        if not path:
+            path = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                                 "artifacts", "obs", "bench_report.json")
+        _os.makedirs(_os.path.dirname(_os.path.abspath(path)), exist_ok=True)
+        values = {f"dgemm_f64_ozaki_int8_gflops_n{N}": float(gflops)}
+        values.update({k: float(v) for k, v in extras.items()
+                       if isinstance(v, (int, float))})
+        write_report(path, name="bench",
+                     config={"n": N, "n_f64": N_F64}, values=values)
+        _progress(f"obs report written to {path}")
+    except Exception as e:  # the headline line must never die on obs
+        _progress(f"obs report failed: {e!r}")
 
 
 if __name__ == "__main__":

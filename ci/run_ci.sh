@@ -27,6 +27,13 @@ if [ "$seed_rc" -ne 1 ]; then
   exit 1
 fi
 
+# obs smoke: a tiny instrumented potrf_dist on the 8-device mesh must
+# emit a schema-valid RunReport (wall/compile time, flop estimate, comm
+# bytes) + a Perfetto-loadable trace with nested spans, and the
+# `obs.report --check` gate must pass an unchanged report while flagging
+# a synthetic 2x regression (slate_tpu/obs/smoke.py validates all of it)
+python -m slate_tpu.obs.smoke --out artifacts/obs
+
 # ruff / mypy: configured in pyproject.toml; the container image may not
 # ship them, so gate on availability rather than skipping silently
 if command -v ruff > /dev/null 2>&1; then

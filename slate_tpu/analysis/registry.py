@@ -376,3 +376,42 @@ def _don_finale(ctx):
     n = 256
     ap = jnp.asarray(np.random.default_rng(4).standard_normal((n, n)))
     return (lambda x: _potrf_ll_finale_jit(x, n=n)), (ap,), (0,)
+
+
+# ---------------------------------------------------------------------------
+# observability wrappers (ISSUE 2): the same kernels traced WITH obs on
+# ---------------------------------------------------------------------------
+
+
+@register("potrf_dist_obs", tags=("obs",))
+def _potrf_obs(ctx):
+    """potrf_dist traced with observability enabled: proves the obs layer
+    (driver spans, TraceAnnotation bridge, comm-audit absorption with
+    propagate=True) neither changes the kernel jaxpr invariants nor hides
+    audit records from the loop-audit check."""
+    from .. import obs
+    from ..parallel.dist_chol import potrf_dist
+
+    a = ctx.dist(kind="spd", diag_pad=True)
+
+    def fn(x):
+        with obs.force_enabled():
+            with obs.driver_span("lint_obs_probe"):
+                return potrf_dist(x)
+
+    return fn, (a,)
+
+
+@register("gemm_summa_obs", tags=("obs",))
+def _gemm_obs(ctx):
+    from .. import obs
+    from ..parallel.summa import gemm_summa
+    from ..types import MethodGemm
+
+    a, b = ctx.dist(), ctx.dist()
+
+    def fn(x, y):
+        with obs.force_enabled():
+            return gemm_summa(1.0, x, y, method=MethodGemm.GemmC)
+
+    return fn, (a, b)

@@ -17,6 +17,8 @@ diagonal-tile factor to vendor LAPACK (internal_potrf.cc -> lapack::potrf).
 
 from __future__ import annotations
 
+from ..obs import instrument
+
 import functools
 from dataclasses import replace
 from typing import Optional, Tuple, Union
@@ -421,6 +423,7 @@ def _is_f64(dtype) -> bool:
     return dtype in (jnp.dtype(jnp.float64), jnp.dtype(jnp.complex128))
 
 
+@instrument("potrf_array")
 def potrf_array(a: jax.Array, uplo: Uplo = Uplo.Lower) -> Tuple[jax.Array, jax.Array]:
     """Factor A = L L^H (or U^H U). ``a`` holds the uplo triangle (other
     triangle ignored). Returns (factor triangle, info); info = 0 on success
@@ -479,6 +482,7 @@ def potrs(factor: TriangularMatrix, b: ArrayLike):
     return out
 
 
+@instrument("posv_array")
 def posv_array(a: jax.Array, b: jax.Array, uplo: Uplo = Uplo.Lower):
     """Factor + solve (src/posv.cc). Returns (x, factor, info)."""
     f, info = potrf_array(a, uplo)

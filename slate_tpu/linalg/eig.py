@@ -23,6 +23,8 @@ TPU design:
 
 from __future__ import annotations
 
+from ..obs import instrument
+
 import functools
 from typing import NamedTuple, Tuple
 
@@ -525,6 +527,7 @@ def _chase_apply_staged(vs, taus, z, n: int, w: int, adjoint: bool) -> Array:
 # ---------------------------------------------------------------------------
 
 
+@instrument("heev_array")
 def heev_array(
     a: Array,
     want_vectors: bool = True,

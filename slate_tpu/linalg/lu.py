@@ -30,6 +30,8 @@ reference's Pivots = vector<vector<Pivot>> (types.hh:64).
 
 from __future__ import annotations
 
+from ..obs import instrument
+
 from dataclasses import replace
 from typing import NamedTuple, Optional, Tuple, Union
 
@@ -268,6 +270,7 @@ _GETRF_LL_MIN_N = 4096  # f64 on TPU: left-looking from here
 _GETRF_LL_MAX_N = 8192
 
 
+@instrument("getrf_array")
 def getrf_array(a: jax.Array) -> LUFactors:
     """Partial-pivot LU, PA = LU (src/getrf.cc)."""
     if (
@@ -632,6 +635,7 @@ def getrs_array(f: LUFactors, b: jax.Array, op: Op = Op.NoTrans) -> jax.Array:
     return z[inv]
 
 
+@instrument("gesv_array")
 def gesv_array(a: jax.Array, b: jax.Array, method: MethodLU = MethodLU.PartialPiv):
     """Factor + solve (src/gesv.cc). Returns (x, factors)."""
     if method == MethodLU.PartialPiv:

@@ -23,6 +23,8 @@ that Q = I - V T V^H.
 
 from __future__ import annotations
 
+from ..obs import instrument
+
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -232,6 +234,7 @@ def _geqrf_rec(a: Array) -> Tuple[Array, Array]:
     return jnp.concatenate([top, bot], axis=0), t
 
 
+@instrument("geqrf_array")
 def geqrf_array(a: Array) -> QRFactors:
     """slate::geqrf (src/geqrf.cc) — A = Q R."""
     vr, t = _geqrf_rec(a)
@@ -399,6 +402,7 @@ def cholqr_array(a: Array) -> Tuple[Array, Array]:
 # ---------------------------------------------------------------------------
 
 
+@instrument("gels_array")
 def gels_array(
     a: Array, b: Array, opts: Optional[Options] = None
 ) -> Array:

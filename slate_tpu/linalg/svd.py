@@ -22,6 +22,8 @@ TPU design:
 
 from __future__ import annotations
 
+from ..obs import instrument
+
 from typing import NamedTuple
 
 import jax
@@ -386,6 +388,7 @@ def svd_staged(a: Array, want_vectors: bool = True, nb: int = _SVD_NB):
     return u_full, s, jnp.conj(v).T
 
 
+@instrument("svd_array")
 def svd_array(
     a: Array,
     want_vectors: bool = True,

@@ -1,0 +1,61 @@
+"""Unified runtime observability: metrics + span tracing + Perfetto export
++ machine-readable RunReports.
+
+The TPU-native analogue of the reference's trace subsystem
+(include/slate/internal/Trace.hh RAII blocks + the ``slate::timers`` phase
+map) fused with xprof-style annotation:
+
+- ``enable()`` / ``SLATE_TPU_OBS=1`` lights up the whole stack: every
+  instrumented driver (parallel/ kernels, linalg facades, mesh drivers)
+  records nested spans, wall/compile/execute phases, comm bytes (absorbed
+  from the parallel.comm trace-time audit) and XLA flop/byte estimates.
+- ``driver_span(name, **tags)`` is the instrumentation context; the
+  ``instrument`` decorator wires a driver in permanently with near-zero
+  disabled overhead.
+- ``perfetto.write_chrome_trace(path)`` exports everything as a Chrome
+  trace-event JSON that loads in ui.perfetto.dev; span names also bridge
+  into real TPU xprof traces via ``jax.profiler.TraceAnnotation``.
+- ``report`` holds the versioned RunReport schema every perf artifact
+  (bench.py, tester.py, tools/northstar_sweep.py, CI smoke) emits
+  through, plus the ``python -m slate_tpu.obs.report`` CLI with
+  ``--check`` regression gating against prior reports / BENCH_*.json.
+- ``python -m slate_tpu.obs.smoke`` is the CI acceptance run.
+"""
+
+# NOTE: perfetto/report are deliberately NOT imported here so that
+# ``python -m slate_tpu.obs.report`` runs without runpy's found-in-
+# sys.modules warning; import them as submodules
+# (``from slate_tpu.obs import perfetto, report``).
+from .metrics import REGISTRY, MetricsRegistry, flatten_snapshot  # noqa: F401
+from .span import (  # noqa: F401
+    FINISHED,
+    Span,
+    cost_analysis_of,
+    current_span,
+    disable,
+    driver_span,
+    enable,
+    enabled,
+    force_enabled,
+    instrument,
+    measure,
+    reset,
+)
+
+__all__ = [
+    "REGISTRY",
+    "MetricsRegistry",
+    "flatten_snapshot",
+    "FINISHED",
+    "Span",
+    "cost_analysis_of",
+    "current_span",
+    "disable",
+    "driver_span",
+    "enable",
+    "enabled",
+    "force_enabled",
+    "instrument",
+    "measure",
+    "reset",
+]

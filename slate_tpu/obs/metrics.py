@@ -1,0 +1,127 @@
+"""Tagged metrics registry: counters, gauges, histograms.
+
+The single sink every instrumentation source feeds — driver spans
+(obs.span), the comm-byte audit (parallel/comm.py via span absorption),
+and the coarse named timers (utils/trace.py ``block``).  Deliberately
+tiny: a metric is (name, frozen tag set) -> scalar state, snapshots are
+plain JSON-able dicts, and nothing here imports jax so the registry can
+be used from tooling that never builds a mesh.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+# histograms keep a bounded sample reservoir next to exact running stats
+_HIST_SAMPLE_CAP = 512
+
+_Key = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def _key(name: str, tags: Dict[str, object]) -> _Key:
+    return name, tuple(sorted((k, str(v)) for k, v in tags.items()))
+
+
+class _Hist:
+    __slots__ = ("count", "total", "vmin", "vmax", "samples")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+        self.samples: List[float] = []
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        self.vmin = min(self.vmin, v)
+        self.vmax = max(self.vmax, v)
+        if len(self.samples) < _HIST_SAMPLE_CAP:
+            self.samples.append(v)
+
+
+class MetricsRegistry:
+    """Counters accumulate, gauges overwrite, histograms observe.
+
+    Tags are free-form key=value pairs; a distinct tag set is a distinct
+    series (Prometheus-style).  All methods are cheap and thread-safe.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[_Key, float] = {}
+        self._gauges: Dict[_Key, float] = {}
+        self._hists: Dict[_Key, _Hist] = {}
+
+    # -- write side ---------------------------------------------------
+    def counter_add(self, name: str, value: float = 1.0, **tags) -> None:
+        k = _key(name, tags)
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0.0) + float(value)
+
+    def gauge_set(self, name: str, value: float, **tags) -> None:
+        with self._lock:
+            self._gauges[_key(name, tags)] = float(value)
+
+    def observe(self, name: str, value: float, **tags) -> None:
+        k = _key(name, tags)
+        with self._lock:
+            h = self._hists.get(k)
+            if h is None:
+                h = self._hists[k] = _Hist()
+            h.observe(float(value))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+    # -- read side ----------------------------------------------------
+    def counter_value(self, name: str, **tags) -> float:
+        return self._counters.get(_key(name, tags), 0.0)
+
+    def snapshot(self) -> Dict[str, List[dict]]:
+        """JSON-able dump: the RunReport ``metrics`` section."""
+        with self._lock:
+            out: Dict[str, List[dict]] = {"counters": [], "gauges": [], "histograms": []}
+            for (name, tags), v in sorted(self._counters.items()):
+                out["counters"].append({"name": name, "tags": dict(tags), "value": v})
+            for (name, tags), v in sorted(self._gauges.items()):
+                out["gauges"].append({"name": name, "tags": dict(tags), "value": v})
+            for (name, tags), h in sorted(self._hists.items()):
+                out["histograms"].append(
+                    {
+                        "name": name,
+                        "tags": dict(tags),
+                        "count": h.count,
+                        "sum": h.total,
+                        "min": h.vmin if h.count else None,
+                        "max": h.vmax if h.count else None,
+                    }
+                )
+            return out
+
+
+REGISTRY = MetricsRegistry()
+
+
+def flatten_snapshot(snap: Dict[str, List[dict]], sep: str = "|") -> Dict[str, float]:
+    """Flatten a snapshot() into scalar {series_name: value} for report
+    comparison: counters/gauges by value, histograms by their sum."""
+    flat: Dict[str, float] = {}
+
+    def series(entry: dict) -> str:
+        tags = entry.get("tags") or {}
+        if not tags:
+            return entry["name"]
+        tagstr = ",".join(f"{k}={v}" for k, v in sorted(tags.items()))
+        return f"{entry['name']}{sep}{tagstr}"
+
+    for entry in snap.get("counters", []) + snap.get("gauges", []):
+        flat[series(entry)] = float(entry["value"])
+    for entry in snap.get("histograms", []):
+        flat[series(entry)] = float(entry["sum"])
+    return flat

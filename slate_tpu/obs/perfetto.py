@@ -1,0 +1,136 @@
+"""Chrome-trace-event / Perfetto JSON export.
+
+Writes the span stream (obs.span.FINISHED) and, optionally, the legacy
+``utils/trace.py`` event list as a Chrome trace-event JSON object that
+loads directly in ui.perfetto.dev (or chrome://tracing) — the modern
+analogue of the reference's per-thread SVG timelines (Trace.cc:330-600).
+
+Complete events (``"ph": "X"``) with microsecond timestamps; span nesting
+is rendered by Perfetto from overlapping events on one track, so parents
+and children land on the thread-id of their recording thread/lane.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Optional
+
+from . import span as _span
+
+PID = 1
+_US = 1e6
+
+
+def chrome_trace_events(
+    spans: Optional[Iterable[dict]] = None,
+    legacy_events: Optional[Iterable[tuple]] = None,
+    legacy_t0: Optional[float] = None,
+) -> List[dict]:
+    """Build the traceEvents list.  ``spans`` defaults to the finished
+    span stream; ``legacy_events`` takes utils.trace.Trace event tuples
+    (name, lane, t0, t1) and renders them on per-lane tracks.
+
+    Timebases: span timestamps are perf_counter absolutes rebased to the
+    first span; legacy Trace events are already relative to ``Trace.on()``.
+    When mixing both, pass ``legacy_t0=Trace._t0`` (the perf_counter
+    origin of the legacy clock) so the tracks align; without it the
+    legacy track keeps its own zero (fine when one of the two is empty)."""
+    spans = list(_span.FINISHED) if spans is None else list(spans)
+    evs: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": PID, "tid": 0,
+         "args": {"name": "slate_tpu"}},
+    ]
+    base = min((s["t0"] for s in spans), default=0.0)
+    if legacy_events:
+        legacy_events = list(legacy_events)
+    for s in spans:
+        args = dict(s.get("tags", {}))
+        args.update({k: v for k, v in s.get("metrics", {}).items()})
+        if s.get("parent"):
+            args["parent"] = s["parent"]
+        evs.append(
+            {
+                "name": s["name"],
+                "cat": "driver",
+                "ph": "X",
+                "pid": PID,
+                "tid": 0,
+                "ts": (s["t0"] - base) * _US,
+                "dur": max(0.0, (s["t1"] - s["t0"]) * _US),
+                "args": args,
+            }
+        )
+    # shift legacy events into the span timebase when their clock origin
+    # is known (and spans exist to define that base)
+    shift = (legacy_t0 - base) if (legacy_t0 is not None and spans) else 0.0
+    for name, lane, t0, t1 in legacy_events or ():
+        evs.append(
+            {
+                "name": name,
+                "cat": "trace",
+                "ph": "X",
+                "pid": PID,
+                "tid": 100 + int(lane),
+                "ts": max(0.0, (t0 + shift) * _US),
+                "dur": max(0.0, (t1 - t0) * _US),
+                "args": {},
+            }
+        )
+    return evs
+
+
+def chrome_trace(
+    spans: Optional[Iterable[dict]] = None,
+    legacy_events: Optional[Iterable[tuple]] = None,
+    legacy_t0: Optional[float] = None,
+) -> dict:
+    return {
+        "traceEvents": chrome_trace_events(spans, legacy_events, legacy_t0),
+        "displayTimeUnit": "ms",
+        "otherData": {"producer": "slate_tpu.obs"},
+    }
+
+
+def write_chrome_trace(
+    path: str,
+    spans: Optional[Iterable[dict]] = None,
+    legacy_events: Optional[Iterable[tuple]] = None,
+    legacy_t0: Optional[float] = None,
+) -> str:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(spans, legacy_events, legacy_t0), f, indent=1)
+    return path
+
+
+def validate_chrome_trace(obj) -> List[str]:
+    """Schema check for the subset of the trace-event format we emit
+    (and that Perfetto requires to load).  Returns a list of problems —
+    empty means valid."""
+    errs: List[str] = []
+    if not isinstance(obj, dict):
+        return ["top level must be an object"]
+    evs = obj.get("traceEvents")
+    if not isinstance(evs, list):
+        return ["missing traceEvents list"]
+    for i, e in enumerate(evs):
+        where = f"traceEvents[{i}]"
+        if not isinstance(e, dict):
+            errs.append(f"{where}: not an object")
+            continue
+        if not isinstance(e.get("name"), str) or not e.get("name"):
+            errs.append(f"{where}: missing name")
+        ph = e.get("ph")
+        if ph not in ("X", "B", "E", "M", "i", "C"):
+            errs.append(f"{where}: bad ph {ph!r}")
+        if ph in ("X", "B", "E"):
+            ts = e.get("ts")
+            if not isinstance(ts, (int, float)) or ts < 0:
+                errs.append(f"{where}: bad ts {ts!r}")
+        if ph == "X":
+            dur = e.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errs.append(f"{where}: bad dur {dur!r}")
+        for k in ("pid", "tid"):
+            if ph != "M" and not isinstance(e.get(k), int):
+                errs.append(f"{where}: bad {k} {e.get(k)!r}")
+    return errs

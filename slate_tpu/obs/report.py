@@ -1,0 +1,334 @@
+"""RunReport: the versioned machine-readable run record, and its CLI.
+
+One schema for every performance artifact the repo emits — bench.py's
+headline run, tester.py sweeps, tools/northstar_sweep.py chip sweeps, and
+the CI obs smoke step all write this shape, so any report can be diffed
+against any prior one (including the legacy BENCH_*.json single-line
+format, which ``load_values`` understands).
+
+CLI::
+
+    python -m slate_tpu.obs.report REPORT.json              # pretty-print
+    python -m slate_tpu.obs.report --check NEW.json OLD.json [--threshold 1.5]
+
+``--check`` exits 1 when any shared metric regressed by more than the
+ratio threshold (direction inferred per metric: *_seconds / *_bytes /
+*_error are lower-is-better, throughput-style names higher-is-better).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .metrics import REGISTRY, flatten_snapshot
+from . import span as _span
+
+SCHEMA = "slate_tpu.obs.run_report"
+VERSION = 1
+
+# substrings marking a metric as lower-is-better; everything else
+# (gflops, gops, value, mfu, ...) is treated as higher-is-better
+_LOWER_BETTER = ("second", "time", "byte", "error", "err", "resid", "latency")
+
+# pure cost-model estimates with no better/worse direction: halving the
+# XLA flop estimate is usually an optimization, doubling may be a bigger
+# problem — either way it is information, not a gate (checked before the
+# _LOWER_BETTER substrings, so bytes_accessed stays neutral too)
+_NEUTRAL = frozenset({"flops", "transcendentals", "bytes_accessed"})
+
+
+def _env_info() -> dict:
+    info = {}
+    try:
+        import jax
+
+        info["jax"] = jax.__version__
+        try:
+            info["platform"] = jax.default_backend()
+            info["device_count"] = jax.device_count()
+        except Exception:
+            pass
+    except Exception:
+        pass
+    return info
+
+
+def make_report(
+    name: str,
+    config: Optional[dict] = None,
+    values: Optional[Dict[str, float]] = None,
+    include_spans: bool = True,
+) -> dict:
+    """Build a RunReport dict from the current metrics registry + span
+    stream, plus explicit headline ``values``."""
+    spans = list(_span.FINISHED) if include_spans else []
+    base = min((s["t0"] for s in spans), default=0.0)
+    return {
+        "schema": SCHEMA,
+        "version": VERSION,
+        "name": name,
+        "created_unix": time.time(),
+        "env": _env_info(),
+        "config": dict(config or {}),
+        "values": {k: float(v) for k, v in (values or {}).items()},
+        "metrics": REGISTRY.snapshot(),
+        "spans": [
+            {
+                "name": s["name"],
+                "tags": s.get("tags", {}),
+                "start_s": s["t0"] - base,
+                "dur_s": s["t1"] - s["t0"],
+                "depth": s.get("depth", 0),
+                "parent": s.get("parent"),
+                "metrics": s.get("metrics", {}),
+            }
+            for s in spans
+        ],
+    }
+
+
+def write_report(path: str, name: str, config: Optional[dict] = None,
+                 values: Optional[Dict[str, float]] = None) -> str:
+    rep = make_report(name, config, values)
+    with open(path, "w") as f:
+        json.dump(rep, f, indent=1)
+    return path
+
+
+def validate_report(rep) -> List[str]:
+    """Schema check; returns problems (empty list == valid)."""
+    errs: List[str] = []
+    if not isinstance(rep, dict):
+        return ["report must be an object"]
+    if rep.get("schema") != SCHEMA:
+        errs.append(f"schema must be {SCHEMA!r}, got {rep.get('schema')!r}")
+    if not isinstance(rep.get("version"), int):
+        errs.append("version must be an int")
+    if not isinstance(rep.get("name"), str) or not rep.get("name"):
+        errs.append("name must be a non-empty string")
+    if not isinstance(rep.get("created_unix"), (int, float)):
+        errs.append("created_unix must be a number")
+    vals = rep.get("values")
+    if not isinstance(vals, dict) or any(
+        not isinstance(v, (int, float)) for v in vals.values()
+    ):
+        errs.append("values must map metric name -> number")
+    m = rep.get("metrics")
+    if not isinstance(m, dict) or any(
+        not isinstance(m.get(k), list) for k in ("counters", "gauges", "histograms")
+    ):
+        errs.append("metrics must hold counters/gauges/histograms lists")
+    spans = rep.get("spans")
+    if not isinstance(spans, list):
+        errs.append("spans must be a list")
+    else:
+        for i, s in enumerate(spans):
+            if not isinstance(s, dict) or not s.get("name"):
+                errs.append(f"spans[{i}]: missing name")
+            elif not isinstance(s.get("dur_s"), (int, float)) or s["dur_s"] < 0:
+                errs.append(f"spans[{i}]: bad dur_s")
+    return errs
+
+
+def load_values(doc: dict, include_series: bool = False) -> Dict[str, float]:
+    """Comparable scalar metrics from a RunReport OR a legacy BENCH_*.json
+    line ({"metric", "value", "extras": {...}}).
+
+    By default only the headline ``values`` of a RunReport are returned —
+    they are workload-keyed and comparable across runs.  The flattened
+    counter/gauge/histogram series (``comm_bytes|span=...`` etc.) scale
+    with however much work a run happened to do, so they only join the
+    comparison on request (``include_series=True`` / ``--all-metrics``),
+    for same-config run pairs."""
+    vals: Dict[str, float] = {}
+    if doc.get("schema") == SCHEMA:
+        vals.update(doc.get("values", {}))
+        if include_series:
+            vals.update(flatten_snapshot(doc.get("metrics", {})))
+        return {k: float(v) for k, v in vals.items()
+                if isinstance(v, (int, float))}
+    if "metric" in doc and "value" in doc:  # legacy bench line
+        if isinstance(doc["value"], (int, float)):
+            vals[doc["metric"]] = float(doc["value"])
+        for k, v in (doc.get("extras") or {}).items():
+            if isinstance(v, (int, float)):
+                vals[k] = float(v)
+        return vals
+    if "results" in doc:  # legacy SWEEP_*.json
+        for r in doc["results"]:
+            if isinstance(r.get("gflops"), (int, float)) and r.get("ok"):
+                vals[f"{r['routine']}_n{r['n']}_gflops"] = float(r["gflops"])
+        return vals
+    if isinstance(doc.get("tail"), str):  # driver BENCH_*.json wrapper:
+        # the bench stdout rides in "tail"; its last parsable JSON object
+        # line with a "metric" key is the headline record
+        for line in reversed(doc["tail"].splitlines()):
+            if not line.startswith("{"):
+                continue
+            try:
+                inner = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(inner, dict) and "metric" in inner:
+                return load_values(inner)
+        raise ValueError(
+            "BENCH wrapper has no parsable metric line in its tail "
+            f"(rc={doc.get('rc')}) — cannot gate against it")
+    raise ValueError("unrecognized report format (not a RunReport, bench "
+                     "line, or sweep file)")
+
+
+def lower_is_better(name: str) -> bool:
+    low = name.lower()
+    return any(tok in low for tok in _LOWER_BETTER)
+
+
+def check_regression(
+    new_vals: Dict[str, float],
+    old_vals: Dict[str, float],
+    threshold: float = 1.5,
+) -> Tuple[List[str], int]:
+    """Compare shared metrics; returns (failure messages, n compared).
+    A metric fails when it is worse than the old value by more than the
+    ratio threshold in its own direction."""
+    failures: List[str] = []
+    compared = 0
+    for name in sorted(set(new_vals) & set(old_vals)):
+        if name.split("|", 1)[0] in _NEUTRAL:
+            continue  # directionless cost estimates never gate
+        old, new = old_vals[name], new_vals[name]
+        if old == 0 or new == 0:
+            continue  # ratios undefined; absolute-zero metrics can't gate
+        if (old < 0) != (new < 0):
+            continue
+        compared += 1
+        ratio = new / old if lower_is_better(name) else old / new
+        if ratio > threshold:
+            direction = "rose" if lower_is_better(name) else "fell"
+            failures.append(
+                f"{name}: {direction} {ratio:.2f}x beyond threshold "
+                f"{threshold}x ({old:.4g} -> {new:.4g})"
+            )
+    return failures, compared
+
+
+def _pretty(rep: dict) -> str:
+    lines = [f"RunReport {rep.get('name')!r} (schema {rep.get('schema')} "
+             f"v{rep.get('version')})"]
+    env = rep.get("env") or {}
+    if env:
+        lines.append("  env: " + ", ".join(f"{k}={v}" for k, v in sorted(env.items())))
+    cfg = rep.get("config") or {}
+    if cfg:
+        lines.append("  config: " + ", ".join(f"{k}={v}" for k, v in sorted(cfg.items())))
+    vals = rep.get("values") or {}
+    if vals:
+        lines.append("  values:")
+        for k, v in sorted(vals.items()):
+            lines.append(f"    {k:<44} {v:>14.4g}")
+    m = rep.get("metrics") or {}
+    for kind in ("counters", "gauges"):
+        for e in m.get(kind, []):
+            tagstr = ",".join(f"{k}={v}" for k, v in sorted((e.get("tags") or {}).items()))
+            lines.append(f"  {kind[:-1]:<8} {e['name']}{{{tagstr}}} = {e['value']:.6g}")
+    for e in m.get("histograms", []):
+        tagstr = ",".join(f"{k}={v}" for k, v in sorted((e.get("tags") or {}).items()))
+        lines.append(
+            f"  hist     {e['name']}{{{tagstr}}} n={e['count']} sum={e['sum']:.6g}"
+        )
+    spans = rep.get("spans") or []
+    if spans:
+        lines.append(f"  spans ({len(spans)}):")
+        for s in spans[:64]:
+            pad = "  " * int(s.get("depth", 0))
+            lines.append(
+                f"    {pad}{s['name']}  {s['dur_s'] * 1e3:.2f} ms"
+                + (f"  comm={s['metrics'].get('comm_bytes', 0):,.0f}B"
+                   if s.get("metrics", {}).get("comm_bytes") else "")
+            )
+        if len(spans) > 64:
+            lines.append(f"    ... {len(spans) - 64} more")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m slate_tpu.obs.report", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("report", nargs="?", help="RunReport JSON to pretty-print")
+    ap.add_argument("--check", nargs=2, metavar=("NEW", "OLD"),
+                    help="compare NEW against OLD (RunReport or BENCH_*.json)")
+    ap.add_argument("--threshold", type=float, default=1.5,
+                    help="worse-than ratio that fails --check (default 1.5)")
+    ap.add_argument("--all-metrics", action="store_true",
+                    help="gate the flattened counter/histogram series too "
+                         "(only meaningful for same-config run pairs; the "
+                         "default gates the headline values only)")
+    args = ap.parse_args(argv)
+
+    if args.check:
+        new_path, old_path = args.check
+        try:
+            with open(new_path) as f:
+                new_doc = json.load(f)
+            with open(old_path) as f:
+                old_doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"obs.report: cannot read report: {e}")
+            return 2
+        if new_doc.get("schema") == SCHEMA:
+            errs = validate_report(new_doc)
+            if errs:
+                print(f"obs.report: {new_path} is not a valid RunReport:")
+                for e in errs:
+                    print(f"  {e}")
+                return 2
+        if (new_doc.get("schema") == SCHEMA == old_doc.get("schema")
+                and new_doc.get("config") != old_doc.get("config")):
+            print(f"obs.report: note — configs differ "
+                  f"({new_doc.get('config')} vs {old_doc.get('config')}); "
+                  "only matching metric names are compared")
+        try:
+            failures, compared = check_regression(
+                load_values(new_doc, args.all_metrics),
+                load_values(old_doc, args.all_metrics), args.threshold
+            )
+        except ValueError as e:
+            # an unrecognized/timed-out artifact is INCONCLUSIVE (2), not
+            # a regression (1)
+            print(f"obs.report: {e}")
+            return 2
+        if compared == 0:
+            print("obs.report: no shared metrics to compare")
+            return 2
+        if failures:
+            print(f"obs.report: {len(failures)} regression(s) over "
+                  f"{compared} shared metric(s):")
+            for msg in failures:
+                print(f"  FAIL {msg}")
+            return 1
+        print(f"obs.report: OK — {compared} shared metric(s) within "
+              f"{args.threshold}x")
+        return 0
+
+    if not args.report:
+        ap.error("give a REPORT to print or --check NEW OLD")
+    with open(args.report) as f:
+        rep = json.load(f)
+    errs = validate_report(rep) if rep.get("schema") == SCHEMA else []
+    print(_pretty(rep) if rep.get("schema") == SCHEMA else json.dumps(rep, indent=1))
+    if errs:
+        print("validation problems:")
+        for e in errs:
+            print(f"  {e}")
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

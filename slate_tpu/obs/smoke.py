@@ -1,0 +1,158 @@
+"""Obs smoke: a tiny instrumented distributed Cholesky on the 8-device
+CPU mesh, emitting and validating one RunReport + one Perfetto trace.
+
+This is the CI acceptance path for the observability layer (ci/run_ci.sh
+"obs smoke" step): it proves that a dist_chol run produces (a) a
+schema-valid RunReport with wall/compile time, an XLA flop estimate, and
+comm bytes, (b) a Perfetto-loadable trace JSON with nested driver/phase
+spans, and (c) that ``obs.report --check`` passes an unchanged report and
+flags a synthetic 2x regression.
+
+Usage::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m slate_tpu.obs.smoke [--out artifacts/obs] [--n 96] [--nb 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import sys
+
+
+def run_smoke(out_dir: str, n: int = 96, nb: int = 8) -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from . import (
+        driver_span, enable, measure, perfetto, report, reset,
+    )
+    from .metrics import REGISTRY
+
+    devs = jax.devices("cpu")
+    if len(devs) < 8:
+        print(f"obs.smoke: need 8 CPU devices, have {len(devs)} — set "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+        return 2
+
+    from ..parallel import from_dense, make_mesh, potrf_dist
+
+    reset()
+    enable()
+    mesh = make_mesh(2, 4, devices=devs[:8])
+    rng = np.random.default_rng(0)
+    g = rng.standard_normal((n, n))
+    spd = jnp.asarray((g @ g.T / n + 2 * np.eye(n)).astype(np.float32))
+    ad = from_dense(spd, mesh, nb, diag_pad_one=True)
+
+    jax.clear_caches()  # comm-byte audit records at trace time only
+    with driver_span("smoke", n=n, nb=nb, grid="2x4"):
+        (l, info), m = measure(
+            "dist_chol", lambda d: potrf_dist(d), ad,
+            tags={"n": n, "nb": nb},
+        )
+    if int(info) != 0:
+        print(f"obs.smoke: potrf_dist reported info={int(info)}")
+        return 1
+    REGISTRY.gauge_set("potrf_gflops", n**3 / 3 / max(m["execute_seconds"], 1e-12) / 1e9)
+
+    os.makedirs(out_dir, exist_ok=True)
+    rep_path = os.path.join(out_dir, "smoke_report.json")
+    trace_path = os.path.join(out_dir, "smoke_trace.json")
+
+    values = {
+        "wall_seconds": m.get("wall_seconds", 0.0),
+        "compile_seconds": m.get("compile_seconds", 0.0),
+        "execute_seconds": m.get("execute_seconds", 0.0),
+        "comm_bytes": m.get("comm_bytes", 0.0),
+    }
+    if "flops" in m:
+        values["flops"] = m["flops"]
+    report.write_report(rep_path, name="obs_smoke",
+                        config={"n": n, "nb": nb, "grid": "2x4",
+                                "driver": "potrf_dist"},
+                        values=values)
+    perfetto.write_chrome_trace(trace_path)
+
+    failures = []
+
+    # (a) RunReport: schema-valid and carries the acceptance metrics
+    with open(rep_path) as f:
+        rep = json.load(f)
+    errs = report.validate_report(rep)
+    if errs:
+        failures.append(f"RunReport schema: {errs}")
+    for key in ("wall_seconds", "compile_seconds", "comm_bytes"):
+        if key not in rep["values"]:
+            failures.append(f"RunReport missing value {key}")
+    if rep["values"].get("comm_bytes", 0) <= 0:
+        failures.append("RunReport comm_bytes not positive — audit absorption broke")
+    if "flops" in rep["values"] and rep["values"]["flops"] <= 0:
+        failures.append("RunReport flop estimate not positive")
+
+    # (b) Perfetto trace: loadable, with nested driver/phase spans
+    with open(trace_path) as f:
+        tr = json.load(f)
+    errs = perfetto.validate_chrome_trace(tr)
+    if errs:
+        failures.append(f"trace schema: {errs[:4]}")
+    names = {e["name"] for e in tr["traceEvents"]}
+    for want in ("smoke", "dist_chol", "dist_chol:compile", "potrf_dist"):
+        if want not in names:
+            failures.append(f"trace missing span {want!r}")
+    parents = {e["args"].get("parent") for e in tr["traceEvents"] if e["ph"] == "X"}
+    if "dist_chol" not in parents:
+        failures.append("trace spans carry no nesting (no parent=dist_chol)")
+
+    # (c) report --check: unchanged passes, synthetic 2x regression fails
+    regressed = copy.deepcopy(rep)
+    for k in regressed["values"]:
+        if report.lower_is_better(k):
+            regressed["values"][k] *= 2.0
+        else:
+            regressed["values"][k] /= 2.0
+    bad_path = os.path.join(out_dir, "smoke_report_regressed.json")
+    with open(bad_path, "w") as f:
+        json.dump(regressed, f)
+    # capture the intentional-failure output: its FAIL lines must not
+    # land in a green CI log
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc_same = report.main(["--check", rep_path, rep_path])
+        rc_bad = report.main(["--check", bad_path, rep_path])
+    if rc_same != 0:
+        failures.append(f"--check of an unchanged report exited {rc_same} (want 0)")
+    if rc_bad != 1:
+        failures.append(f"--check of a 2x-regressed report exited {rc_bad} (want 1)")
+    if failures:  # only then is the captured check output diagnostic
+        print(buf.getvalue(), end="")
+
+    if failures:
+        print(f"obs.smoke: FAILED with {len(failures)} problem(s):")
+        for msg in failures:
+            print(f"  FAIL {msg}")
+        return 1
+    print(f"obs.smoke: OK — report {rep_path} ({len(rep['spans'])} spans, "
+          f"{rep['values']['comm_bytes']:,.0f} comm B/dev traced), "
+          f"trace {trace_path} ({len(tr['traceEvents'])} events)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m slate_tpu.obs.smoke")
+    ap.add_argument("--out", default=os.path.join("artifacts", "obs"))
+    ap.add_argument("--n", type=int, default=96)
+    ap.add_argument("--nb", type=int, default=8)
+    args = ap.parse_args(argv)
+    return run_smoke(args.out, args.n, args.nb)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,17 +84,25 @@ _AUDIT_MULT = [1]
 
 
 @contextlib.contextmanager
-def comm_audit():
+def comm_audit(propagate: bool = False):
     """Yield a list that fills with (op, payload_bytes, multiplicity)
     records for every audited collective traced while active.  Callers
     must ensure the target kernel actually re-traces (jax.clear_caches()
-    or a fresh shape) — a jit cache hit records nothing."""
+    or a fresh shape) — a jit cache hit records nothing.
+
+    ``propagate=True`` re-appends the captured records to the enclosing
+    audit (if any) on exit, so a nested capture observes without stealing
+    — obs.driver_span uses this to absorb bytes per span while an outer
+    audit (slate_lint's trace pass, the comm-volume tool) still sees
+    every record."""
     global _AUDIT
     old, _AUDIT = _AUDIT, []
     try:
         yield _AUDIT
     finally:
-        _AUDIT = old
+        records, _AUDIT = _AUDIT, old
+        if propagate and old is not None:
+            old.extend(records)
 
 
 @contextlib.contextmanager

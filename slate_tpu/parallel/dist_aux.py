@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..obs import instrument
 from ..types import Norm, Uplo
 from .comm import (
     PRECISE,
@@ -36,6 +37,7 @@ from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 
 
+@instrument("norm_dist")
 def norm_dist(norm: Norm, d: DistMatrix) -> jax.Array:
     """Matrix norm of a DistMatrix, computed fully distributed
     (src/norm.cc: local reduce + allreduce).  One/Inf/Max/Fro."""
@@ -88,6 +90,7 @@ def _norm_jit(at, mesh, p, q, m_true, n_true, norm):
     return out[0, 0]
 
 
+@instrument("herk_dist")
 def herk_dist(
     alpha,
     a: DistMatrix,

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..obs import instrument
 from ..types import Diag, Op, Uplo
 from .comm import (
     PRECISE,
@@ -42,6 +43,7 @@ from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 
 
+@instrument("transpose_dist")
 def transpose_dist(a: DistMatrix, conj: bool = False) -> DistMatrix:
     """op(A) on the same mesh: out tile (i, j) = op(in tile (j, i)).
 
@@ -136,6 +138,7 @@ def _set_diag(t, dvals):
     return jnp.where(eye, dvals[..., :, None] * jnp.eye(nb, dtype=t.dtype), t)
 
 
+@instrument("hemm_summa")
 def hemm_summa(
     side,
     alpha,
@@ -281,6 +284,7 @@ def _hemm_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, uplo, conj):
     return (alpha * prod + beta * ct).astype(at.dtype)
 
 
+@instrument("trmm_dist")
 def trmm_dist(
     side,
     uplo: Uplo,
@@ -369,6 +373,7 @@ def _trmm_jit(at, bt, alpha, mesh, p, q, kt, uplo, op, diag):
     return (alpha * prod).astype(at.dtype)
 
 
+@instrument("her2k_dist")
 def her2k_dist(
     alpha,
     a: DistMatrix,
@@ -395,6 +400,7 @@ def her2k_dist(
     return DistMatrix(tiles=out, m=a.m, n=a.m, nb=a.nb, mesh=a.mesh, diag_pad=no_pad)
 
 
+@instrument("syr2k_dist")
 def syr2k_dist(alpha, a, b, beta=0.0, c=None, uplo: Uplo = Uplo.Lower, full=False):
     return her2k_dist(alpha, a, b, beta, c, uplo, conj=False, full=full)
 

@@ -38,6 +38,7 @@ from jax import lax
 
 from jax.sharding import PartitionSpec as P
 
+from ..obs import instrument
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 from .comm import (
@@ -51,6 +52,7 @@ from .comm import (
     shard_map_compat,
 )
 
+@instrument("potrf_dist")
 def potrf_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array]:
     """Factor A = L L^H (lower). ``a`` holds the lower triangle (upper tile
     content ignored). Returns (L as DistMatrix, info)."""
@@ -161,6 +163,7 @@ def _potrf_jit(at, mesh, p, q, nt):
     return lt, jnp.max(info)
 
 
+@instrument("pbtrf_band_dist")
 def pbtrf_band_dist(a: DistMatrix, kd: int) -> Tuple[DistMatrix, jax.Array]:
     """Band Cholesky on the mesh at band cost (src/pbtrf.cc): the k-loop
     only ever touches the O(wd^2) tile window inside the bandwidth —

@@ -39,6 +39,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..linalg.lu import _getrf_nopiv_rec, _tournament_reduce
+from ..obs import instrument
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 from .comm import (
@@ -54,6 +55,7 @@ from .comm import (
     shard_map_compat,
 )
 
+@instrument("getrf_nopiv_dist")
 def getrf_nopiv_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array]:
     """Factor A = L U in place (packed LU tiles). Returns (LU, info)."""
     p, q = mesh_shape(a.mesh)
@@ -175,6 +177,7 @@ def _lu_jit(at, mesh, p, q, nt):
 # ---------------------------------------------------------------------------
 
 
+@instrument("getrf_tntpiv_dist")
 def getrf_tntpiv_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array, jax.Array]:
     """Factor P A = L U with tournament pivoting across the mesh.
 
@@ -310,6 +313,7 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true):
 # ---------------------------------------------------------------------------
 
 
+@instrument("getrf_pp_dist")
 def getrf_pp_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array, jax.Array]:
     """Factor P A = L U with classic partial (per-column argmax) pivoting.
 
@@ -510,6 +514,7 @@ def _pp_jit(at, mesh, p, q, nt, m_true):
     return lut, perm[0], jnp.max(info)
 
 
+@instrument("gbtrf_band_dist")
 def gbtrf_band_dist(
     a: DistMatrix, kl: int, ku: int
 ) -> Tuple[DistMatrix, jax.Array, jax.Array]:
@@ -630,6 +635,7 @@ def _gb_pp_jit(at, mesh, p, q, nt, m_true, wd_l, wd_u, wd_usw):
     return lut, perm[0], jnp.max(info)
 
 
+@instrument("permute_rows_dist")
 def permute_rows_dist(b: DistMatrix, perm: jax.Array) -> DistMatrix:
     """B <- P B for a global row permutation over the padded row space
     (the pivot-application data motion of getrs, internal_swap.cc run as

@@ -37,6 +37,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..linalg.qr import _larft, _larft_v, _panel_qr, _panel_qr_offset, _v_of
+from ..obs import instrument
 from ..types import Op
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
@@ -80,6 +81,7 @@ def _merge_ids(p: int) -> List[List[int]]:
     return ids
 
 
+@instrument("geqrf_dist")
 def geqrf_dist(a: DistMatrix) -> DistQR:
     """Factor A = Q R across the mesh (m >= n)."""
     p, q = mesh_shape(a.mesh)
@@ -259,6 +261,7 @@ def _geqrf_jit(at, mesh, p, q, nt, m_true, n_true):
     )(at)
 
 
+@instrument("unmqr_dist")
 def unmqr_dist(f: DistQR, b: DistMatrix, op: Op = Op.ConjTrans) -> DistMatrix:
     """B <- Q^H B (op=ConjTrans) or Q B (op=NoTrans) from CAQR factors."""
     a = f.fact

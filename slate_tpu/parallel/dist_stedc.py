@@ -41,10 +41,12 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..linalg.tridiag import _DC_SMALL, _secular_roots_shard, _zhat_shard, steqr
+from ..obs import instrument
 from .comm import PRECISE, all_gather_a, psum_a, shard_map_compat
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 
 
+@instrument("stedc_dist")
 def stedc_dist(d: jax.Array, e: jax.Array, mesh) -> Tuple[jax.Array, jax.Array]:
     """Eigen-decomposition of the symmetric tridiagonal (d, e) with the
     merge tree sharded over ``mesh``.  Returns (w ascending, Z) where Z is

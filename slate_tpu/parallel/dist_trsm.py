@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..obs import instrument
 from ..types import Diag, MethodTrsm, Op, Side, Uplo, select_trsm_method
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
@@ -40,6 +41,7 @@ from .comm import (
 from typing import Optional
 
 
+@instrument("trsm_dist")
 def trsm_dist(
     a: DistMatrix,
     b: DistMatrix,
@@ -236,6 +238,7 @@ def _trsm_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
     )(at, bt)
 
 
+@instrument("trsm_dist_right")
 def trsm_dist_right(
     a: DistMatrix,
     b: DistMatrix,

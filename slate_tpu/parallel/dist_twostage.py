@@ -35,6 +35,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..linalg.eig import _he2hb_panel_count
+from ..obs import instrument
 from ..linalg.qr import _larft_v, _panel_qr_offset
 from .comm import (PRECISE, all_gather_a, audit_scope, bcast_from_col,
                    bcast_from_row, local_indices, psum_a, shard_map_compat)
@@ -70,6 +71,7 @@ class DistTwoStage(NamedTuple):
 # ---------------------------------------------------------------------------
 
 
+@instrument("he2hb_dist")
 def he2hb_dist(a: DistMatrix) -> DistTwoStage:
     """Reduce the full Hermitian DistMatrix (both triangles stored) to a
     Hermitian band of bandwidth nb; Q panels sharded over mesh rows."""
@@ -176,6 +178,7 @@ def _he2hb_jit(at, mesh, p, q, n_true, nb, nsteps):
     )(at)
 
 
+@instrument("unmtr_he2hb_dist")
 def unmtr_he2hb_dist(f: DistTwoStage, z: DistMatrix, adjoint: bool = False) -> DistMatrix:
     """Z <- Q Z (or Q^H Z) for the distributed stage-1 Q: one psum along
     'p' per panel, reflectors consumed from their sharded storage
@@ -227,6 +230,7 @@ def _apply_row_panels_jit(vqs, tqs, zt, mesh, p, q, adjoint):
 # ---------------------------------------------------------------------------
 
 
+@instrument("ge2tb_dist")
 def ge2tb_dist(a: DistMatrix) -> DistTwoStage:
     """Reduce a general (m >= n) DistMatrix to an upper triangular band of
     bandwidth nb via alternating distributed QR/LQ panels; U-side
@@ -346,6 +350,7 @@ def _ge2tb_jit(at, mesh, p, q, m_true, n_true, nb, nblocks):
     )(at)
 
 
+@instrument("unmbr_ge2tb_u_dist")
 def unmbr_ge2tb_u_dist(f: DistTwoStage, z: DistMatrix, adjoint: bool = False) -> DistMatrix:
     """Z <- Q Z for the stage-1 U factor (src/unmbr_ge2tb.cc U side) —
     identical panel-apply loop to unmtr_he2hb_dist."""
@@ -356,6 +361,7 @@ def unmbr_ge2tb_u_dist(f: DistTwoStage, z: DistMatrix, adjoint: bool = False) ->
     return DistMatrix(tiles=zt, m=z.m, n=z.n, nb=z.nb, mesh=z.mesh)
 
 
+@instrument("unmbr_ge2tb_v_dist")
 def unmbr_ge2tb_v_dist(f: DistTwoStage, z: DistMatrix) -> DistMatrix:
     """Z <- P Z for the stage-1 V factor: the reflectors live in A's
     COLUMN space (sharded over 'q') while Z's rows are sharded over 'p',
@@ -464,6 +470,7 @@ def _gather_diagband_jit(tiles, mesh, p, q, nb, w):
     )(tiles)
 
 
+@instrument("chase_apply_dist")
 def chase_apply_dist(vs, taus, z, n: int, w: int, mesh) -> jax.Array:
     """Z <- U Z for a bulge-chase reflector basis with Z column-sharded
     over ALL p*q devices and the (sweep, hop) family sharded by sweep

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+from ..obs import instrument
 from ..types import Diag, Op, Uplo
 from .dist import DistMatrix, from_dense, to_dense
 from .dist_chol import potrf_dist
@@ -34,6 +35,7 @@ from .summa import gemm_summa
 _DEFAULT_NB = 256
 
 
+@instrument("gemm_mesh")
 def gemm_mesh(
     alpha, a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
     beta=0.0, c: Optional[jax.Array] = None,
@@ -45,6 +47,7 @@ def gemm_mesh(
     return to_dense(gemm_summa(alpha, ad, bd, beta, cd))
 
 
+@instrument("potrf_mesh")
 def potrf_mesh(
     a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
 ) -> Tuple[DistMatrix, jax.Array]:
@@ -52,6 +55,7 @@ def potrf_mesh(
     return potrf_dist(from_dense(a, mesh, nb, diag_pad_one=True))
 
 
+@instrument("posv_mesh")
 def posv_mesh(
     a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
 ) -> Tuple[jax.Array, jax.Array]:
@@ -63,12 +67,14 @@ def posv_mesh(
     return to_dense(x), info
 
 
+@instrument("getrf_nopiv_mesh")
 def getrf_nopiv_mesh(
     a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
 ) -> Tuple[DistMatrix, jax.Array]:
     return getrf_nopiv_dist(from_dense(a, mesh, nb, diag_pad_one=True))
 
 
+@instrument("gesv_nopiv_mesh")
 def gesv_nopiv_mesh(
     a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
 ) -> Tuple[jax.Array, jax.Array]:
@@ -82,11 +88,13 @@ def gesv_nopiv_mesh(
     return to_dense(x), info
 
 
+@instrument("geqrf_mesh")
 def geqrf_mesh(a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB):
     """Distributed CAQR factorization (src/geqrf.cc). Returns DistQR."""
     return geqrf_dist(from_dense(a, mesh, nb))
 
 
+@instrument("gels_mesh")
 def gels_mesh(
     a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
 ) -> Tuple[jax.Array, jax.Array]:
@@ -110,6 +118,7 @@ def gels_mesh(
     return to_dense(xd), info
 
 
+@instrument("heev_mesh")
 def heev_mesh(
     a: jax.Array, mesh: Mesh, nb: int = 64, want_vectors: bool = True,
     distributed_solver: bool = True,
@@ -162,6 +171,7 @@ def heev_mesh(
     return w, to_dense(zd)
 
 
+@instrument("svd_mesh")
 def svd_mesh(
     a: jax.Array, mesh: Mesh, nb: int = 64, want_vectors: bool = True
 ):
@@ -199,6 +209,7 @@ def svd_mesh(
     return to_dense(ud), s, jnp.conj(to_dense(vd)).T
 
 
+@instrument("getrf_tntpiv_mesh")
 def getrf_tntpiv_mesh(
     a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
 ) -> Tuple[DistMatrix, jax.Array, jax.Array]:
@@ -207,6 +218,7 @@ def getrf_tntpiv_mesh(
     return getrf_tntpiv_dist(from_dense(a, mesh, nb, diag_pad_one=True))
 
 
+@instrument("gesv_tntpiv_mesh")
 def gesv_tntpiv_mesh(
     a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
 ) -> Tuple[jax.Array, jax.Array]:
@@ -266,6 +278,7 @@ def _astype_dist(d: DistMatrix, dtype) -> DistMatrix:
                       mesh=d.mesh, diag_pad=d.diag_pad)
 
 
+@instrument("posv_mixed_mesh")
 def posv_mixed_mesh(
     a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
     max_iter: int = 30,
@@ -296,6 +309,7 @@ def _nan_like_solution(bd: DistMatrix, ad: DistMatrix) -> jax.Array:
     return jnp.full((bd.m, bd.n), jnp.nan, ad.tiles.dtype)
 
 
+@instrument("gesv_mixed_mesh")
 def gesv_mixed_mesh(
     a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
     max_iter: int = 30,
@@ -319,6 +333,7 @@ def gesv_mixed_mesh(
     return to_dense(x), jnp.asarray(iters if conv else -1, jnp.int32), info
 
 
+@instrument("getri_mesh")
 def getri_mesh(
     a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
 ) -> Tuple[jax.Array, jax.Array]:
@@ -336,6 +351,7 @@ def getri_mesh(
     return to_dense(x), info
 
 
+@instrument("potri_mesh")
 def potri_mesh(
     a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
 ) -> Tuple[jax.Array, jax.Array]:
@@ -358,6 +374,7 @@ def potri_mesh(
 # ---------------------------------------------------------------------------
 
 
+@instrument("gbmm_mesh")
 def gbmm_mesh(
     alpha, a: jax.Array, kl: int, ku: int, b: jax.Array, mesh: Mesh,
     nb: int = _DEFAULT_NB, beta=0.0, c: Optional[jax.Array] = None,
@@ -368,6 +385,7 @@ def gbmm_mesh(
     return gemm_mesh(alpha, band_project(a, kl, ku), b, mesh, nb, beta, c)
 
 
+@instrument("hbmm_mesh")
 def hbmm_mesh(
     side, alpha, a: jax.Array, kd: int, b: jax.Array, mesh: Mesh,
     nb: int = _DEFAULT_NB, beta=0.0, c: Optional[jax.Array] = None,
@@ -384,6 +402,7 @@ def hbmm_mesh(
     return to_dense(hemm_summa(side, alpha, ad, bd, beta, cd, uplo=uplo))
 
 
+@instrument("tbsm_mesh")
 def tbsm_mesh(
     a: jax.Array, kd: int, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
     uplo: Uplo = Uplo.Lower, diag: Diag = Diag.NonUnit,
@@ -401,6 +420,7 @@ def tbsm_mesh(
     return to_dense(trsm_dist(ad, bd, uplo, Op.NoTrans, diag))
 
 
+@instrument("pbsv_mesh")
 def pbsv_mesh(
     a: jax.Array, b: jax.Array, kd: int, mesh: Mesh, nb: int = _DEFAULT_NB
 ) -> Tuple[jax.Array, jax.Array]:
@@ -423,6 +443,7 @@ def pbsv_mesh(
     return to_dense(x), info
 
 
+@instrument("gbsv_mesh")
 def gbsv_mesh(
     a: jax.Array, b: jax.Array, kl: int, ku: int, mesh: Mesh,
     nb: int = _DEFAULT_NB,
@@ -445,6 +466,7 @@ def gbsv_mesh(
     return to_dense(x), info
 
 
+@instrument("getrf_mesh")
 def getrf_mesh(
     a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
 ) -> Tuple[DistMatrix, jax.Array, jax.Array]:
@@ -454,6 +476,7 @@ def getrf_mesh(
     return getrf_pp_dist(from_dense(a, mesh, nb, diag_pad_one=True))
 
 
+@instrument("gesv_mesh")
 def gesv_mesh(
     a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
 ) -> Tuple[jax.Array, jax.Array]:

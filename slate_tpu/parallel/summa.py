@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..obs import instrument
 from ..types import MethodGemm, select_gemm_method
 from .comm import PRECISE as _PRECISE
 from .comm import bcast_from_col as _bcast_from_col
@@ -36,6 +37,7 @@ def _local_outer(acol: jax.Array, brow: jax.Array, dtype) -> jax.Array:
     return jnp.einsum("iab,jbc->ijac", acol, brow, precision=_PRECISE).astype(dtype)
 
 
+@instrument("gemm_summa")
 def gemm_summa(
     alpha,
     a: DistMatrix,

@@ -57,24 +57,44 @@ class Trace:
 
     @classmethod
     def finish(cls, path: str = "trace.svg", scale: float = 200.0) -> Optional[str]:
-        """Emit the SVG timeline via the native writer (Trace.cc:330-600
-        analogue). Returns the path, or None if no events / no writer."""
+        """Emit the timeline: the native SVG writer when available
+        (Trace.cc:330-600 analogue), a pure-Python Chrome-trace JSON
+        fallback otherwise — so traces survive hosts without g++.
+        Returns the written path, or None if there were no events or no
+        writer succeeded.  Collected events are only dropped once a
+        writer actually succeeded (they used to be lost on any failure)."""
         if not cls._events:
             return None
-        lib = _load_writer()
-        if lib is None:
-            return None
-        h = lib.slate_trace_new()
+        # an explicit .json path requests the Chrome-trace form directly
+        lib = None if path.endswith(".json") else _load_writer()
+        if lib is not None:
+            h = lib.slate_trace_new()
+            try:
+                for name, lane, t0, t1 in cls._events:
+                    lib.slate_trace_event(
+                        h, name.encode(), lane, ctypes.c_double(t0), ctypes.c_double(t1), b""
+                    )
+                rc = lib.slate_trace_write_svg(h, path.encode(), ctypes.c_double(scale))
+            finally:
+                lib.slate_trace_free(h)
+            if rc == 0:
+                cls._events = []
+                return path
+        return cls._finish_json(path)
+
+    @classmethod
+    def _finish_json(cls, path: str) -> Optional[str]:
+        """Chrome-trace-event JSON fallback (loads in ui.perfetto.dev);
+        events are kept if even this write fails."""
+        json_path = path if path.endswith(".json") else path + ".json"
         try:
-            for name, lane, t0, t1 in cls._events:
-                lib.slate_trace_event(
-                    h, name.encode(), lane, ctypes.c_double(t0), ctypes.c_double(t1), b""
-                )
-            rc = lib.slate_trace_write_svg(h, path.encode(), ctypes.c_double(scale))
-        finally:
-            lib.slate_trace_free(h)
+            from ..obs.perfetto import write_chrome_trace
+
+            write_chrome_trace(json_path, spans=[], legacy_events=cls._events)
+        except Exception:
+            return None
         cls._events = []
-        return path if rc == 0 else None
+        return json_path
 
 
 _writer = None
@@ -116,7 +136,8 @@ def _load_writer():
 @contextmanager
 def block(name: str, lane: int = 0):
     """trace::Block RAII analogue: times the region when tracing is on and
-    always accumulates into the named-timer map."""
+    always accumulates into the named-timer map (and, with observability
+    enabled, into the obs metrics registry as a first-class metric)."""
     t0 = time.perf_counter()
     try:
         yield
@@ -126,3 +147,15 @@ def block(name: str, lane: int = 0):
         if Trace.enabled():
             base = Trace._t0 or 0.0
             Trace.add(name, lane, t0 - base, t1 - base)
+        _obs_timer(name, t1 - t0)
+
+
+def _obs_timer(name: str, dt: float) -> None:
+    """Absorb a named-timer sample into the obs metrics registry; no-op
+    while observability is off (or during early partial imports)."""
+    try:
+        from ..obs import REGISTRY, enabled
+    except Exception:  # pragma: no cover - partial package import
+        return
+    if enabled():
+        REGISTRY.counter_add("timer_seconds", dt, timer=name)

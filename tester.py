@@ -343,8 +343,13 @@ def main(argv=None):
                     help="also run scipy/LAPACK and report the comparison "
                          "(reference tester's ScaLAPACK ref mode)")
     ap.add_argument("--trace", default="",
-                    help="write an SVG timeline of the sweep via "
-                         "slate_tpu.utils.trace to this path")
+                    help="write a timeline of the sweep via "
+                         "slate_tpu.utils.trace to this path (SVG, or "
+                         "Chrome-trace/Perfetto JSON for a .json path)")
+    ap.add_argument("--report", default="",
+                    help="write a slate_tpu.obs RunReport JSON of the sweep "
+                         "(also enables observability: driver spans + comm "
+                         "bytes ride along)")
     args = ap.parse_args(argv)
 
     import jax
@@ -360,6 +365,11 @@ def main(argv=None):
 
         Trace.on()
         tracer = Trace
+    if args.report:
+        from slate_tpu import obs
+
+        obs.enable()
+    report_values = {}
     hdr = (f"{'routine':<10} {'type':<4} {'n':>7} {'error':>10} {'status':>6} "
            f"{'time(s)':>9} {'gflops':>10}")
     print(hdr + ("  ref_diff" if args.ref == "y" else ""))
@@ -408,12 +418,29 @@ def main(argv=None):
                     refcol = "  " + _ref_compare(routine, n, dtype, args.seed)
                 status = "pass" if ok else "FAILED"
                 failures += 0 if ok else 1
+                key = f"{rname.replace('@', '_').replace(':', '_')}_{prefix}_n{n}"
+                report_values[f"{key}_gflops"] = round(gflops, 2)
+                report_values[f"{key}_seconds"] = round(t, 6)
                 print(f"{rname:<10} {prefix:<4} {n:>7} {err:>10.2e} {status:>6} "
                       f"{t:>9.4f} {gflops:>10.1f}{refcol}")
     if tracer is not None:
         out = tracer.finish(args.trace)
         tracer.off()
         print(f"trace written to {out}")
+    if args.report:
+        import os
+
+        from slate_tpu.obs.report import write_report
+
+        d = os.path.dirname(os.path.abspath(args.report))
+        os.makedirs(d, exist_ok=True)
+        write_report(
+            args.report, name="tester",
+            config={"routines": ",".join(args.routines), "dim": args.dim,
+                    "type": args.type, "grid": args.grid or "single"},
+            values=report_values,
+        )
+        print(f"report written to {args.report}")
     return 1 if failures else 0
 
 

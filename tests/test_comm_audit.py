@@ -111,3 +111,41 @@ def test_summarize_ring_estimates():
     expect = 2 * 100 * (p - 1) / p * 2 + 50 * (q - 1) + 80 * (q - 1) / q * 3
     assert np.isclose(recv, expect)
     assert set(by_op) == {"psum", "all_gather", "psum_scatter"}
+
+
+def test_summa_payload_matches_analytic_bcast_volume():
+    """ISSUE 2 satellite: prove the comm_audit counters against the
+    closed-form SUMMA communication volume, not just exercise them.
+
+    C-stationary SUMMA broadcasts, per k-step and per device, its A
+    tile-column (mtl tiles) along mesh axis 'q' and its B tile-row (ntl
+    tiles) along 'p' — each as one masked psum of nb x nb tiles — under
+    ``audit_scope(kt)``.  The audited per-device payload must therefore
+    equal kt * (mtl + ntl) * nb^2 * itemsize EXACTLY, as two psum
+    records with multiplicity kt."""
+    import jax.numpy as jnp
+
+    from slate_tpu.parallel import from_dense, gemm_summa, make_mesh
+    from slate_tpu.types import MethodGemm
+
+    p, q, n, nb = 2, 4, 64, 8
+    mesh = make_mesh(p, q, devices=jax.devices("cpu")[:8])
+    rng = np.random.default_rng(0)
+    a = from_dense(jnp.asarray(rng.standard_normal((n, n)), jnp.float32),
+                   mesh, nb)
+    b = from_dense(jnp.asarray(rng.standard_normal((n, n)), jnp.float32),
+                   mesh, nb)
+    jax.clear_caches()  # counters record at trace time only
+    with comm_audit() as recs:
+        gemm_summa(1.0, a, b, method=MethodGemm.GemmC).tiles.block_until_ready()
+
+    kt, mtl, ntl = a.nt, a.mt // p, b.nt // q
+    itemsize = 4  # f32
+    expect_total = kt * (mtl + ntl) * nb * nb * itemsize
+    assert sum(nbytes * m for _, nbytes, m in recs) == expect_total
+
+    by_op = {op: (nbytes, m) for op, nbytes, m in recs}
+    assert set(by_op) == {"psum[p]", "psum[q]"}
+    # A column panel rides axis 'q' (bcast_from_col), B row panel axis 'p'
+    assert by_op["psum[q]"] == (mtl * nb * nb * itemsize, kt)
+    assert by_op["psum[p]"] == (ntl * nb * nb * itemsize, kt)

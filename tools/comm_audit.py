@@ -1,22 +1,16 @@
 #!/usr/bin/env python3
-"""Collective-volume audit for the distributed kernels (VERDICT r4 item 7).
+"""Thin compatibility shim: the collective-volume audit now lives in
+``slate_tpu.obs.comm_audit`` (ISSUE 2 — one audit entry point inside the
+observability subsystem).  This wrapper keeps the historical CLI
 
-Runs gemm_summa / potrf_dist / getrf_pp_dist on the forced 8-device CPU
-mesh with the trace-time byte counters in parallel.comm active, and writes
-``artifacts/comm_audit.md``: per-driver payload bytes, estimated received
-bytes per device (ring-lowering formulas), collective call counts (the
-latency story), and the ratio to the 2D communication lower-bound scale
-n^2 * itemsize / sqrt(P) (Irony-Toledo-Tiskin for gemm; same scale governs
-dense factorizations).  This makes the weak-scaling claim (BASELINE config
-#3) falsifiable without a pod: the measured volumes are what would ride
-ICI, and their n^2/sqrt(P) scaling is the whole 2D story.
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/comm_audit.py [--n 256] [--nb 16] [--report R.json]
 
-Usage:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-            python tools/comm_audit.py [--n 256] [--nb 16]
+and pins the virtual-mesh environment before JAX initializes a backend
+(which a ``python -m slate_tpu.obs.comm_audit`` invocation cannot do,
+since importing the package may already touch JAX).
 """
 
-import argparse
-import math
 import os
 import sys
 
@@ -25,194 +19,12 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
-import numpy as np  # noqa: E402
-
-
-def summarize(records, p, q):
-    """(payload_bytes_total, received_bytes_total, n_calls) per device.
-
-    Ring-lowering receive estimates per executed collective with local
-    payload B over an axis of size s: psum (all-reduce) ~ 2 B (s-1)/s,
-    psum_scatter (reduce-scatter, TrsmA's epilogue) ~ B (s-1)/s,
-    all_gather ~ B (s-1).
-    """
-    payload = recv = calls = 0
-    by_op = {}
-    for op, nbytes, mult in records:
-        if "[p]" in op:
-            s = p
-        elif "[q]" in op:
-            s = q
-        else:  # tuple axis, e.g. psum[('p', 'q')] (chase_apply streaming)
-            s = p * q
-        if op.startswith("psum_scatter"):
-            r = nbytes * (s - 1) / s
-        elif op.startswith("psum"):
-            r = 2 * nbytes * (s - 1) / s
-        else:  # all_gather
-            r = nbytes * (s - 1)
-        payload += nbytes * mult
-        recv += r * mult
-        calls += mult
-        agg = by_op.setdefault(op.split("[")[0], [0, 0])
-        agg[0] += nbytes * mult
-        agg[1] += mult
-    return payload, recv, calls, by_op
-
-
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--n", type=int, default=256)
-    ap.add_argument("--nb", type=int, default=16)
-    ap.add_argument("--out", default=os.path.join(
-        os.path.dirname(__file__), "..", "artifacts", "comm_audit.md"))
-    args = ap.parse_args()
-
-    from slate_tpu.parallel import (
-        from_dense, gemm_summa, getrf_pp_dist, make_mesh, potrf_dist,
-    )
-    from slate_tpu.parallel.comm import comm_audit
-    from slate_tpu.types import MethodGemm
-
-    devs = jax.devices("cpu")[:8]
-    mesh = make_mesh(2, 4, devices=devs)
-    p, q = 2, 4
-    n, nb = args.n, args.nb
-    itemsize = 4  # f32
-    rng = np.random.default_rng(0)
-    a = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32))
-    spd = jnp.asarray((np.asarray(a) @ np.asarray(a).T / n
-                       + 2 * np.eye(n)).astype(np.float32))
-
-    rows = []
-    lb = n * n * itemsize / math.sqrt(p * q)  # 2D lower-bound scale/device
-
-    def run(name, fn, flops):
-        jax.clear_caches()  # audit hooks record at trace time only
-        with comm_audit() as recs:
-            fn()
-        payload, recv, calls, by_op = summarize(recs, p, q)
-        rows.append((name, payload, recv, calls, by_op, flops))
-
-    from slate_tpu.parallel import heev_mesh, trsm_dist
-    from slate_tpu.parallel.dist_blas3 import hemm_summa
-    from slate_tpu.parallel.dist_chol import pbtrf_band_dist
-    from slate_tpu.parallel.dist_lu import gbtrf_band_dist
-    from slate_tpu.types import MethodHemm, MethodTrsm, Op, Side, Uplo
-
-    nrhs = max(nb, n // 16)  # thin RHS: the stationary-A regime
-    b_thin = jnp.asarray(rng.standard_normal((n, nrhs)).astype(np.float32))
-
-    ad = from_dense(a, mesh, nb)
-    bd = from_dense(a, mesh, nb)
-    run("gemm_summa (C-stationary)",
-        lambda: gemm_summa(1.0, ad, bd, method=MethodGemm.GemmC).tiles.block_until_ready(),
-        2 * n**3)
-    btd = from_dense(b_thin, mesh, nb)
-    run("gemm_summa (A-stationary, thin C)",
-        lambda: gemm_summa(1.0, ad, btd, method=MethodGemm.GemmA).tiles.block_until_ready(),
-        2 * n**2 * nrhs)
-    sd = from_dense(spd, mesh, nb, diag_pad_one=True)
-    run("potrf_dist", lambda: potrf_dist(sd)[0].tiles.block_until_ready(),
-        n**3 / 3)
-    gd = from_dense(a, mesh, nb, diag_pad_one=True)
-    run("getrf_pp_dist", lambda: getrf_pp_dist(gd)[0].tiles.block_until_ready(),
-        2 * n**3 / 3)
-    # stationary-A solves/multiplies (VERDICT r5 item 7): thin B
-    tlow = jnp.asarray((np.tril(np.asarray(a)) + n * np.eye(n)).astype(np.float32))
-    td = from_dense(tlow, mesh, nb, diag_pad_one=True)
-    run("trsm_dist TrsmA (NoTrans, thin B)",
-        lambda: trsm_dist(td, btd, Uplo.Lower, Op.NoTrans,
-                          method=MethodTrsm.TrsmA).tiles.block_until_ready(),
-        n**2 * nrhs)
-    run("trsm_dist TrsmA (Trans, thin B)",
-        lambda: trsm_dist(td, btd, Uplo.Lower, Op.Trans,
-                          method=MethodTrsm.TrsmA).tiles.block_until_ready(),
-        n**2 * nrhs)
-    hd = from_dense(spd, mesh, nb)
-    run("hemm_summa HemmA (thin B)",
-        lambda: hemm_summa(Side.Left, 1.0, hd, btd, uplo=Uplo.Lower,
-                           conj=False, method=MethodHemm.HemmA).tiles.block_until_ready(),
-        2 * n**2 * nrhs)
-    # band kernels at band cost (VERDICT r5 item 8)
-    kd = 2 * nb
-    iv = np.arange(n)
-    bmask = np.abs(np.subtract.outer(iv, iv)) <= kd
-    spd_band = jnp.asarray(np.where(bmask, np.asarray(spd), 0).astype(np.float32)
-                           + kd * np.eye(n, dtype=np.float32))
-    sbd = from_dense(spd_band, mesh, nb, diag_pad_one=True)
-    run(f"pbtrf_band_dist (kd={kd})",
-        lambda: pbtrf_band_dist(sbd, kd)[0].tiles.block_until_ready(),
-        n * kd * kd)
-    gb = jnp.asarray(np.where(bmask, np.asarray(a), 0).astype(np.float32)
-                     + kd * np.eye(n, dtype=np.float32))
-    gbd = from_dense(gb, mesh, nb, diag_pad_one=True)
-    run(f"gbtrf_band_dist (kl=ku={kd})",
-        lambda: gbtrf_band_dist(gbd, kd, kd)[0].tiles.block_until_ready(),
-        2 * n * kd * 2 * kd)
-    # the full distributed eig chain (VERDICT r5 item 7): he2hb + band
-    # gather + sharded stedc + streamed chase + stage-1 back-transform
-    heig = jnp.asarray(((np.asarray(a) + np.asarray(a).T) / 2).astype(np.float32))
-    run("heev_mesh (vectors, full chain)",
-        lambda: jax.block_until_ready(heev_mesh(heig, mesh, nb=nb)[1]),
-        4 * n**3 / 3)
-
-    lines = [
-        "# Collective-volume audit (8-device CPU mesh, trace-time byte counters)",
-        "",
-        f"Config: n={n}, nb={nb}, grid {p}x{q}, f32.  Counters live in "
-        "`slate_tpu/parallel/comm.py` (`comm_audit`); kernels declare loop "
-        "trip counts via `audit_scope`.  Received-bytes estimates use ring "
-        "lowerings: psum ~ 2B(s-1)/s, all_gather ~ B(s-1) per device.",
-        "",
-        f"2D lower-bound scale per device: n^2 * 4B / sqrt(P) = {lb:,.0f} B.",
-        "",
-        "| driver | payload B/dev | est. received B/dev | collective execs | recv / (n^2/sqrt(P)) | bytes/flop |",
-        "|---|---|---|---|---|---|",
-    ]
-    for name, payload, recv, calls, by_op, flops in rows:
-        lines.append(
-            f"| {name} | {payload:,.0f} | {recv:,.0f} | {calls:,} | "
-            f"{recv / lb:.2f} | {recv / flops:.4f} |"
-        )
-    lines += [
-        "",
-        "Per-op breakdown (payload bytes x executions):",
-        "",
-    ]
-    for name, _, _, _, by_op, _ in rows:
-        det = ", ".join(f"{op}: {v[0]:,}B / {v[1]:,}x" for op, v in sorted(by_op.items()))
-        lines.append(f"- **{name}**: {det}")
-    lines += [
-        "",
-        "Reading the table: SUMMA's received volume is ~2 n^2/sqrt(P) per",
-        "device (the classic 2D algorithm, a factor 2 of the lower bound);",
-        "the factorizations sit at the same n^2-class scale, so doubling n",
-        "at 4x the devices holds received-bytes/device constant — the 2D",
-        "weak-scaling invariant (BASELINE config #3).  The `collective",
-        "execs` column is the latency story: getrf's per-column pivot",
-        "all_gathers dominate call counts at O(n) tiny messages, the",
-        "documented cost of partial pivoting (reference: per-column",
-        "MPI exchanges in Tile_getrf.hh / internal_swap.cc).",
-        "",
-        "Stationary-A rows (trsmA / gemmA / hemmA, thin B): received",
-        "volume is B/C-sized, far below the n^2-class stationary-C rows —",
-        "A never moves, the stationary-A win (src/trsmA.cc, hemmA.cc).",
-        "Band rows: volumes collapse to the O(n k)-class window traffic",
-        "(tiles outside the band are never communicated).  The heev_mesh",
-        "row audits the whole distributed eig chain — he2hb two-sided",
-        "updates, band gather, sharded stedc merges, the streamed chase",
-        "back-transform (psum over both axes), and unmtr_he2hb.",
-    ]
-    out = os.path.abspath(args.out)
-    os.makedirs(os.path.dirname(out), exist_ok=True)
-    with open(out, "w") as f:
-        f.write("\n".join(lines) + "\n")
-    print("\n".join(lines))
-    print(f"\nwrote {out}")
-
+from slate_tpu.obs.comm_audit import (  # noqa: E402,F401  (re-exported API)
+    main,
+    render,
+    run_audit,
+    summarize,
+)
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

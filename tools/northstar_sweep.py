@@ -401,6 +401,30 @@ def main():
                 f, indent=1,
             )
     print(f"wrote {out}")
+    _emit_obs_report(root, out, results)
+
+
+def _emit_obs_report(root, out, results):
+    """RunReport twin of the sweep file (slate_tpu.obs): schema-versioned,
+    diffable against any prior sweep with
+    ``python -m slate_tpu.obs.report --check`` (which also reads the
+    legacy SWEEP_*.json shape directly)."""
+    try:
+        sys.path.insert(0, root)
+        from slate_tpu.obs.report import write_report
+
+        values = {
+            f"{r['routine']}_n{r['n']}_gflops": float(r["gflops"])
+            for r in results
+            if r.get("ok") and isinstance(r.get("gflops"), (int, float))
+        }
+        rpath = out[:-5] + ".report.json" if out.endswith(".json") else out + ".report.json"
+        write_report(rpath, name="northstar_sweep",
+                     config={"chip": "TPU v5e (1 chip, via tunnel)"},
+                     values=values)
+        print(f"wrote {rpath}")
+    except Exception as e:  # sweep results must never die on obs
+        print(f"obs report failed: {e!r}")
 
 
 if __name__ == "__main__":
