@@ -48,5 +48,9 @@ else
 fi
 
 # ---- dynamic suites -----------------------------------------------------
+# tests/ includes test_lookahead.py in the default tier: the Option.Lookahead
+# pipelined schedules must stay BITWISE identical to the strict depth-0
+# schedule on the 8-device mesh, and the comm-audit byte totals must be
+# depth-invariant (lookahead moves when bytes travel, never how many).
 python -m pytest tests/ -q
 python examples/run_tests.py

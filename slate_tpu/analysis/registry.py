@@ -379,6 +379,73 @@ def _don_finale(ctx):
 
 
 # ---------------------------------------------------------------------------
+# lookahead variants (ISSUE 3): the pipelined schedules under the gate.
+# The default entries above already trace depth 1 (the Option.Lookahead
+# default); these pin the strict depth-0 schedule and a deeper prefetch so
+# both ends of the pipeline stay lint-green (axis names, audit coverage,
+# HIGHEST dots on the narrow/bulk einsum splits).
+# ---------------------------------------------------------------------------
+
+
+@register("gemm_summa_la0", tags=("lookahead",))
+def _gemm_la0(ctx):
+    from ..parallel.summa import gemm_summa
+    from ..types import MethodGemm
+
+    a, b = ctx.dist(), ctx.dist()
+    return (
+        lambda x, y: gemm_summa(1.0, x, y, method=MethodGemm.GemmC, lookahead=0)
+    ), (a, b)
+
+
+@register("gemm_summa_la2", tags=("lookahead",))
+def _gemm_la2(ctx):
+    from ..parallel.summa import gemm_summa
+    from ..types import MethodGemm
+
+    a, b = ctx.dist(), ctx.dist()
+    return (
+        lambda x, y: gemm_summa(1.0, x, y, method=MethodGemm.GemmC, lookahead=2)
+    ), (a, b)
+
+
+@register("potrf_dist_la0", tags=("lookahead",))
+def _potrf_la0(ctx):
+    from ..parallel.dist_chol import potrf_dist
+
+    a = ctx.dist(kind="spd", diag_pad=True)
+    return (lambda x: potrf_dist(x, lookahead=0)), (a,)
+
+
+@register("trsm_dist_la2", tags=("lookahead",))
+def _trsm_la2(ctx):
+    from ..parallel.dist_trsm import trsm_dist
+    from ..types import Op, Uplo
+
+    a = ctx.dist(kind="tril", diag_pad=True)
+    b = ctx.dist_thin()
+    return (
+        lambda x, y: trsm_dist(x, y, Uplo.Lower, Op.NoTrans, lookahead=2)
+    ), (a, b)
+
+
+@register("getrf_nopiv_dist_la0", tags=("lookahead",))
+def _getrf_nopiv_la0(ctx):
+    from ..parallel.dist_lu import getrf_nopiv_dist
+
+    a = ctx.dist(kind="tril", diag_pad=True)
+    return (lambda x: getrf_nopiv_dist(x, lookahead=0)), (a,)
+
+
+@register("getrf_pp_dist_la0", tags=("lookahead",))
+def _getrf_pp_la0(ctx):
+    from ..parallel.dist_lu import getrf_pp_dist
+
+    a = ctx.dist(diag_pad=True)
+    return (lambda x: getrf_pp_dist(x, lookahead=0)), (a,)
+
+
+# ---------------------------------------------------------------------------
 # observability wrappers (ISSUE 2): the same kernels traced WITH obs on
 # ---------------------------------------------------------------------------
 

@@ -27,7 +27,10 @@ ArrayLike = Union[Array, BaseMatrix]
 def multiply(alpha, a: ArrayLike, b: ArrayLike, beta=0.0, c: Optional[ArrayLike] = None,
              opts: Optional[Options] = None):
     """C = alpha A B + beta C (slate::multiply -> gemm).  Option.Precision
-    in ``opts`` selects the accumulation tier (types.Precision)."""
+    in ``opts`` selects the accumulation tier (types.Precision);
+    Option.Lookahead is accepted here and consumed by the explicitly
+    sharded mesh drivers (parallel.drivers / parallel.summa) — XLA's
+    partitioner schedules the single-array form on its own."""
     if c is None:
         am, bm = blas3._arr(a), blas3._arr(b)
         c = jnp.zeros((am.shape[0], bm.shape[1]), am.dtype)
@@ -65,9 +68,11 @@ def rank_2k_update(alpha, a: ArrayLike, b: ArrayLike, beta, c: ArrayLike, uplo=N
     return blas3.her2k(alpha, a, b, beta, c, uplo, opts=opts)
 
 
-def triangular_solve(side: Side, alpha, a: ArrayLike, b: ArrayLike):
-    """slate::triangular_solve -> trsm."""
-    return blas3.trsm(side, alpha, a, b)
+def triangular_solve(side: Side, alpha, a: ArrayLike, b: ArrayLike,
+                     opts: Optional[Options] = None):
+    """slate::triangular_solve -> trsm.  ``opts`` rides through (e.g.
+    Option.Lookahead, consumed by the mesh schedules in parallel/)."""
+    return blas3.trsm(side, alpha, a, b, opts=opts)
 
 
 # -- LU (lu_factor / lu_solve / lu_solve_using_factor / lu_inverse) ----------

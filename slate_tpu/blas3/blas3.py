@@ -321,8 +321,12 @@ def trsm_array(
     return _trsm_left_lower_notrans(a, b, diag)
 
 
-def trsm(side: Side, alpha, a: ArrayLike, b: ArrayLike):
-    """slate::trsm driver over matrix views."""
+def trsm(side: Side, alpha, a: ArrayLike, b: ArrayLike,
+         opts: Optional[Options] = None):
+    """slate::trsm driver over matrix views.  ``opts`` is accepted for
+    option symmetry with the other drivers; Option.Lookahead is a mesh
+    scheduling knob (parallel.dist_trsm consumes it) — the single-chip
+    recursive solve has no broadcast loop to pipeline."""
     am = a if isinstance(a, BaseMatrix) else TriangularMatrix.from_array(a, Uplo.Lower)
     out = trsm_array(side, am.uplo, am.op, am.diag, alpha, am.data, _arr(b))
     return _wrap_like(b, out)

@@ -442,6 +442,19 @@ def potrf_array(a: jax.Array, uplo: Uplo = Uplo.Lower) -> Tuple[jax.Array, jax.A
             and _tpu_is_default()
         ):
             l = _potrf_ll_ozaki(full)
+        elif a.shape[0] > _POTRF_OZCACHE_MAX_N and not isinstance(
+            full, jax.core.Tracer
+        ):
+            # ADVICE r5: the fused left-looking form keeps ~5 live copies
+            # of the matrix (XLA buffer assignment across the unrolled
+            # panel chain) and OOMs v5e at n = 32768; the staged variant
+            # dispatches one donated program per panel, capping peak HBM
+            # at one matrix + panel transients.  Staged dispatch is eager
+            # only — under an outer jit the stages would inline and the
+            # fused-liveness problem returns, so tracers keep the fused
+            # form.  ``full`` is the symmetrize intermediate owned here,
+            # so donating it never touches the caller's array.
+            l = potrf_left_looking_staged(full, donate=True)
         else:
             l = _potrf_left_looking(full)
     elif a.shape[0] > _POTRF_SCAN_MIN_N:

@@ -492,6 +492,12 @@ _APPLY_SEG_SWEEPS = 4096
 _APPLY_REF_AREA = 8192 * 8192
 _APPLY_MIN_BLOCK = 256  # dispatch-overhead floor
 
+# module-level jit: a fresh ``jax.jit(_chase_sweep_apply, ...)`` wrapper
+# per call owns a fresh cache, so every _chase_apply_staged invocation
+# re-traced (and re-compiled on cache-miss backends) even for identical
+# shapes — ADVICE r5.  One shared wrapper makes repeat applies cache hits.
+_chase_sweep_apply_jit = jax.jit(_chase_sweep_apply, static_argnums=(3, 4, 5))
+
 
 def _chase_apply_staged(vs, taus, z, n: int, w: int, adjoint: bool) -> Array:
     """Apply a bulge-chase reflector family to Z in SWEEP-BLOCK programs
@@ -509,16 +515,13 @@ def _chase_apply_staged(vs, taus, z, n: int, w: int, adjoint: bool) -> Array:
     )
     nseg = max(1, -(-nsweeps // per_block))
     if nseg == 1:
-        return jax.jit(_chase_sweep_apply, static_argnums=(3, 4, 5))(
-            vs, taus, z, n, w, adjoint
-        )
+        return _chase_sweep_apply_jit(vs, taus, z, n, w, adjoint)
     # equal-size blocks within 1 (at most two distinct compiled shapes)
     bounds = [nsweeps * i // nseg for i in range(nseg)] + [nsweeps]
     order = range(nseg) if adjoint else range(nseg - 1, -1, -1)
-    apply = jax.jit(_chase_sweep_apply, static_argnums=(3, 4, 5))
     for i in order:
         b0, b1 = bounds[i], bounds[i + 1]
-        z = apply(vs[b0:b1], taus[b0:b1], z, n, w, adjoint, b0)
+        z = _chase_sweep_apply_jit(vs[b0:b1], taus[b0:b1], z, n, w, adjoint, b0)
     return z
 
 

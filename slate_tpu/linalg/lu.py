@@ -686,7 +686,14 @@ def getrf(a: ArrayLike, opts: Optional[Options] = None) -> Tuple[Matrix, LUFacto
         # multiplier: wider panels amortize per-step latency against
         # bigger trailing updates, the same trade the reference makes by
         # adding panel threads (PartialPiv/NoPiv panels are recursive and
-        # take no width knob).  Clamped to 8x: past ~512-wide panels the
+        # take no width knob).  NUMERICAL SIDE EFFECT — unlike the
+        # reference, where the option is parallelism-only and bitwise
+        # neutral, here it changes the CALU tournament width and hence
+        # WHICH pivots win: a wider panel factors more columns without
+        # interchanges between tournament rounds, so pivot quality (and
+        # the element growth bound) degrades as the width grows.  Results
+        # remain backward-stable in the CALU sense but are NOT invariant
+        # under this option.  Clamped to 8x: past ~512-wide panels the
         # tournament factors without interchanges over too many columns
         # (pivot-growth risk) and the block LUs blow up compile time.
         threads = int(get_option(opts, Option.MaxPanelThreads, 1))

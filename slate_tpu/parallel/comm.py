@@ -207,6 +207,133 @@ def route_to_block_cyclic_rows(
     return psum_scatter_a(out, ROW_AXIS, scatter_dimension=0, tiled=False)
 
 
+# ---------------------------------------------------------------------------
+# Lookahead pipelining (Option.Lookahead; SURVEY §2.5 P3).  The reference
+# overlaps each step's panel broadcast with the previous step's trailing
+# update via lookahead task queues (gemmC.cc:147-176, potrf.cc:129-133).
+# Inside one lax.fori_loop the carry serializes iterations, so XLA cannot
+# overlap step k+1's collective with step k's einsum on its own: the
+# kernels below restructure the loop so the independent work lives in the
+# SAME iteration body, where the latency-hiding scheduler can interleave
+# it.  Two carry patterns cover every mesh k-loop:
+#
+# * ``prefetch_bcast`` — read-only operands (SUMMA-class accumulation
+#   loops, trsm's A panels): broadcast step k+d's panel while step k's
+#   buffered panel feeds the MXU.  Arbitrary depth d (a d-deep FIFO).
+# * ``pipelined_factor_loop`` — factorizations (potrf/LU), where panel
+#   k+1 depends on update k: defer each step's trailing update into the
+#   next iteration, refresh only the row/column the next panel reads
+#   (``narrow``), issue the panel broadcasts, then apply the bulk of the
+#   deferred update (``bulk``) — the broadcast and the big einsum are
+#   independent.  Effective depth caps at 1: panel k+2 reads column k+1,
+#   which needs update k applied first, so deeper prefetch has no legal
+#   reorder.
+#
+# Both patterns reorder ONLY independent work: every element receives
+# exactly the same arithmetic in the same per-element order, so results
+# are bitwise-identical to the strict schedule at any depth (enforced by
+# tests/test_lookahead.py), and total audited comm bytes are unchanged —
+# lookahead moves WHEN bytes move, never how many.
+# ---------------------------------------------------------------------------
+
+
+def la_depth(lookahead, nt: int) -> int:
+    """Resolve an Option.Lookahead value to a usable pipeline depth:
+    ``None`` means the option default (1, the reference's default
+    lookahead), clamped to [0, nt]."""
+    if lookahead is None:
+        from ..types import Option, get_option
+
+        lookahead = get_option(None, Option.Lookahead)
+    return max(0, min(int(lookahead), int(nt)))
+
+
+def prefetch_bcast(nt: int, depth: int, fetch, consume, state):
+    """Software-pipelined k-loop over READ-ONLY panel broadcasts.
+
+    ``fetch(k)`` builds step k's panel pytree purely from loop-invariant
+    operands (masked-psum broadcasts / gathers of stationary tiles);
+    ``consume(k, panel, state)`` performs step k's update (and any
+    serial-chain collectives of its own).  Depth 0 reproduces the strict
+    broadcast→update schedule exactly.  Depth d >= 1 double-buffers:
+    a d-deep FIFO of prefetched panels is filled before the loop, each
+    iteration issues fetch(k + d) BEFORE consume(k, fifo head) so the
+    broadcast for a future step is independent of — and overlappable
+    with — the current trailing update, and the last d panels drain
+    after the loop.  Total broadcast count (and audited bytes) is
+    unchanged: d prologue + (nt - d) in-loop fetches = nt.
+    """
+    d = max(0, min(int(depth), int(nt)))
+    if d == 0:
+        def body(k, st):
+            return consume(k, fetch(k), st)
+
+        with audit_scope(nt):
+            return lax.fori_loop(0, nt, body, state)
+
+    # prologue: fill the FIFO with panels 0..d-1 (each audited once)
+    buf = jax.tree.map(lambda *xs: jnp.stack(xs), *[fetch(k) for k in range(d)])
+
+    def body(k, carry):
+        st, fifo = carry
+        head = jax.tree.map(lambda b: b[0], fifo)
+        nxt = fetch(k + d)  # issued before the update consumes the head
+        fifo = jax.tree.map(
+            lambda b, nx: jnp.concatenate([b[1:], nx[None]]), fifo, nxt
+        )
+        st = consume(k, head, st)
+        return st, fifo
+
+    with audit_scope(nt - d):
+        state, buf = lax.fori_loop(0, nt - d, body, (state, buf))
+    for i in range(d):  # epilogue: drain the FIFO (no fetches left)
+        state = consume(nt - d + i, jax.tree.map(lambda b: b[i], buf), state)
+    return state
+
+
+def pipelined_factor_loop(k0, k1, depth, panel, narrow, bulk, state, zero_payload):
+    """Deferred-trailing-update pipelining for factorization k-loops.
+
+    ``panel(k, state) -> (state, payload)``: diag-tile factor + panel
+    solves + panel broadcasts of step k; must read only the local tile
+    slots ``narrow`` has refreshed (the logical row/column k slots).
+    ``narrow(k, state, payload)``: apply the carried step-(k-1) trailing
+    update to exactly those slots.
+    ``bulk(k, state, payload)``: apply the carried update everywhere
+    ``narrow`` did not (``k=None``: everywhere — the strict form and the
+    post-loop drain).
+
+    Depth 0 is the strict schedule (panel, then full update, per step).
+    Depth >= 1 carries each step's update payload into the next
+    iteration: the body runs narrow → panel → bulk, so step k's panel
+    broadcasts are issued between two halves of step k-1's update and
+    are data-independent of the bulk einsum — the overlap window.  The
+    first iteration consumes ``zero_payload`` (subtracting exact zeros,
+    bitwise identity) and the last payload drains after the loop.
+    """
+    n = int(k1) - int(k0)
+    if n <= 0:
+        return state
+    if int(depth) <= 0:
+        def body(k, st):
+            st, pl = panel(k, st)
+            return bulk(None, st, pl)
+
+        with audit_scope(n):
+            return lax.fori_loop(k0, k1, body, state)
+
+    def body(k, carry):
+        st, pl = carry
+        st = narrow(k, st, pl)
+        st, pl_new = panel(k, st)
+        st = bulk(k, st, pl)
+        return st, pl_new
+
+    with audit_scope(n):
+        state, pl_last = lax.fori_loop(k0, k1, body, (state, zero_payload))
+    return bulk(None, state, pl_last)
+
+
 def bucket_plan(nt: int, p: int, q: int, nbuckets: int = BUCKETS):
     """Static trailing-update segmentation shared by the bucketed
     factorization kernels: yields (k0, k1, s0r, s0c) per bucket, where

@@ -33,10 +33,11 @@ from ..types import Diag, Op, Uplo
 from .comm import (
     PRECISE,
     all_gather_a,
-    audit_scope,
     bcast_from_col,
     bcast_from_row,
+    la_depth,
     local_indices,
+    prefetch_bcast,
     shard_map_compat,
 )
 from .dist import DistMatrix
@@ -149,6 +150,7 @@ def hemm_summa(
     uplo: Uplo = Uplo.Lower,
     conj: bool = True,
     method=None,
+    lookahead: Optional[int] = None,
 ) -> DistMatrix:
     """C := alpha A B + beta C with A Hermitian (conj=True, src/hemm.cc) or
     symmetric (conj=False, src/symm.cc), A referenced through its ``uplo``
@@ -159,7 +161,11 @@ def hemm_summa(
     ``method`` selects the stationary operand (slate::hemm's MethodHemm):
     HemmC is the k-loop broadcast pipeline (_hemm_jit); HemmA keeps A's
     stored triangle in place and reduces C (src/hemmA.cc) — the win when
-    B/C are panels far thinner than A.  None = auto-select by shape."""
+    B/C are panels far thinner than A.  None = auto-select by shape.
+
+    ``lookahead`` prefetches the HemmC k-loop's panels (both operands are
+    read-only) via ``comm.prefetch_bcast``; HemmA has no k-loop, so the
+    depth is accepted and ignored there."""
     from ..types import MethodHemm, Side, select_hemm_method
 
     p, q = mesh_shape(a.mesh)
@@ -172,7 +178,7 @@ def hemm_summa(
         al = jnp.conj(alpha) if conj else alpha
         be = jnp.conj(beta) if conj else beta
         prod_t = hemm_summa(Side.Left, al, a, bt_, be, ct_, uplo=uplo,
-                            conj=conj, method=method)
+                            conj=conj, method=method, lookahead=lookahead)
         return transpose_dist(prod_t, conj=conj)
     if b.grid != (p, q) or b.nb != a.nb or a.n != b.m:
         raise ValueError("hemm_summa operands must share mesh/nb and dims")
@@ -182,7 +188,8 @@ def hemm_summa(
     if method == MethodHemm.HemmA:
         out = _hemm_a_jit(a.tiles, b.tiles, ct, alpha, beta, a.mesh, p, q, uplo, conj)
     else:
-        out = _hemm_jit(a.tiles, b.tiles, ct, alpha, beta, a.mesh, p, q, a.nt, uplo, conj)
+        out = _hemm_jit(a.tiles, b.tiles, ct, alpha, beta, a.mesh, p, q, a.nt,
+                        uplo, conj, la_depth(lookahead, a.nt))
     return DistMatrix(tiles=out, m=a.m, n=b.n, nb=a.nb, mesh=a.mesh)
 
 
@@ -255,8 +262,8 @@ def _hemm_a_jit(at, bt, ct, alpha, beta, mesh, p, q, uplo, conj):
     return (alpha * prod + beta * ct).astype(at.dtype)
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10))
-def _hemm_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, uplo, conj):
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _hemm_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, uplo, conj, la=0):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(a_loc, b_loc):
@@ -265,16 +272,20 @@ def _hemm_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, uplo, conj):
         dtype = a_loc.dtype
         r, c_, i_log, j_log = local_indices(p, q, mtl, ntl)
 
-        def step(k, acc):
+        def fetch(k):
+            # both panels are pure functions of the stored tile stacks
             pan = _mirror_col_panel(a_loc, k, p, q, i_log, uplo, conj)
             brow_own = lax.dynamic_slice_in_dim(b_loc, k // p, 1, axis=0)[0]
             brow = bcast_from_row(brow_own, k % p)
+            return pan, brow
+
+        def consume(k, panels, acc):
+            pan, brow = panels
             upd = jnp.einsum("iab,jbc->ijac", pan, brow, precision=PRECISE)
             return acc + upd.astype(dtype)
 
         acc0 = jnp.zeros((mtl, ntl, nb, nb), dtype)
-        with audit_scope(kt):
-            return lax.fori_loop(0, kt, step, acc0)
+        return prefetch_bcast(kt, la, fetch, consume, acc0)
 
     prod = shard_map_compat(
         kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
@@ -293,12 +304,14 @@ def trmm_dist(
     alpha,
     a: DistMatrix,
     b: DistMatrix,
+    lookahead: Optional[int] = None,
 ) -> DistMatrix:
     """B := alpha op(A) B (Left) / alpha B op(A) (Right), A triangular
     (src/trmm.cc).  Left runs natively (SUMMA with the triangle mask and,
     for op != NoTrans, the mirrored row-panel build); Right reduces to Left
     by transposition, as the reference routes trsm variants through one
-    internal kernel (internal_trmm.cc)."""
+    internal kernel (internal_trmm.cc).  ``lookahead`` prefetches the
+    read-only per-step panels (comm.prefetch_bcast)."""
     from ..types import Side
 
     p, q = mesh_shape(a.mesh)
@@ -312,16 +325,18 @@ def trmm_dist(
             # B A^H = (A B^H)^H: conjugate via double transpose path
             bt_ = transpose_dist(b, conj=True)
             out_t = trmm_dist(Side.Left, uplo, Op.NoTrans, diag,
-                              jnp.conj(alpha), a, bt_)
+                              jnp.conj(alpha), a, bt_, lookahead=lookahead)
             return transpose_dist(out_t, conj=True)
-        out_t = trmm_dist(Side.Left, uplo, opt, diag, alpha, at_, bt_)
+        out_t = trmm_dist(Side.Left, uplo, opt, diag, alpha, at_, bt_,
+                          lookahead=lookahead)
         return transpose_dist(out_t)
-    out = _trmm_jit(a.tiles, b.tiles, alpha, a.mesh, p, q, a.nt, uplo, op, diag)
+    out = _trmm_jit(a.tiles, b.tiles, alpha, a.mesh, p, q, a.nt, uplo, op,
+                    diag, la_depth(lookahead, a.nt))
     return DistMatrix(tiles=out, m=a.m, n=b.n, nb=a.nb, mesh=a.mesh)
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _trmm_jit(at, bt, alpha, mesh, p, q, kt, uplo, op, diag):
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def _trmm_jit(at, bt, alpha, mesh, p, q, kt, uplo, op, diag, la=0):
     spec = P(ROW_AXIS, COL_AXIS)
     lower = uplo == Uplo.Lower
 
@@ -332,7 +347,7 @@ def _trmm_jit(at, bt, alpha, mesh, p, q, kt, uplo, op, diag):
         r, c_, i_log, j_log = local_indices(p, q, mtl, ntl)
         eye = jnp.eye(nb, dtype=dtype)
 
-        def step(k, acc):
+        def fetch(k):
             if op == Op.NoTrans:
                 acol_own = lax.dynamic_slice_in_dim(a_loc, k // q, 1, axis=1)[:, 0]
                 acol = bcast_from_col(acol_own, k % q)
@@ -360,12 +375,15 @@ def _trmm_jit(at, bt, alpha, mesh, p, q, kt, uplo, op, diag):
                 pan = jnp.where((i_log == k)[:, None, None], dtile, pan)
             brow_own = lax.dynamic_slice_in_dim(b_loc, k // p, 1, axis=0)[0]
             brow = bcast_from_row(brow_own, k % p)
+            return pan, brow
+
+        def consume(k, panels, acc):
+            pan, brow = panels
             upd = jnp.einsum("iab,jbc->ijac", pan, brow, precision=PRECISE)
             return acc + upd.astype(dtype)
 
         acc0 = jnp.zeros((mtl, ntl, nb, nb), dtype)
-        with audit_scope(kt):
-            return lax.fori_loop(0, kt, step, acc0)
+        return prefetch_bcast(kt, la, fetch, consume, acc0)
 
     prod = shard_map_compat(
         kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
@@ -383,11 +401,13 @@ def her2k_dist(
     uplo: Uplo = Uplo.Lower,
     conj: bool = True,
     full: bool = False,
+    lookahead: Optional[int] = None,
 ) -> DistMatrix:
     """C := alpha A B^H + conj(alpha) B A^H + beta C (conj=True,
     src/her2k.cc) or the ^T / plain-alpha variant (conj=False, syr2k).
     Same SUMMA-with-transposed-panel schedule as herk_dist, accumulated
-    twice per step."""
+    twice per step.  ``lookahead`` prefetches both operands' read-only
+    panels (comm.prefetch_bcast)."""
     p, q = mesh_shape(a.mesh)
     if b.grid != (p, q) or b.nb != a.nb or (a.m, a.n) != (b.m, b.n):
         raise ValueError("her2k_dist: A and B must be same-shape, same mesh")
@@ -395,18 +415,21 @@ def her2k_dist(
         raise ValueError("her2k_dist: C layout must match A B^H")
     ct = None if c is None else c.tiles
     out = _her2k_jit(a.tiles, b.tiles, ct, alpha, beta, a.mesh, p, q,
-                     a.nt, a.n, uplo, conj, full)
+                     a.nt, a.n, uplo, conj, full, la_depth(lookahead, a.nt))
     no_pad = a.mt * a.nb == a.m
     return DistMatrix(tiles=out, m=a.m, n=a.m, nb=a.nb, mesh=a.mesh, diag_pad=no_pad)
 
 
 @instrument("syr2k_dist")
-def syr2k_dist(alpha, a, b, beta=0.0, c=None, uplo: Uplo = Uplo.Lower, full=False):
-    return her2k_dist(alpha, a, b, beta, c, uplo, conj=False, full=full)
+def syr2k_dist(alpha, a, b, beta=0.0, c=None, uplo: Uplo = Uplo.Lower, full=False,
+               lookahead: Optional[int] = None):
+    return her2k_dist(alpha, a, b, beta, c, uplo, conj=False, full=full,
+                      lookahead=lookahead)
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
-def _her2k_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, k_true, uplo, conj, full):
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13))
+def _her2k_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, k_true, uplo, conj,
+               full, la=0):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(a_loc, b_loc):
@@ -425,9 +448,11 @@ def _her2k_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, k_true, uplo, conj, full
             panT = allpan[jc % p, jc // p]
             return xcol, (jnp.conj(panT) if conj else panT)
 
-        def step(k, acc):
-            acol, aT = panels(a_loc, k)
-            bcol, bT = panels(b_loc, k)
+        def fetch(k):
+            return panels(a_loc, k), panels(b_loc, k)
+
+        def consume(k, prefetched, acc):
+            (acol, aT), (bcol, bT) = prefetched
             u1 = jnp.einsum("iab,jcb->ijac", acol, bT, precision=PRECISE)
             u2 = jnp.einsum("iab,jcb->ijac", bcol, aT, precision=PRECISE)
             al2 = jnp.conj(alpha) if conj else alpha
@@ -435,8 +460,7 @@ def _her2k_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, k_true, uplo, conj, full
 
         ntl_c = -(-at.shape[0] // q)
         acc0 = jnp.zeros((mtl, ntl_c, nb, nb), dtype)
-        with audit_scope(kt):
-            acc = lax.fori_loop(0, kt, step, acc0)
+        acc = prefetch_bcast(kt, la, fetch, consume, acc0)
         if not full:
             jc = lax.axis_index(COL_AXIS) + jnp.arange(ntl_c) * q
             ii = i_log[:, None, None, None] * nb + jnp.arange(nb)[None, None, :, None]

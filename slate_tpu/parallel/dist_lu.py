@@ -50,32 +50,47 @@ from .comm import (
     bcast_from_col,
     bcast_from_row,
     bucket_plan,
+    la_depth,
     local_indices,
+    pipelined_factor_loop,
     psum_a,
     shard_map_compat,
 )
 
+from typing import Optional
+
 @instrument("getrf_nopiv_dist")
-def getrf_nopiv_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array]:
-    """Factor A = L U in place (packed LU tiles). Returns (LU, info)."""
+def getrf_nopiv_dist(
+    a: DistMatrix, lookahead: Optional[int] = None
+) -> Tuple[DistMatrix, jax.Array]:
+    """Factor A = L U in place (packed LU tiles). Returns (LU, info).
+
+    ``lookahead`` (Option.Lookahead; None = the option default, 1) defers
+    each step's trailing gemm into the next iteration so the panel
+    broadcasts overlap it (getrf_nopiv.cc's lookahead queues); results
+    are bitwise-identical at any depth."""
     p, q = mesh_shape(a.mesh)
     if a.mt != a.nt:
         raise ValueError("getrf_nopiv_dist needs a square tile grid")
     a.require_diag_pad("getrf_nopiv_dist")
-    lut, info = _lu_jit(a.tiles, a.mesh, p, q, a.nt)
+    lut, info = _lu_jit(a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt))
     return DistMatrix(
         tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True
     ), info
 
 
-def _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c, roff=0, coff=0, panel_done=False):
-    """One right-looking LU tile step (panel solves + bcasts + trailing
-    gemm) on the swapped/unswapped local stack.  Shared by the no-pivot
-    and tournament kernels; ``roff``/``coff`` shift tile indexing when
-    ``t_loc`` is a trailing view (bucketed caller).  ``panel_done`` skips
-    the diag-tile factor + column solve: the partial-pivot kernel factors
-    the whole panel column itself (internal_getrf.cc's role), leaving only
-    the row solve + trailing update here."""
+def _nopiv_panel(t_loc, k, p, q, i_log, j_log, r, c, roff=0, coff=0,
+                 panel_done=False):
+    """Panel phase of one right-looking LU tile step (diag factor + panel
+    solves + bcasts), shared by the no-pivot / tournament / partial-pivot
+    kernels; the trailing gemm is NOT applied — the (pan, urow) payload is
+    returned for the caller to schedule (immediately for the strict
+    schedule, deferred one step under lookahead).  ``roff``/``coff`` shift
+    tile indexing when ``t_loc`` is a trailing view (bucketed caller).
+    ``panel_done`` skips the diag-tile factor + column solve: the
+    partial-pivot kernel factors the whole panel column itself
+    (internal_getrf.cc's role), leaving only the row solve here.  Reads
+    only the logical row/column k tile slots."""
     nb = t_loc.shape[2]
     dtype = t_loc.dtype
     eye = jnp.eye(nb, dtype=dtype)
@@ -118,11 +133,64 @@ def _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c, roff=0, coff=0, panel_done=F
         t_loc, jnp.where(mine_r, newrow, prow)[None], kr, axis=0
     )
 
-    # broadcasts + trailing update (masked by the zeros in pan/prow)
+    # panel broadcasts (trailing masking rides the zeros in pan/urow)
     pan = bcast_from_col(jnp.where(below & mine_c, newcol, 0), k % q)
     urow = bcast_from_row(jnp.where(right & mine_r, newrow, 0), k % p)
-    upd = jnp.einsum("iab,jbc->ijac", pan, urow, precision=PRECISE)
-    return t_loc - upd.astype(dtype)
+    return t_loc, (pan, urow)
+
+
+def _nopiv_narrow(t_loc, payload, k, p, q, roff=0, coff=0, with_row=True):
+    """Apply a deferred trailing update to exactly the tile slots the
+    step-k panel phase reads: local column slot k // q (all rows) and,
+    when ``with_row``, local row slot k // p (all columns but the one the
+    column piece covered).  Same per-element products as the full einsum,
+    sliced to one j (resp. one i)."""
+    dtype = t_loc.dtype
+    ntl = t_loc.shape[1]
+    pan_p, urow_p = payload
+    kr, kc = k // p - roff, k // q - coff
+    uc = lax.dynamic_slice_in_dim(urow_p, kc, 1, axis=0)
+    updc = jnp.einsum("iab,jbc->ijac", pan_p, uc, precision=PRECISE)
+    colv = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)
+    t_loc = lax.dynamic_update_slice_in_dim(
+        t_loc, colv - updc.astype(dtype), kc, axis=1
+    )
+    if with_row:
+        pr = lax.dynamic_slice_in_dim(pan_p, kr, 1, axis=0)
+        updr = jnp.einsum("iab,jbc->ijac", pr, urow_p, precision=PRECISE)
+        keep = (jnp.arange(ntl) != kc)[None, :, None, None]
+        rowv = lax.dynamic_slice_in_dim(t_loc, kr, 1, axis=0)
+        t_loc = lax.dynamic_update_slice_in_dim(
+            t_loc, rowv - jnp.where(keep, updr.astype(dtype), 0), kr, axis=0
+        )
+    return t_loc
+
+
+def _nopiv_bulk(t_loc, payload, excl_kr=None, excl_kc=None):
+    """Apply a deferred trailing update everywhere ``_nopiv_narrow`` did
+    not (both exclusions None = the full strict-schedule update)."""
+    dtype = t_loc.dtype
+    mtl, ntl = t_loc.shape[0], t_loc.shape[1]
+    pan_p, urow_p = payload
+    upd = jnp.einsum("iab,jbc->ijac", pan_p, urow_p, precision=PRECISE)
+    if excl_kr is None and excl_kc is None:
+        return t_loc - upd.astype(dtype)
+    keep = jnp.ones((mtl, ntl), bool)
+    if excl_kc is not None:
+        keep = keep & (jnp.arange(ntl) != excl_kc)[None, :]
+    if excl_kr is not None:
+        keep = keep & (jnp.arange(mtl) != excl_kr)[:, None]
+    return t_loc - jnp.where(keep[:, :, None, None], upd.astype(dtype), 0)
+
+
+def _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c, roff=0, coff=0, panel_done=False):
+    """One FULL right-looking LU tile step — the strict schedule: panel
+    phase followed immediately by the trailing gemm (the depth-0 form the
+    pipelined kernels must reproduce bitwise)."""
+    t_loc, payload = _nopiv_panel(
+        t_loc, k, p, q, i_log, j_log, r, c, roff, coff, panel_done
+    )
+    return _nopiv_bulk(t_loc, payload)
 
 
 def _lu_info_dist(t_loc, i_log, j_log, nt, nb):
@@ -137,26 +205,42 @@ def _lu_info_dist(t_loc, i_log, j_log, nt, nb):
     return jnp.where(info >= big, 0, info).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
-def _lu_jit(at, mesh, p, q, nt):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
+def _lu_jit(at, mesh, p, q, nt, la):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
         mtl, ntl, nb, _ = t_loc.shape
+        dtype = t_loc.dtype
         r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
 
         # trailing-update bucketing (see dist_chol.py): each segment runs
-        # on a statically smaller trailing view, cutting the masked flops
+        # on a statically smaller trailing view, cutting the masked flops.
+        # Lookahead pipelines within each bucket (the deferred gemm drains
+        # at the bucket boundary before the view is re-sliced).
         for k0, k1, s0r, s0c in bucket_plan(nt, p, q):
             view = t_loc[s0r:, s0c:]
             i_v = r + (s0r + jnp.arange(mtl - s0r)) * p
             j_v = c + (s0c + jnp.arange(ntl - s0c)) * q
 
-            def step(k, view, i_v=i_v, j_v=j_v, s0r=s0r, s0c=s0c):
-                return _nopiv_step(view, k, p, q, i_v, j_v, r, c, s0r, s0c)
+            def panel(k, view, i_v=i_v, j_v=j_v, s0r=s0r, s0c=s0c):
+                return _nopiv_panel(view, k, p, q, i_v, j_v, r, c, s0r, s0c)
 
-            with audit_scope(k1 - k0):
-                view = lax.fori_loop(k0, k1, step, view)
+            def narrow(k, view, pl, s0r=s0r, s0c=s0c):
+                return _nopiv_narrow(view, pl, k, p, q, s0r, s0c)
+
+            def bulk(k, view, pl, s0r=s0r, s0c=s0c):
+                if k is None:
+                    return _nopiv_bulk(view, pl)
+                return _nopiv_bulk(view, pl, k // p - s0r, k // q - s0c)
+
+            zero_pl = (
+                jnp.zeros((mtl - s0r, nb, nb), dtype),
+                jnp.zeros((ntl - s0c, nb, nb), dtype),
+            )
+            view = pipelined_factor_loop(
+                k0, k1, la, panel, narrow, bulk, view, zero_pl
+            )
             t_loc = t_loc.at[s0r:, s0c:].set(view)
 
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
@@ -178,19 +262,30 @@ def _lu_jit(at, mesh, p, q, nt):
 
 
 @instrument("getrf_tntpiv_dist")
-def getrf_tntpiv_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array, jax.Array]:
+def getrf_tntpiv_dist(
+    a: DistMatrix, lookahead: Optional[int] = None
+) -> Tuple[DistMatrix, jax.Array, jax.Array]:
     """Factor P A = L U with tournament pivoting across the mesh.
 
     Returns (LU DistMatrix, perm, info): ``perm`` is the global row
     permutation over the PADDED row space (length mt*nb; rows >= a.m are
     pad fixed points) with LAPACK meaning row i of PA = original row
     perm[i].
+
+    ``lookahead`` >= 1 defers each step's trailing gemm so the NEXT
+    step's tournament collectives (which read only the refreshed panel
+    column) overlap it — the CALU form of the reference's lookahead.  The
+    deferred update must land before the cross-shard row swaps (they move
+    full rows), so the overlap window is the tournament, not the whole
+    panel.  Results are bitwise-identical at any depth.
     """
     p, q = mesh_shape(a.mesh)
     if a.mt != a.nt:
         raise ValueError("getrf_tntpiv_dist needs a square tile grid")
     a.require_diag_pad("getrf_tntpiv_dist")
-    lut, perm, info = _tntpiv_jit(a.tiles, a.mesh, p, q, a.nt, a.m)
+    lut, perm, info = _tntpiv_jit(
+        a.tiles, a.mesh, p, q, a.nt, a.m, la_depth(lookahead, a.nt)
+    )
     return (
         DistMatrix(tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True),
         perm,
@@ -198,8 +293,8 @@ def getrf_tntpiv_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array, jax.Array]:
     )
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
-def _tntpiv_jit(at, mesh, p, q, nt, m_true):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
+def _tntpiv_jit(at, mesh, p, q, nt, m_true, la):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
@@ -210,8 +305,9 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true):
         sent = mglob  # tournament sentinel (sorts last, marks dead slots)
         flat_gids = (i_log[:, None] * nb + jnp.arange(nb)[None, :]).reshape(-1)
 
-        def step(k, carry):
-            t_loc, rowperm = carry
+        def tournament(k, t_loc):
+            """Panel-column tournament: local reduce, cross-row merge,
+            winner bcast.  Reads only local column slot k // q."""
             base = k * nb
             kc = k // q
 
@@ -227,7 +323,13 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true):
             ga = all_gather_a(vloc, ROW_AXIS, axis=0).reshape(p * nb, nb)
             gi = all_gather_a(iloc, ROW_AXIS, axis=0).reshape(p * nb)
             _, win = _tournament_reduce(ga, gi, nb, sent)
-            win = bcast_from_col(jnp.where(c == k % q, win, 0), k % q)
+            return bcast_from_col(jnp.where(c == k % q, win, 0), k % q)
+
+        def apply_swaps(k, win, t_loc, rowperm):
+            """Replicated swap simulation + physical cross-shard full-row
+            exchange; reads full rows, so any deferred trailing update
+            must be fully applied first."""
+            base = k * nb
 
             # ---- simulate the LAPACK-style sequential swaps (replicated):
             # swap j brings winner row win[j] (at its CURRENT position —
@@ -283,14 +385,43 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true):
             t_loc = t_loc.at[dst_loc, :, dst_r, :].set(
                 rows_data.astype(dtype), mode="drop"
             )
-
-            # ---- standard right-looking step on the pivoted panel ----
-            return _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c), rowperm
-
+            return t_loc, rowperm
 
         rowperm0 = jnp.arange(mglob)
-        with audit_scope(nt):
-            t_loc, rowperm = lax.fori_loop(0, nt, step, (t_loc, rowperm0))
+        if la <= 0:
+            def step(k, carry):
+                t_loc, rowperm = carry
+                win = tournament(k, t_loc)
+                t_loc, rowperm = apply_swaps(k, win, t_loc, rowperm)
+                # ---- standard right-looking step on the pivoted panel ----
+                return _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c), rowperm
+
+            with audit_scope(nt):
+                t_loc, rowperm = lax.fori_loop(0, nt, step, (t_loc, rowperm0))
+        else:
+            # Lookahead: carry the previous step's (pan, urow); refresh
+            # the panel column, run the tournament (its collectives are
+            # independent of — and overlap — the bulk einsum), land the
+            # rest of the deferred update, then swap and factor, deferring
+            # this step's own trailing gemm.
+            def step(k, carry):
+                t_loc, rowperm, pl = carry
+                t_loc = _nopiv_narrow(t_loc, pl, k, p, q, with_row=False)
+                win = tournament(k, t_loc)
+                t_loc = _nopiv_bulk(t_loc, pl, excl_kc=k // q)
+                t_loc, rowperm = apply_swaps(k, win, t_loc, rowperm)
+                t_loc, pl_new = _nopiv_panel(t_loc, k, p, q, i_log, j_log, r, c)
+                return t_loc, rowperm, pl_new
+
+            zero_pl = (
+                jnp.zeros((mtl, nb, nb), dtype),
+                jnp.zeros((ntl, nb, nb), dtype),
+            )
+            with audit_scope(nt):
+                t_loc, rowperm, pl = lax.fori_loop(
+                    0, nt, step, (t_loc, rowperm0, zero_pl)
+                )
+            t_loc = _nopiv_bulk(t_loc, pl)  # drain the last deferred gemm
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, rowperm[None], info[None, None]
 
@@ -314,7 +445,9 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true):
 
 
 @instrument("getrf_pp_dist")
-def getrf_pp_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array, jax.Array]:
+def getrf_pp_dist(
+    a: DistMatrix, lookahead: Optional[int] = None
+) -> Tuple[DistMatrix, jax.Array, jax.Array]:
     """Factor P A = L U with classic partial (per-column argmax) pivoting.
 
     TPU form of getrf.cc: the panel column block stays in its owning mesh
@@ -329,13 +462,17 @@ def getrf_pp_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array, jax.Array]:
     with the shared row-solve + trailing-gemm tail (_nopiv_step).
 
     Returns (LU DistMatrix, perm over the padded row space, info), same
-    contract as getrf_tntpiv_dist.
+    contract as getrf_tntpiv_dist.  ``lookahead`` >= 1 overlaps the
+    pivoted panel factor's collectives with the previous step's deferred
+    trailing gemm (bitwise-identical reorder; see getrf_tntpiv_dist).
     """
     p, q = mesh_shape(a.mesh)
     if a.mt != a.nt:
         raise ValueError("getrf_pp_dist needs a square tile grid")
     a.require_diag_pad("getrf_pp_dist")
-    lut, perm, info = _pp_jit(a.tiles, a.mesh, p, q, a.nt, a.m)
+    lut, perm, info = _pp_jit(
+        a.tiles, a.mesh, p, q, a.nt, a.m, la_depth(lookahead, a.nt)
+    )
     return (
         DistMatrix(tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True),
         perm,
@@ -343,22 +480,16 @@ def getrf_pp_dist(a: DistMatrix) -> Tuple[DistMatrix, jax.Array, jax.Array]:
     )
 
 
-def _pp_panel_and_swaps(t_loc, rowperm, k, p, q, r, c, nt, m_true,
-                        s_r, wlr, s_cw, wlsw):
-    """Shared partial-pivot panel factor + cross-shard row-swap machinery
-    (the internal_getrf.cc + internal_swap.cc pair), used by the dense
-    (getrf_pp_dist) and band (gbtrf_band_dist) kernels so the pivot
-    tie-break / sentinel / swap-write logic lives in ONE place.
+def _pp_panel_factor(t_loc, k, p, q, r, c, nt, m_true, s_r, wlr):
+    """Partial-pivot panel factor (the internal_getrf.cc half of the
+    shared machinery): per-column argmax pivoting with cross-row
+    all_gathers and in-panel masked-psum swaps, all on a broadcast COPY
+    of panel column k.  Reads only local column slot k // q (window rows
+    [s_r, s_r + wlr)), so under lookahead it can run after the narrow
+    column refresh and overlap the deferred bulk update.
 
-    ``s_r``/``wlr`` restrict the panel's candidate rows to the local slot
-    window [s_r, s_r + wlr) — the band kernel's O(kl)-row panel; the
-    dense kernel passes the full height (0, mtl).  ``s_cw``/``wlsw``
-    restrict the swap application to that local column window (a band
-    row's nonzeros — L history in columns >= g - kl, U fill up to
-    g + kl + ku — live inside it); the dense kernel passes (0, ntl).
-
-    Returns (t_loc, rowperm): all nb transpositions applied and the
-    factored panel written back into the owning column's window rows."""
+    Returns (flat, piv_pos): the factored panel (flattened window rows)
+    and the global pivot position chosen per column."""
     mtl, ntl, nb, _ = t_loc.shape
     dtype = t_loc.dtype
     mglob = nt * nb
@@ -426,6 +557,22 @@ def _pp_panel_and_swaps(t_loc, rowperm, k, p, q, r, c, nt, m_true,
         flat, piv_pos = lax.fori_loop(
             0, nb, colstep, (flat, jnp.zeros((nb,), win_gids.dtype))
         )
+    return flat, piv_pos
+
+
+def _pp_apply_swaps(t_loc, rowperm, flat, piv_pos, k, p, q, r, c, nt,
+                    s_r, wlr, s_cw, wlsw):
+    """Apply the partial-pivot panel's nb transpositions to the stored
+    rows (the internal_swap.cc half) and write the factored panel back
+    into the owning column.  Reads full rows across the swap column
+    window, so any deferred trailing update must be fully applied first.
+    Returns (t_loc, rowperm)."""
+    mtl, ntl, nb, _ = t_loc.shape
+    dtype = t_loc.dtype
+    mglob = nt * nb
+    base = k * nb
+    kc32 = jnp.asarray(k // q, jnp.int32)
+    zero = jnp.zeros((), jnp.int32)
 
     # ---- apply the nb transpositions to the stored rows (restricted to
     # the swap column window; the panel column is overwritten below) ----
@@ -476,31 +623,89 @@ def _pp_panel_and_swaps(t_loc, rowperm, k, p, q, r, c, nt, m_true,
     return t_loc, rowperm
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
-def _pp_jit(at, mesh, p, q, nt, m_true):
+def _pp_panel_and_swaps(t_loc, rowperm, k, p, q, r, c, nt, m_true,
+                        s_r, wlr, s_cw, wlsw):
+    """Shared partial-pivot panel factor + cross-shard row-swap machinery
+    (the internal_getrf.cc + internal_swap.cc pair), used by the dense
+    (getrf_pp_dist) and band (gbtrf_band_dist) kernels so the pivot
+    tie-break / sentinel / swap-write logic lives in ONE place — split
+    into ``_pp_panel_factor`` (reads only column k; overlappable under
+    lookahead) and ``_pp_apply_swaps`` (full-row motion) so the dense
+    kernel can land a deferred trailing update between them.
+
+    ``s_r``/``wlr`` restrict the panel's candidate rows to the local slot
+    window [s_r, s_r + wlr) — the band kernel's O(kl)-row panel; the
+    dense kernel passes the full height (0, mtl).  ``s_cw``/``wlsw``
+    restrict the swap application to that local column window (a band
+    row's nonzeros — L history in columns >= g - kl, U fill up to
+    g + kl + ku — live inside it); the dense kernel passes (0, ntl).
+
+    Returns (t_loc, rowperm): all nb transpositions applied and the
+    factored panel written back into the owning column's window rows."""
+    flat, piv_pos = _pp_panel_factor(t_loc, k, p, q, r, c, nt, m_true, s_r, wlr)
+    return _pp_apply_swaps(
+        t_loc, rowperm, flat, piv_pos, k, p, q, r, c, nt, s_r, wlr, s_cw, wlsw
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
+def _pp_jit(at, mesh, p, q, nt, m_true, la):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
         mtl, ntl, nb, _ = t_loc.shape
+        dtype = t_loc.dtype
         r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
         mglob = nt * nb
         zero = jnp.zeros((), jnp.int32)
 
-        def step(k, carry):
-            t_loc, rowperm = carry
-            t_loc, rowperm = _pp_panel_and_swaps(
-                t_loc, rowperm, k, p, q, r, c, nt, m_true,
-                zero, mtl, zero, ntl,
-            )
-            # ---- shared tail: row solve + trailing update ----
-            return (
-                _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c, panel_done=True),
-                rowperm,
-            )
-
         rowperm0 = jnp.arange(mglob)
-        with audit_scope(nt):
-            t_loc, rowperm = lax.fori_loop(0, nt, step, (t_loc, rowperm0))
+        if la <= 0:
+            def step(k, carry):
+                t_loc, rowperm = carry
+                t_loc, rowperm = _pp_panel_and_swaps(
+                    t_loc, rowperm, k, p, q, r, c, nt, m_true,
+                    zero, mtl, zero, ntl,
+                )
+                # ---- shared tail: row solve + trailing update ----
+                return (
+                    _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c, panel_done=True),
+                    rowperm,
+                )
+
+            with audit_scope(nt):
+                t_loc, rowperm = lax.fori_loop(0, nt, step, (t_loc, rowperm0))
+        else:
+            # Lookahead (getrf.cc's panel/update overlap): refresh the
+            # panel column, factor it with pivoting (its collectives are
+            # independent of the deferred bulk einsum), land the rest of
+            # the deferred update, then swap full rows, row-solve, and
+            # defer this step's own trailing gemm.
+            def step(k, carry):
+                t_loc, rowperm, pl = carry
+                t_loc = _nopiv_narrow(t_loc, pl, k, p, q, with_row=False)
+                flat, piv_pos = _pp_panel_factor(
+                    t_loc, k, p, q, r, c, nt, m_true, zero, mtl
+                )
+                t_loc = _nopiv_bulk(t_loc, pl, excl_kc=k // q)
+                t_loc, rowperm = _pp_apply_swaps(
+                    t_loc, rowperm, flat, piv_pos, k, p, q, r, c, nt,
+                    zero, mtl, zero, ntl,
+                )
+                t_loc, pl_new = _nopiv_panel(
+                    t_loc, k, p, q, i_log, j_log, r, c, panel_done=True
+                )
+                return t_loc, rowperm, pl_new
+
+            zero_pl = (
+                jnp.zeros((mtl, nb, nb), dtype),
+                jnp.zeros((ntl, nb, nb), dtype),
+            )
+            with audit_scope(nt):
+                t_loc, rowperm, pl = lax.fori_loop(
+                    0, nt, step, (t_loc, rowperm0, zero_pl)
+                )
+            t_loc = _nopiv_bulk(t_loc, pl)  # drain the last deferred gemm
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, rowperm[None], info[None, None]
 
@@ -516,7 +721,7 @@ def _pp_jit(at, mesh, p, q, nt, m_true):
 
 @instrument("gbtrf_band_dist")
 def gbtrf_band_dist(
-    a: DistMatrix, kl: int, ku: int
+    a: DistMatrix, kl: int, ku: int, lookahead: Optional[int] = None
 ) -> Tuple[DistMatrix, jax.Array, jax.Array]:
     """Band partial-pivot LU on the mesh at band cost (src/gbtrf.cc):
     the shared getrf_pp_dist pivoting/swap machinery (_pp_panel_and_swaps)
@@ -528,7 +733,13 @@ def gbtrf_band_dist(
     envelope are never read or written (VERDICT r5 item 8); total work is
     O(n (kl + nb)(kl + ku + nb)) — the band-cost class at tile
     granularity (the nb terms are the blocking overhead every blocked
-    band LU pays)."""
+    band LU pays).
+
+    ``lookahead`` is accepted for API symmetry but runs the strict
+    schedule: the pivoted band step's swap column window slides with k
+    and its exclusion set would depend on the pivot choices, so the
+    windowed analogue of getrf_pp_dist's deferred update is future work
+    (the dense kernels carry the overlap story)."""
     p, q = mesh_shape(a.mesh)
     if a.mt != a.nt:
         raise ValueError("gbtrf_band_dist needs a square tile grid")

@@ -28,11 +28,12 @@ from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 from .comm import (
     PRECISE,
     all_gather_a,
-    audit_scope,
     bcast_diag_tile,
     bcast_from_col,
     bcast_from_row,
+    la_depth,
     local_indices,
+    prefetch_bcast,
     psum_scatter_a,
     route_to_block_cyclic_rows,
     shard_map_compat,
@@ -49,6 +50,7 @@ def trsm_dist(
     op: Op = Op.NoTrans,
     diag: Diag = Diag.NonUnit,
     method: Optional[MethodTrsm] = None,
+    lookahead: Optional[int] = None,
 ) -> DistMatrix:
     """Solve op(A) X = B; A triangular-distributed, B distributed. X
     overwrites B's layout (left side; alpha folded by callers).
@@ -61,7 +63,14 @@ def trsm_dist(
     transposed ops, routed per target row by
     comm.route_to_block_cyclic_rows) — the win when B is far thinner
     than A.  All (uplo, op) combinations run the stationary schedule
-    (src/trsmA.cc covers every op).  None = auto-select."""
+    (src/trsmA.cc covers every op).  None = auto-select.
+
+    ``lookahead`` (Option.Lookahead; None = the option default, 1): A is
+    read-only here, so its per-step panels (diag tile + column/row panel)
+    are prefetched ``lookahead`` steps ahead through
+    ``comm.prefetch_bcast`` — the broadcast for step k + d overlaps the
+    serial solve/update chain of step k.  Bitwise-identical at any
+    depth."""
     p, q = mesh_shape(a.mesh)
     if b.grid != a.grid or b.nb != a.nb or b.mt != a.nt or b.m != a.n:
         raise ValueError(
@@ -71,17 +80,18 @@ def trsm_dist(
     a.require_diag_pad("trsm_dist")
     if method is None:
         method = select_trsm_method(Side.Left, b.mt, b.nt)
+    la = la_depth(lookahead, a.nt)
     if method == MethodTrsm.TrsmA:
-        xt = _trsm_a_jit(a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag)
+        xt = _trsm_a_jit(a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag, la)
     else:
         xt = _trsm_jit(
-            a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag
+            a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag, la
         )
     return DistMatrix(tiles=xt, m=b.m, n=b.n, nb=b.nb, mesh=b.mesh)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8))
-def _trsm_a_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
+def _trsm_a_jit(at, bt, mesh, p, q, nt, uplo, op, diag, la=0):
     """Stationary-A left solve, all ops (slate::trsmA, src/trsmA.cc
     semantics): per step the solved X row is all-gathered and multiplied
     against A's stationary tiles where they live — column k of A for
@@ -107,13 +117,16 @@ def _trsm_a_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
             t = jnp.swapaxes(t, -1, -2)
             return jnp.conj(t) if conj else t
 
-        def step(s, b_loc):
+        def fetch(s):
+            # the stationary-A schedule's only read-only broadcast is the
+            # diag tile; the solved-row replication is a serial chain
+            k = s if forward else nt - 1 - s
+            dtile = bcast_diag_tile(a_loc, k, p, q, nb)
+            return opt(dtile) if trans else dtile
+
+        def consume(s, dtile, b_loc):
             k = s if forward else nt - 1 - s
             kr, kc = k // p, k // q
-
-            dtile = bcast_diag_tile(a_loc, k, p, q, nb)
-            if trans:
-                dtile = opt(dtile)
 
             # solve X[k,:] on the owning mesh row, write back
             brow = lax.dynamic_slice_in_dim(b_loc, kr, 1, axis=0)[0]
@@ -162,16 +175,15 @@ def _trsm_a_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
             upd = route_to_block_cyclic_rows(part, j_log, p, mtl_b)
             return b_loc - upd.astype(b_loc.dtype)
 
-        with audit_scope(nt):
-            return lax.fori_loop(0, nt, step, b_loc)
+        return prefetch_bcast(nt, la, fetch, consume, b_loc)
 
     return shard_map_compat(
         kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
     )(at, bt)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8))
-def _trsm_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
+def _trsm_jit(at, bt, mesh, p, q, nt, uplo, op, diag, la=0):
     spec = P(ROW_AXIS, COL_AXIS)
     trans = op != Op.NoTrans
     conj = op == Op.ConjTrans
@@ -188,27 +200,15 @@ def _trsm_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
             t = jnp.swapaxes(t, -1, -2)
             return jnp.conj(t) if conj else t
 
-        def step(s, b_loc):
+        def fetch(s):
+            # A is stationary: the diag tile and the op(A) panel of step
+            # s are pure functions of a_loc, prefetchable at any depth
             k = s if forward else nt - 1 - s
             kr, kc = k // p, k // q
 
-            # diag tile of A to everyone
             dtile = bcast_diag_tile(a_loc, k, p, q, nb)
             if trans:
                 dtile = opt(dtile)
-
-            # solve X[k,:] on the owning mesh row, write back, bcast down 'p'
-            brow = lax.dynamic_slice_in_dim(b_loc, kr, 1, axis=0)[0]  # (nbt,nb,nb)
-            xrow = lax.linalg.triangular_solve(
-                jnp.broadcast_to(dtile, brow.shape), brow,
-                left_side=True, lower=eff_lower, transpose_a=False,
-                unit_diagonal=unit,
-            )
-            mine_r = (r == k % p)
-            b_loc = lax.dynamic_update_slice_in_dim(
-                b_loc, jnp.where(mine_r, xrow, brow)[None], kr, axis=0
-            )
-            xrow = bcast_from_row(jnp.where(mine_r, xrow, 0), k % p)
 
             # panel of op(A)[:, k] by my local row indices, remaining side only
             remaining = (i_log > k) if forward else (i_log < k)
@@ -226,12 +226,30 @@ def _trsm_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
                 allrow = all_gather_a(arow, COL_AXIS, axis=0)  # (q,ntl,nb,nb)
                 pan = opt(allrow[i_log % q, i_log // q])
                 pan = jnp.where(remaining[:, None, None], pan, 0)
+            return dtile, pan
+
+        def consume(s, panels, b_loc):
+            k = s if forward else nt - 1 - s
+            kr = k // p
+            dtile, pan = panels
+
+            # solve X[k,:] on the owning mesh row, write back, bcast down 'p'
+            brow = lax.dynamic_slice_in_dim(b_loc, kr, 1, axis=0)[0]  # (nbt,nb,nb)
+            xrow = lax.linalg.triangular_solve(
+                jnp.broadcast_to(dtile, brow.shape), brow,
+                left_side=True, lower=eff_lower, transpose_a=False,
+                unit_diagonal=unit,
+            )
+            mine_r = (r == k % p)
+            b_loc = lax.dynamic_update_slice_in_dim(
+                b_loc, jnp.where(mine_r, xrow, brow)[None], kr, axis=0
+            )
+            xrow = bcast_from_row(jnp.where(mine_r, xrow, 0), k % p)
 
             upd = jnp.einsum("iab,jbc->ijac", pan, xrow, precision=PRECISE)
             return b_loc - upd.astype(b_loc.dtype)
 
-        with audit_scope(nt):
-            return lax.fori_loop(0, nt, step, b_loc)
+        return prefetch_bcast(nt, la, fetch, consume, b_loc)
 
     return shard_map_compat(
         kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
@@ -245,9 +263,11 @@ def trsm_dist_right(
     uplo: Uplo = Uplo.Lower,
     op: Op = Op.NoTrans,
     diag: Diag = Diag.NonUnit,
+    lookahead: Optional[int] = None,
 ) -> DistMatrix:
     """Solve X op(A) = B; A triangular-distributed (n, n), B (m, n).
-    X overwrites B's layout."""
+    X overwrites B's layout.  ``lookahead`` prefetches A's read-only
+    per-step panels, as in trsm_dist."""
     p, q = mesh_shape(a.mesh)
     if b.grid != a.grid or b.nb != a.nb or b.nt != a.nt or b.n != a.m:
         raise ValueError(
@@ -255,12 +275,15 @@ def trsm_dist_right(
             f"B {b.m}x{b.n} nb={b.nb}"
         )
     a.require_diag_pad("trsm_dist_right")
-    xt = _trsm_right_jit(a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag)
+    xt = _trsm_right_jit(
+        a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag,
+        la_depth(lookahead, a.nt),
+    )
     return DistMatrix(tiles=xt, m=b.m, n=b.n, nb=b.nb, mesh=b.mesh)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8))
-def _trsm_right_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
+def _trsm_right_jit(at, bt, mesh, p, q, nt, uplo, op, diag, la=0):
     spec = P(ROW_AXIS, COL_AXIS)
     trans = op != Op.NoTrans
     conj = op == Op.ConjTrans
@@ -277,26 +300,14 @@ def _trsm_right_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
             t = jnp.swapaxes(t, -1, -2)
             return jnp.conj(t) if conj else t
 
-        def step(s, b_loc):
+        def fetch(s):
+            # A is stationary: diag tile + row panel of op(A) prefetch
             k = s if forward else nt - 1 - s
             kr, kc = k // p, k // q
 
             dtile = bcast_diag_tile(a_loc, k, p, q, nb)
             if trans:
                 dtile = opt(dtile)
-
-            # solve X[:, k] on the owning mesh column, write back, bcast 'q'
-            bcol = lax.dynamic_slice_in_dim(b_loc, kc, 1, axis=1)[:, 0]
-            xcol = lax.linalg.triangular_solve(
-                jnp.broadcast_to(dtile, bcol.shape), bcol,
-                left_side=False, lower=eff_lower, transpose_a=False,
-                unit_diagonal=unit,
-            )
-            mine_c = (c == k % q)
-            b_loc = lax.dynamic_update_slice_in_dim(
-                b_loc, jnp.where(mine_c, xcol, bcol)[:, None], kc, axis=1
-            )
-            xcol = bcast_from_col(jnp.where(mine_c, xcol, 0), k % q)
 
             # row k of op(A) restricted to the remaining columns
             remaining = (j_log_b > k) if forward else (j_log_b < k)
@@ -313,12 +324,30 @@ def _trsm_right_jit(at, bt, mesh, p, q, nt, uplo, op, diag):
                 allcol = all_gather_a(acol, ROW_AXIS, axis=0)  # (p,mtl,nb,nb)
                 arow = opt(allcol[j_log_b % p, j_log_b // p])
                 arow = jnp.where(remaining[:, None, None], arow, 0)
+            return dtile, arow
+
+        def consume(s, panels, b_loc):
+            k = s if forward else nt - 1 - s
+            kc = k // q
+            dtile, arow = panels
+
+            # solve X[:, k] on the owning mesh column, write back, bcast 'q'
+            bcol = lax.dynamic_slice_in_dim(b_loc, kc, 1, axis=1)[:, 0]
+            xcol = lax.linalg.triangular_solve(
+                jnp.broadcast_to(dtile, bcol.shape), bcol,
+                left_side=False, lower=eff_lower, transpose_a=False,
+                unit_diagonal=unit,
+            )
+            mine_c = (c == k % q)
+            b_loc = lax.dynamic_update_slice_in_dim(
+                b_loc, jnp.where(mine_c, xcol, bcol)[:, None], kc, axis=1
+            )
+            xcol = bcast_from_col(jnp.where(mine_c, xcol, 0), k % q)
 
             upd = jnp.einsum("iab,jbc->ijac", xcol, arow, precision=PRECISE)
             return b_loc - upd.astype(b_loc.dtype)
 
-        with audit_scope(nt):
-            return lax.fori_loop(0, nt, step, b_loc)
+        return prefetch_bcast(nt, la, fetch, consume, b_loc)
 
     return shard_map_compat(
         kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from ..obs import instrument
-from ..types import Diag, Op, Uplo
+from ..types import Diag, Op, Option, Options, Uplo, get_option
 from .dist import DistMatrix, from_dense, to_dense
 from .dist_chol import potrf_dist
 from .dist_lu import (
@@ -35,56 +35,78 @@ from .summa import gemm_summa
 _DEFAULT_NB = 256
 
 
+def _la(opts: Optional[Options]):
+    """Raw Option.Lookahead value from a driver ``opts`` mapping — the
+    panel-prefetch / deferred-update pipeline depth every mesh k-loop
+    consumes (comm.prefetch_bcast / comm.pipelined_factor_loop).  May be
+    None (absent or explicitly unset): ``comm.la_depth`` inside each
+    kernel is the single authority that maps None to the option default
+    (1, as in the reference) and clamps to the trip count."""
+    return get_option(opts, Option.Lookahead)
+
+
 @instrument("gemm_mesh")
 def gemm_mesh(
     alpha, a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
     beta=0.0, c: Optional[jax.Array] = None,
+    opts: Optional[Options] = None,
 ) -> jax.Array:
-    """Distributed C = alpha A B (+ beta C) via SUMMA (src/gemmC.cc)."""
+    """Distributed C = alpha A B (+ beta C) via SUMMA (src/gemmC.cc).
+    ``opts`` carries Option.Lookahead (panel-prefetch depth)."""
     ad = from_dense(a, mesh, nb)
     bd = from_dense(b, mesh, nb)
     cd = from_dense(c, mesh, nb) if c is not None else None
-    return to_dense(gemm_summa(alpha, ad, bd, beta, cd))
+    return to_dense(gemm_summa(alpha, ad, bd, beta, cd, lookahead=_la(opts)))
 
 
 @instrument("potrf_mesh")
 def potrf_mesh(
-    a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
+    a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
+    opts: Optional[Options] = None,
 ) -> Tuple[DistMatrix, jax.Array]:
     """Distributed lower Cholesky; input is the full/lower Hermitian array."""
-    return potrf_dist(from_dense(a, mesh, nb, diag_pad_one=True))
+    return potrf_dist(
+        from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts)
+    )
 
 
 @instrument("posv_mesh")
 def posv_mesh(
-    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
+    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
+    opts: Optional[Options] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed SPD solve: potrf + two trsm sweeps (src/posv.cc)."""
-    l, info = potrf_mesh(a, mesh, nb)
+    la = _la(opts)
+    l, info = potrf_mesh(a, mesh, nb, opts)
     bd = from_dense(b, mesh, nb)
-    y = trsm_dist(l, bd, Uplo.Lower, Op.NoTrans)
-    x = trsm_dist(l, y, Uplo.Lower, Op.ConjTrans)
+    y = trsm_dist(l, bd, Uplo.Lower, Op.NoTrans, lookahead=la)
+    x = trsm_dist(l, y, Uplo.Lower, Op.ConjTrans, lookahead=la)
     return to_dense(x), info
 
 
 @instrument("getrf_nopiv_mesh")
 def getrf_nopiv_mesh(
-    a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
+    a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
+    opts: Optional[Options] = None,
 ) -> Tuple[DistMatrix, jax.Array]:
-    return getrf_nopiv_dist(from_dense(a, mesh, nb, diag_pad_one=True))
+    return getrf_nopiv_dist(
+        from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts)
+    )
 
 
 @instrument("gesv_nopiv_mesh")
 def gesv_nopiv_mesh(
-    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
+    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
+    opts: Optional[Options] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed LU solve without pivoting (src/gesv_nopiv path). For
     general matrices use gesv_tntpiv_mesh (tournament pivoting), the RBT
     preconditioner (linalg.rbt), or the single-chip partial-pivot getrf."""
-    lu, info = getrf_nopiv_mesh(a, mesh, nb)
+    la = _la(opts)
+    lu, info = getrf_nopiv_mesh(a, mesh, nb, opts)
     bd = from_dense(b, mesh, nb)
-    y = trsm_dist(lu, bd, Uplo.Lower, Op.NoTrans, Diag.Unit)
-    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans)
+    y = trsm_dist(lu, bd, Uplo.Lower, Op.NoTrans, Diag.Unit, lookahead=la)
+    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans, lookahead=la)
     return to_dense(x), info
 
 
@@ -211,24 +233,29 @@ def svd_mesh(
 
 @instrument("getrf_tntpiv_mesh")
 def getrf_tntpiv_mesh(
-    a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
+    a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
+    opts: Optional[Options] = None,
 ) -> Tuple[DistMatrix, jax.Array, jax.Array]:
     """Distributed tournament-pivoted LU (src/getrf_tntpiv.cc): P A = L U.
     Returns (LU, perm over the padded row space, info)."""
-    return getrf_tntpiv_dist(from_dense(a, mesh, nb, diag_pad_one=True))
+    return getrf_tntpiv_dist(
+        from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts)
+    )
 
 
 @instrument("gesv_tntpiv_mesh")
 def gesv_tntpiv_mesh(
-    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
+    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
+    opts: Optional[Options] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed general solve with tournament pivoting
     (src/gesv.cc with MethodLU::CALU): factor, permute B, two trsm sweeps."""
-    lu, perm, info = getrf_tntpiv_mesh(a, mesh, nb)
+    la = _la(opts)
+    lu, perm, info = getrf_tntpiv_mesh(a, mesh, nb, opts)
     bd = from_dense(b, mesh, nb)
     pb = permute_rows_dist(bd, perm)
-    y = trsm_dist(lu, pb, Uplo.Lower, Op.NoTrans, Diag.Unit)
-    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans)
+    y = trsm_dist(lu, pb, Uplo.Lower, Op.NoTrans, Diag.Unit, lookahead=la)
+    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans, lookahead=la)
     return to_dense(x), info
 
 
@@ -378,18 +405,19 @@ def potri_mesh(
 def gbmm_mesh(
     alpha, a: jax.Array, kl: int, ku: int, b: jax.Array, mesh: Mesh,
     nb: int = _DEFAULT_NB, beta=0.0, c: Optional[jax.Array] = None,
+    opts: Optional[Options] = None,
 ) -> jax.Array:
     """Distributed general-band x dense multiply (src/gbmm.cc)."""
     from ..core.matrix import band_project
 
-    return gemm_mesh(alpha, band_project(a, kl, ku), b, mesh, nb, beta, c)
+    return gemm_mesh(alpha, band_project(a, kl, ku), b, mesh, nb, beta, c, opts)
 
 
 @instrument("hbmm_mesh")
 def hbmm_mesh(
     side, alpha, a: jax.Array, kd: int, b: jax.Array, mesh: Mesh,
     nb: int = _DEFAULT_NB, beta=0.0, c: Optional[jax.Array] = None,
-    uplo: Uplo = Uplo.Lower,
+    uplo: Uplo = Uplo.Lower, opts: Optional[Options] = None,
 ) -> jax.Array:
     """Distributed Hermitian-band x dense multiply (src/hbmm.cc)."""
     from ..core.matrix import band_project
@@ -399,7 +427,8 @@ def hbmm_mesh(
     ad = from_dense(band_project(a, kl, ku), mesh, nb)
     bd = from_dense(b, mesh, nb)
     cd = from_dense(c, mesh, nb) if c is not None else None
-    return to_dense(hemm_summa(side, alpha, ad, bd, beta, cd, uplo=uplo))
+    return to_dense(hemm_summa(side, alpha, ad, bd, beta, cd, uplo=uplo,
+                               lookahead=_la(opts)))
 
 
 @instrument("tbsm_mesh")
@@ -422,7 +451,8 @@ def tbsm_mesh(
 
 @instrument("pbsv_mesh")
 def pbsv_mesh(
-    a: jax.Array, b: jax.Array, kd: int, mesh: Mesh, nb: int = _DEFAULT_NB
+    a: jax.Array, b: jax.Array, kd: int, mesh: Mesh, nb: int = _DEFAULT_NB,
+    opts: Optional[Options] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed Hermitian-band solve (src/pbsv.cc/pbtrf.cc): the
     factorization k-loop only touches the tile window inside the
@@ -434,19 +464,20 @@ def pbsv_mesh(
     from ..core.matrix import band_project
     from .dist_chol import pbtrf_band_dist
 
+    la = _la(opts)
     ab = band_project(a, kd, kd)
     ad = from_dense(ab, mesh, nb, diag_pad_one=True)
-    l, info = pbtrf_band_dist(ad, kd)
+    l, info = pbtrf_band_dist(ad, kd, lookahead=la)
     bd = from_dense(b, mesh, nb)
-    y = trsm_dist(l, bd, Uplo.Lower, Op.NoTrans)
-    x = trsm_dist(l, y, Uplo.Lower, Op.ConjTrans)
+    y = trsm_dist(l, bd, Uplo.Lower, Op.NoTrans, lookahead=la)
+    x = trsm_dist(l, y, Uplo.Lower, Op.ConjTrans, lookahead=la)
     return to_dense(x), info
 
 
 @instrument("gbsv_mesh")
 def gbsv_mesh(
     a: jax.Array, b: jax.Array, kl: int, ku: int, mesh: Mesh,
-    nb: int = _DEFAULT_NB,
+    nb: int = _DEFAULT_NB, opts: Optional[Options] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed general-band solve (src/gbsv.cc/gbtrf.cc): partial-pivot
     band LU whose panel, swaps, row solve and trailing update only touch
@@ -456,35 +487,41 @@ def gbsv_mesh(
     from ..core.matrix import band_project
     from .dist_lu import gbtrf_band_dist
 
+    la = _la(opts)
     ab = band_project(a, kl, ku)
     ad = from_dense(ab, mesh, nb, diag_pad_one=True)
-    lu, perm, info = gbtrf_band_dist(ad, kl, ku)
+    lu, perm, info = gbtrf_band_dist(ad, kl, ku, lookahead=la)
     bd = from_dense(b, mesh, nb)
     pb = permute_rows_dist(bd, perm)
-    y = trsm_dist(lu, pb, Uplo.Lower, Op.NoTrans, Diag.Unit)
-    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans)
+    y = trsm_dist(lu, pb, Uplo.Lower, Op.NoTrans, Diag.Unit, lookahead=la)
+    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans, lookahead=la)
     return to_dense(x), info
 
 
 @instrument("getrf_mesh")
 def getrf_mesh(
-    a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
+    a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
+    opts: Optional[Options] = None,
 ) -> Tuple[DistMatrix, jax.Array, jax.Array]:
     """Distributed partial-pivot LU — the reference's default getrf
     (src/getrf.cc:23-200): P A = L U with per-column argmax pivoting.
     Returns (LU, perm over the padded row space, info)."""
-    return getrf_pp_dist(from_dense(a, mesh, nb, diag_pad_one=True))
+    return getrf_pp_dist(
+        from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts)
+    )
 
 
 @instrument("gesv_mesh")
 def gesv_mesh(
-    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
+    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
+    opts: Optional[Options] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed general solve with partial pivoting (src/gesv.cc
     default MethodLU::PartialPiv): factor, permute B, two trsm sweeps."""
-    lu, perm, info = getrf_mesh(a, mesh, nb)
+    la = _la(opts)
+    lu, perm, info = getrf_mesh(a, mesh, nb, opts)
     bd = from_dense(b, mesh, nb)
     pb = permute_rows_dist(bd, perm)
-    y = trsm_dist(lu, pb, Uplo.Lower, Op.NoTrans, Diag.Unit)
-    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans)
+    y = trsm_dist(lu, pb, Uplo.Lower, Op.NoTrans, Diag.Unit, lookahead=la)
+    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans, lookahead=la)
     return to_dense(x), info

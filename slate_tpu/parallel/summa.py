@@ -9,7 +9,12 @@ axis (owner contributes its tiles, everyone else zeros — lowering to an ICI
 all-reduce whose cost equals a broadcast's within 2x, with no tags or
 lifetimes), and the local batched gemm is one einsum over the device's tile
 stack that XLA maps onto the MXU.  Lookahead/overlap (gemmC.cc:147-176) is
-XLA's async collective scheduling, not runtime code.
+explicit: the k-loop is software-pipelined through ``comm.prefetch_bcast``
+with depth ``Option.Lookahead`` — step k+d's panel broadcasts are issued in
+the same loop body that runs step k's MXU update, so the ICI collective and
+the einsum are data-independent and XLA's latency-hiding scheduler can
+overlap them.  Depth 0 reproduces the strict broadcast→update schedule;
+any depth is bitwise-identical (only independent work reorders).
 """
 
 from __future__ import annotations
@@ -45,6 +50,7 @@ def gemm_summa(
     beta=0.0,
     c: Optional[DistMatrix] = None,
     method: Optional[MethodGemm] = None,
+    lookahead: Optional[int] = None,
 ) -> DistMatrix:
     """C := alpha A B + beta C on block-cyclic tile stacks.
 
@@ -56,6 +62,11 @@ def gemm_summa(
     below; GemmA keeps A's tiles in place and reduces C — the win when
     the output panel is tiny (method.hh:35-45).  None = auto-select from
     the tile-grid shape, as the reference's select_algo does.
+
+    ``lookahead`` is the panel-prefetch depth (Option.Lookahead; None =
+    the option default, 1).  GemmC pipelines its k-loop through
+    ``comm.prefetch_bcast``; GemmA has no k-loop (one-shot all_gather
+    schedule), so the depth is accepted and ignored there.
     """
     p, q = mesh_shape(a.mesh)
     if b.grid != (p, q) or b.nb != a.nb:
@@ -72,7 +83,12 @@ def gemm_summa(
     if method == MethodGemm.GemmA:
         return _gemm_summa_a(alpha, a, b, beta, c)
     ctiles = None if c is None else c.tiles
-    out_t = _summa_jit(a.tiles, b.tiles, ctiles, alpha, beta, a.mesh, p, q, kt)
+    from .comm import la_depth
+
+    out_t = _summa_jit(
+        a.tiles, b.tiles, ctiles, alpha, beta, a.mesh, p, q, kt,
+        la_depth(lookahead, kt),
+    )
     return DistMatrix(tiles=out_t, m=a.m, n=b.n, nb=a.nb, mesh=a.mesh)
 
 
@@ -85,7 +101,9 @@ def _gemm_summa_a(alpha, a: DistMatrix, b: DistMatrix, beta, c) -> DistMatrix:
     gemmA.cc) — owner-selects its block-cyclic C tiles from the reduced
     rows.  Total tile-gemm count equals GemmC's (no redundant compute);
     communication is |B| replication + |C| reduction instead of |A|
-    broadcast, the win when C/B are output panels far thinner than A."""
+    broadcast, the win when C/B are output panels far thinner than A.
+    There is no k-loop here, so Option.Lookahead has nothing to pipeline
+    (the single-shot all_gathers already overlap under XLA)."""
     p, q = mesh_shape(a.mesh)
     ctiles = None if c is None else c.tiles
     out_t = _summa_a_jit(a.tiles, b.tiles, ctiles, alpha, beta, a.mesh, p, q)
@@ -126,8 +144,8 @@ def _summa_a_jit(at, bt, ct, alpha, beta, mesh, p, q):
     return (alpha * prod + beta * ct).astype(at.dtype)
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8))
-def _summa_jit(at, bt, ct, alpha, beta, mesh, p, q, kt):
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9))
+def _summa_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, la):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(a_loc, b_loc):
@@ -135,19 +153,23 @@ def _summa_jit(at, bt, ct, alpha, beta, mesh, p, q, kt):
         mtl, _, nb, _ = a_loc.shape
         ntl = b_loc.shape[1]
         dtype = a_loc.dtype
+        from .comm import prefetch_bcast
 
-        def step(k, acc):
+        def fetch(k):
+            # panels are pure functions of the stationary tile stacks:
+            # prefetchable at any depth (gemmC.cc's listBcastMT lookahead)
             acol_own = lax.dynamic_slice_in_dim(a_loc, k // q, 1, axis=1)[:, 0]
             acol = _bcast_from_col(acol_own, k % q)
             brow_own = lax.dynamic_slice_in_dim(b_loc, k // p, 1, axis=0)[0]
             brow = _bcast_from_row(brow_own, k % p)
+            return acol, brow
+
+        def consume(k, panels, acc):
+            acol, brow = panels
             return acc + _local_outer(acol, brow, dtype)
 
         acc0 = jnp.zeros((mtl, ntl, nb, nb), dtype)
-        from .comm import audit_scope
-
-        with audit_scope(kt):
-            return lax.fori_loop(0, kt, step, acc0)
+        return prefetch_bcast(kt, la, fetch, consume, acc0)
 
     prod = shard_map_compat(
         kernel,

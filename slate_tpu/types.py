@@ -175,8 +175,14 @@ def select_trsm_method(side: Side, m: int, n: int) -> MethodTrsm:
 
 
 def select_hemm_method(m: int, n: int) -> MethodHemm:
-    """method.hh MethodHemm::select_algo: a thin B/C panel next to a big
-    Hermitian A favours the stationary-A schedule (hemmA.cc)."""
+    """Shape heuristic in the SPIRIT of method.hh MethodHemm::select_algo
+    (a thin B/C panel next to a big Hermitian A favours the stationary-A
+    schedule, hemmA.cc) but NOT its exact rule: the reference switches on
+    ``n < 2 * nb`` (panel thinner than two tiles); here the threshold is
+    the TPU-tuned aspect ratio n <= m / 4, where hemmA's |B|-replication
+    + p|C|-reduction ICI volume undercuts the k-loop's row-panel gathers
+    on the meshes we measure.  Callers pinning the reference's exact
+    dispatch should pass Option.MethodHemm explicitly."""
     if n <= m // 4:
         return MethodHemm.HemmA
     return MethodHemm.HemmC
@@ -192,10 +198,13 @@ class Option(enum.Enum):
     Lookahead = "lookahead"
     BlockSize = "block_size"  # nb (reference Option::TileSize analog)
     InnerBlocking = "inner_blocking"  # ib
-    # Reference: threads cooperating on one LU panel (internal_getrf.cc).
-    # TPU analogue: the CALU tournament panel is ib * MaxPanelThreads
-    # columns wide, trading per-step latency against update size exactly
-    # as panel threads do (linalg/lu.py getrf, MethodLU.CALU).
+    # Reference: threads cooperating on one LU panel (internal_getrf.cc),
+    # a parallelism-only knob there.  TPU analogue: the CALU tournament
+    # panel is ib * MaxPanelThreads columns wide, trading per-step latency
+    # against update size as panel threads do — but with a NUMERICAL side
+    # effect the reference doesn't have: the tournament width changes
+    # which pivots win, so pivot quality varies with this option
+    # (linalg/lu.py getrf, MethodLU.CALU, has the full note).
     MaxPanelThreads = "max_panel_threads"
     Tolerance = "tolerance"
     Target = "target"

@@ -119,10 +119,13 @@ def test_summa_payload_matches_analytic_bcast_volume():
 
     C-stationary SUMMA broadcasts, per k-step and per device, its A
     tile-column (mtl tiles) along mesh axis 'q' and its B tile-row (ntl
-    tiles) along 'p' — each as one masked psum of nb x nb tiles — under
-    ``audit_scope(kt)``.  The audited per-device payload must therefore
-    equal kt * (mtl + ntl) * nb^2 * itemsize EXACTLY, as two psum
-    records with multiplicity kt."""
+    tiles) along 'p' — each as one masked psum of nb x nb tiles.  The
+    audited per-device payload must equal kt * (mtl + ntl) * nb^2 *
+    itemsize EXACTLY at every lookahead depth; the depth only moves
+    broadcasts between the prologue (multiplicity 1) and the
+    audit-scoped loop (multiplicity kt - depth), never changing the
+    per-op totals (ISSUE 3: lookahead changes when bytes move, not how
+    many)."""
     import jax.numpy as jnp
 
     from slate_tpu.parallel import from_dense, gemm_summa, make_mesh
@@ -135,17 +138,33 @@ def test_summa_payload_matches_analytic_bcast_volume():
                    mesh, nb)
     b = from_dense(jnp.asarray(rng.standard_normal((n, n)), jnp.float32),
                    mesh, nb)
-    jax.clear_caches()  # counters record at trace time only
-    with comm_audit() as recs:
-        gemm_summa(1.0, a, b, method=MethodGemm.GemmC).tiles.block_until_ready()
-
     kt, mtl, ntl = a.nt, a.mt // p, b.nt // q
     itemsize = 4  # f32
     expect_total = kt * (mtl + ntl) * nb * nb * itemsize
-    assert sum(nbytes * m for _, nbytes, m in recs) == expect_total
 
-    by_op = {op: (nbytes, m) for op, nbytes, m in recs}
-    assert set(by_op) == {"psum[p]", "psum[q]"}
-    # A column panel rides axis 'q' (bcast_from_col), B row panel axis 'p'
-    assert by_op["psum[q]"] == (mtl * nb * nb * itemsize, kt)
-    assert by_op["psum[p]"] == (ntl * nb * nb * itemsize, kt)
+    for la in (0, 1, 2):
+        jax.clear_caches()  # counters record at trace time only
+        with comm_audit() as recs:
+            gemm_summa(1.0, a, b, method=MethodGemm.GemmC,
+                       lookahead=la).tiles.block_until_ready()
+
+        assert sum(nbytes * m for _, nbytes, m in recs) == expect_total, la
+
+        # per-op totals: multiplicity-weighted step counts sum to kt
+        steps = {}
+        payload = {}
+        for op, nbytes, m in recs:
+            steps[op] = steps.get(op, 0) + m
+            payload.setdefault(op, nbytes)
+            assert payload[op] == nbytes  # same panel size in every record
+        assert set(steps) == {"psum[p]", "psum[q]"}
+        # A column panel rides axis 'q' (bcast_from_col), B row panel 'p'
+        assert steps["psum[q]"] == kt and payload["psum[q]"] == mtl * nb * nb * itemsize
+        assert steps["psum[p]"] == kt and payload["psum[p]"] == ntl * nb * nb * itemsize
+        # strict: one scoped record per op; depth d: d prologue records
+        # at multiplicity 1 per op + one loop record at kt - d
+        mults = sorted(m for _, _, m in recs)
+        if la == 0:
+            assert mults == [kt, kt]
+        else:
+            assert mults == [1] * (2 * la) + [kt - la] * 2

@@ -1,0 +1,336 @@
+"""Lookahead pipelining (ISSUE 3): Option.Lookahead consumed end-to-end.
+
+Contracts under test, on the forced 8-device CPU mesh:
+
+1. Depth 0 reproduces the strict broadcast→update schedule and depth >= 1
+   reorders ONLY independent work — results are BITWISE identical across
+   depths for every pipelined mesh kernel (summa / dist_chol / dist_lu /
+   dist_trsm / dist_blas3).
+2. The option plumbs through the driver (`opts`) and api facades.
+3. Lookahead changes WHEN bytes move (audit record layout: prologue
+   records at multiplicity 1 split off the loop records) but not HOW MANY
+   (total audited payload is unchanged at any depth).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from slate_tpu.parallel import (
+    from_dense,
+    gemm_mesh,
+    gemm_summa,
+    make_mesh,
+    potrf_dist,
+    to_dense,
+    trsm_dist,
+)
+from slate_tpu.parallel.comm import comm_audit, la_depth, prefetch_bcast
+from slate_tpu.parallel.dist_blas3 import hemm_summa, her2k_dist, trmm_dist
+from slate_tpu.parallel.dist_chol import pbtrf_band_dist
+from slate_tpu.parallel.dist_lu import (
+    getrf_nopiv_dist,
+    getrf_pp_dist,
+    getrf_tntpiv_dist,
+)
+from slate_tpu.parallel.dist_trsm import trsm_dist_right
+from slate_tpu.types import (
+    Diag,
+    MethodGemm,
+    MethodHemm,
+    MethodTrsm,
+    Op,
+    Option,
+    Side,
+    Uplo,
+    get_option,
+)
+
+from conftest import cpu_devices
+
+DEPTHS = (0, 1, 2)
+N, NB = 64, 8
+
+
+def mesh24():
+    return make_mesh(2, 4, devices=cpu_devices(8))
+
+
+def _rand(rng, m, n, cplx=False):
+    a = rng.standard_normal((m, n))
+    if cplx:
+        a = a + 1j * rng.standard_normal((m, n))
+    return jnp.asarray(a)
+
+
+def _assert_bitwise(outs, label):
+    for la in DEPTHS[1:]:
+        np.testing.assert_array_equal(
+            np.asarray(outs[la]), np.asarray(outs[0]),
+            err_msg=f"{label}: depth {la} differs from the strict schedule",
+        )
+
+
+# ---------------------------------------------------------------------------
+# depth 0/1/2 bitwise equivalence, kernel by kernel
+# ---------------------------------------------------------------------------
+
+
+def test_lookahead_gemm_summa_bitwise(rng):
+    mesh = mesh24()
+    a = from_dense(_rand(rng, N, N), mesh, NB)
+    b = from_dense(_rand(rng, N, N), mesh, NB)
+    outs = {
+        la: to_dense(gemm_summa(1.0, a, b, method=MethodGemm.GemmC, lookahead=la))
+        for la in DEPTHS
+    }
+    _assert_bitwise(outs, "gemm_summa")
+
+
+def test_lookahead_potrf_dist_bitwise(rng):
+    mesh = mesh24()
+    a = _rand(rng, N, N)
+    spd = a @ a.T + N * jnp.eye(N)
+    ad = from_dense(spd, mesh, NB, diag_pad_one=True)
+    outs = {}
+    for la in DEPTHS:
+        l, info = potrf_dist(ad, lookahead=la)
+        assert int(info) == 0
+        outs[la] = to_dense(l)
+    _assert_bitwise(outs, "potrf_dist")
+
+
+def test_lookahead_pbtrf_band_dist_bitwise(rng):
+    from slate_tpu.core.matrix import band_project
+
+    mesh = mesh24()
+    kd = 18
+    a = _rand(rng, N, N)
+    spd = band_project(a @ a.T + N * jnp.eye(N), kd, kd)
+    ad = from_dense(spd, mesh, NB, diag_pad_one=True)
+    outs = {}
+    for la in DEPTHS:
+        l, info = pbtrf_band_dist(ad, kd, lookahead=la)
+        assert int(info) == 0
+        outs[la] = to_dense(l)
+    _assert_bitwise(outs, "pbtrf_band_dist")
+
+
+@pytest.mark.parametrize(
+    "factor", [getrf_nopiv_dist, getrf_tntpiv_dist, getrf_pp_dist],
+    ids=["nopiv", "tntpiv", "pp"],
+)
+def test_lookahead_dist_lu_bitwise(rng, factor):
+    mesh = mesh24()
+    a = rng.standard_normal((N, N))
+    if factor is getrf_nopiv_dist:  # no pivoting: keep it diagonally safe
+        a = np.tril(a) + N * np.eye(N) + np.triu(rng.standard_normal((N, N)), 1)
+    ad = from_dense(jnp.asarray(a), mesh, NB, diag_pad_one=True)
+    outs = {}
+    for la in DEPTHS:
+        res = factor(ad, lookahead=la)
+        lu, info = res[0], res[-1]
+        assert int(info) == 0
+        perm = res[1] if len(res) == 3 else None
+        outs[la] = (
+            np.asarray(to_dense(lu)),
+            None if perm is None else np.asarray(perm),
+        )
+    for la in DEPTHS[1:]:
+        np.testing.assert_array_equal(outs[la][0], outs[0][0])
+        if outs[0][1] is not None:
+            np.testing.assert_array_equal(outs[la][1], outs[0][1])
+
+
+@pytest.mark.parametrize("uplo", [Uplo.Lower, Uplo.Upper])
+@pytest.mark.parametrize("op", [Op.NoTrans, Op.Trans])
+def test_lookahead_trsm_dist_bitwise(rng, uplo, op):
+    mesh = mesh24()
+    t = np.tril(rng.standard_normal((N, N))) + N * np.eye(N)
+    ad = from_dense(jnp.asarray(t), mesh, NB, diag_pad_one=True)
+    bd = from_dense(_rand(rng, N, 2 * NB), mesh, NB)
+    for method in (MethodTrsm.TrsmB, MethodTrsm.TrsmA):
+        outs = {
+            la: to_dense(trsm_dist(ad, bd, uplo, op, method=method, lookahead=la))
+            for la in DEPTHS
+        }
+        _assert_bitwise(outs, f"trsm_dist[{uplo},{op},{method}]")
+
+
+def test_lookahead_trsm_dist_right_bitwise(rng):
+    mesh = mesh24()
+    t = np.tril(rng.standard_normal((N, N))) + N * np.eye(N)
+    ad = from_dense(jnp.asarray(t), mesh, NB, diag_pad_one=True)
+    bd = from_dense(_rand(rng, N, N), mesh, NB)
+    for op in (Op.NoTrans, Op.Trans):
+        outs = {
+            la: to_dense(trsm_dist_right(ad, bd, Uplo.Lower, op, lookahead=la))
+            for la in DEPTHS
+        }
+        _assert_bitwise(outs, f"trsm_dist_right[{op}]")
+
+
+def test_lookahead_blas3_bitwise(rng):
+    mesh = mesh24()
+    h = _rand(rng, N, N, cplx=True)
+    hd = from_dense(h + jnp.conj(h).T, mesh, NB)
+    bd = from_dense(_rand(rng, N, N, cplx=True), mesh, NB)
+    outs = {
+        la: to_dense(
+            hemm_summa(Side.Left, 1.0, hd, bd, uplo=Uplo.Lower,
+                       method=MethodHemm.HemmC, lookahead=la)
+        )
+        for la in DEPTHS
+    }
+    _assert_bitwise(outs, "hemm_summa")
+
+    t = np.tril(rng.standard_normal((N, N))) + np.eye(N)
+    td = from_dense(jnp.asarray(t), mesh, NB, diag_pad_one=True)
+    gd = from_dense(_rand(rng, N, N), mesh, NB)
+    outs = {
+        la: to_dense(
+            trmm_dist(Side.Left, Uplo.Lower, Op.NoTrans, Diag.NonUnit, 1.0,
+                      td, gd, lookahead=la)
+        )
+        for la in DEPTHS
+    }
+    _assert_bitwise(outs, "trmm_dist")
+
+    a2 = from_dense(_rand(rng, N, N), mesh, NB)
+    b2 = from_dense(_rand(rng, N, N), mesh, NB)
+    outs = {
+        la: to_dense(her2k_dist(1.0, a2, b2, lookahead=la)) for la in DEPTHS
+    }
+    _assert_bitwise(outs, "her2k_dist")
+
+
+def test_lookahead_depth_clamps_past_trip_count(rng):
+    # depth > nt must clamp (all panels prefetched up front), not crash
+    mesh = mesh24()
+    a = from_dense(_rand(rng, N, N), mesh, NB)
+    b = from_dense(_rand(rng, N, N), mesh, NB)
+    deep = to_dense(gemm_summa(1.0, a, b, method=MethodGemm.GemmC, lookahead=99))
+    strict = to_dense(gemm_summa(1.0, a, b, method=MethodGemm.GemmC, lookahead=0))
+    np.testing.assert_array_equal(np.asarray(deep), np.asarray(strict))
+    assert la_depth(99, 8) == 8 and la_depth(None, 8) == 1 and la_depth(-3, 8) == 0
+
+
+# ---------------------------------------------------------------------------
+# option plumbing: drivers, api facades, defaults
+# ---------------------------------------------------------------------------
+
+
+def test_lookahead_option_default_is_one():
+    assert get_option(None, Option.Lookahead) == 1
+    assert get_option({Option.Lookahead: 3}, Option.Lookahead) == 3
+    assert get_option({"lookahead": 2}, Option.Lookahead) == 2
+
+
+def test_lookahead_plumbs_through_mesh_driver_opts(rng):
+    """gemm_mesh(opts={Lookahead: d}) must reach the kernel: the audit
+    record layout is the fingerprint (depth 0 -> all records scoped at
+    multiplicity kt; depth 2 -> 2 prologue records per operand at
+    multiplicity 1 + loop records at kt - 2)."""
+    mesh = mesh24()
+    a, b = _rand(rng, N, N), _rand(rng, N, N)
+    kt = N // NB
+
+    def records_for(depth):
+        jax.clear_caches()  # audit hooks record at trace time only
+        with comm_audit() as recs:
+            gemm_mesh(1.0, a, b, mesh, nb=NB,
+                      opts={Option.Lookahead: depth}).block_until_ready()
+        return [(op, nb_, m) for op, nb_, m in recs]
+
+    strict = records_for(0)
+    deep = records_for(2)
+    assert {m for _, _, m in strict} == {kt}
+    # depth 2: each of the two psum streams shows 2 prologue fetches + a
+    # shortened loop — the "when" changed...
+    assert sorted({m for _, _, m in deep}) == [1, kt - 2]
+    # ...but the total payload did not (the "how many" invariant)
+    total = lambda rs: sum(nb_ * m for _, nb_, m in rs)
+    assert total(deep) == total(strict)
+
+
+def test_lookahead_factor_kernels_keep_audit_records_identical(rng):
+    """The deferred-update pipeline (potrf) keeps the very same audit
+    records: panel broadcasts stay in the loop at full multiplicity —
+    bytes move at execution time (XLA overlap), not at the audit level."""
+    mesh = mesh24()
+    a = _rand(rng, N, N)
+    spd = a @ a.T + N * jnp.eye(N)
+    ad = from_dense(spd, mesh, NB, diag_pad_one=True)
+
+    def records_for(depth):
+        jax.clear_caches()
+        with comm_audit() as recs:
+            potrf_dist(ad, lookahead=depth)[0].tiles.block_until_ready()
+        return sorted(recs)
+
+    assert records_for(1) == records_for(0)
+
+
+def test_lookahead_accepted_by_api_facades(rng):
+    import slate_tpu.api as api
+
+    a = _rand(rng, 32, 32)
+    b = _rand(rng, 32, 32)
+    opts = {Option.Lookahead: 2}
+    c = api.multiply(1.0, a, b, opts=opts)
+    np.testing.assert_allclose(
+        np.asarray(c), np.asarray(a) @ np.asarray(b), rtol=1e-12, atol=1e-10
+    )
+    t = jnp.asarray(np.tril(np.asarray(a)) + 32 * np.eye(32))
+    x = api.triangular_solve(Side.Left, 1.0, t, b, opts=opts)
+    np.testing.assert_allclose(
+        np.asarray(t) @ np.asarray(x), np.asarray(b), rtol=1e-10, atol=1e-8
+    )
+
+
+def test_posv_mesh_opts_bitwise(rng):
+    """Driver-level plumbing: the full posv chain (potrf + 2 trsm) under
+    explicit strict/deep opts stays bitwise identical."""
+    from slate_tpu.parallel import posv_mesh
+
+    mesh = mesh24()
+    a = rng.standard_normal((50, 50))
+    spd = jnp.asarray(a @ a.T + 50 * np.eye(50))
+    b = _rand(rng, 50, 3)
+    outs = {}
+    for la in DEPTHS:
+        x, info = posv_mesh(spd, b, mesh, nb=NB, opts={Option.Lookahead: la})
+        assert int(info) == 0
+        outs[la] = x
+    _assert_bitwise(outs, "posv_mesh")
+
+
+# ---------------------------------------------------------------------------
+# prefetch_bcast unit contract
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_bcast_fetch_counts():
+    """d prologue + (nt - d) in-loop + 0 epilogue fetches == nt, and every
+    step consumes its own panel exactly once, in order."""
+    nt = 7
+    for depth in (0, 1, 3, 7, 99):
+        fetched, consumed = [], []
+
+        def fetch(k):
+            fetched.append(k)
+            return jnp.zeros((2,)) + (k if isinstance(k, int) else 0)
+
+        def consume(k, panel, acc):
+            consumed.append(k)
+            return acc + jnp.sum(panel)
+
+        prefetch_bcast(nt, depth, fetch, consume, jnp.zeros(()))
+        # trace-time counts: the loop body traces exactly once (even for a
+        # zero-trip loop), so python-level fetch calls are d prologue + 1
+        # loop body; consumes are d epilogue + 1 loop body
+        d = min(max(depth, 0), nt)
+        want = (d + 1) if d > 0 else 1
+        assert len(fetched) == want, (depth, fetched)
+        assert len(consumed) == want, (depth, consumed)

@@ -1023,11 +1023,11 @@ def test_band_mesh_kernels_band_cost(rng):
             ca = ca[0]
         return ca["flops"]
 
-    dense = _potrf_jit.lower(tiles, mesh, 2, 4, nt).compile()
-    band = _pbtrf_band_jit.lower(tiles, mesh, 2, 4, nt, wd).compile()
+    dense = _potrf_jit.lower(tiles, mesh, 2, 4, nt, 1).compile()
+    band = _pbtrf_band_jit.lower(tiles, mesh, 2, 4, nt, wd, 1).compile()
     assert flops(band) < flops(dense) / 4, (flops(band), flops(dense))
 
-    dense_lu = _pp_jit.lower(tiles, mesh, 2, 4, nt, n).compile()
+    dense_lu = _pp_jit.lower(tiles, mesh, 2, 4, nt, n, 1).compile()
     wd_u = ((nb - 1) + 2 * kd) // nb + 1
     wd_usw = ((nb - 1) + 3 * kd) // nb + 1
     band_lu = _gb_pp_jit.lower(tiles, mesh, 2, 4, nt, n, wd, wd_u, wd_usw).compile()
