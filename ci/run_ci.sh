@@ -34,6 +34,13 @@ fi
 # a synthetic 2x regression (slate_tpu/obs/smoke.py validates all of it)
 python -m slate_tpu.obs.smoke --out artifacts/obs
 
+# ft smoke: the ABFT acceptance run — one injected single-tile fault per
+# op class (SUMMA gemm / mesh potrf / LU-nopiv) must be detected and
+# corrected on the 8-device mesh, the recompute + FtError escalations
+# must fire, and the ft.* counters must land in a schema-valid RunReport
+# so detection-coverage regressions gate like perf (slate_tpu/ft/smoke.py)
+python -m slate_tpu.ft.smoke --out artifacts/ft
+
 # ruff / mypy: configured in pyproject.toml; the container image may not
 # ship them, so gate on availability rather than skipping silently
 if command -v ruff > /dev/null 2>&1; then

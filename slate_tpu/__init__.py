@@ -60,7 +60,7 @@ from .blas3 import (
     trsm,
 )
 
-from . import api, linalg, obs, ops, parallel
+from . import api, ft, linalg, obs, ops, parallel
 from .linalg import (
     bdsqr,
     gecondest,

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from .blas3 import blas3
 from .core.matrix import BaseMatrix, HermitianMatrix, TriangularMatrix
 from .linalg import chol, eig, indefinite, lu, norms, qr, svd as svd_mod, tri
-from .types import Diag, MethodLU, Norm, Op, Options, Side, Uplo
+from .types import Diag, MethodLU, Norm, Op, Options, Side, Uplo, get_option
 
 Array = jax.Array
 ArrayLike = Union[Array, BaseMatrix]
@@ -30,7 +30,23 @@ def multiply(alpha, a: ArrayLike, b: ArrayLike, beta=0.0, c: Optional[ArrayLike]
     in ``opts`` selects the accumulation tier (types.Precision);
     Option.Lookahead is accepted here and consumed by the explicitly
     sharded mesh drivers (parallel.drivers / parallel.summa) — XLA's
-    partitioner schedules the single-array form on its own."""
+    partitioner schedules the single-array form on its own.
+    Option.FaultTolerance (ABFT policy, types.Option) routes this
+    single-array form through ft.abft.gemm_checked: the product and its
+    row/column checksums are computed by independent programs and
+    compared, with single-tile damage repaired under ``correct`` —
+    the mesh drivers run the full checksum-carrying SUMMA instead."""
+    from .ft.policy import FtPolicy, resolve_policy
+
+    policy = resolve_policy(opts)
+    if policy != FtPolicy.Off:
+        from .ft.abft import gemm_checked
+        from .types import Option
+
+        nb = int(get_option(opts, Option.BlockSize, default=32))
+        return gemm_checked(alpha, blas3._arr(a), blas3._arr(b), beta,
+                            None if c is None else blas3._arr(c),
+                            nb=nb, policy=policy)
     if c is None:
         am, bm = blas3._arr(a), blas3._arr(b)
         c = jnp.zeros((am.shape[0], bm.shape[1]), am.dtype)

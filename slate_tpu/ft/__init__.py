@@ -1,0 +1,49 @@
+"""Algorithm-based fault tolerance (ABFT) for the distributed kernels.
+
+The robustness axis of the reproduction (the analogue of what ``obs/`` is
+for observability): checksum-carrying variants of the core mesh kernels
+detect — and where the algebra allows, correct — silent single-tile data
+corruption, in the style of Huang & Abraham (1984) generalized to full
+factorizations by Du, Bosilca & Dongarra (PPoPP 2012).
+
+- ``checksum``: tile-level row/column checksum encode / verify / locate /
+  correct over the 2D block-cyclic layout.  Two weighted checksum tile
+  rows (unit + ramp weights) bound a corrupted tile's row index by the
+  discrepancy ratio; the checksum tiles are ORDINARY tiles of the grid,
+  so they ride every existing panel broadcast unchanged.
+- ``abft``: checksum-carrying SUMMA gemm, mesh Cholesky and LU-nopiv —
+  the augmented operands flow through the same ``comm.prefetch_bcast`` /
+  ``comm.pipelined_factor_loop`` schedules as the plain kernels, with
+  pure-JAX fault-injection hooks at the panel / broadcast / trailing
+  phases of every k-step.
+- ``inject``: deterministic seeded fault plans (zero / scale /
+  bitflip-style element perturbation of a chosen tile at a chosen k-step
+  on a chosen mesh coordinate), transient (one-shot) or persistent.
+- ``policy``: the per-op ``FtPolicy`` knob (off | detect | correct |
+  recompute) plumbed as ``Option.FaultTolerance`` through
+  ``parallel/drivers.py`` and ``api.py``, the structured ``FtError``,
+  and the ``ft.*`` obs counters.
+- ``python -m slate_tpu.ft.smoke`` is the CI acceptance run: one
+  injected fault per op class on the 8-device CPU mesh, detection +
+  correction asserted, ``ft.*`` counters emitted through a RunReport.
+"""
+
+from .policy import (  # noqa: F401
+    FtError,
+    FtPolicy,
+    FtReport,
+    ft_counter_values,
+    resolve_policy,
+)
+from .inject import Fault, FaultPlan, fault_scope  # noqa: F401
+
+__all__ = [
+    "FtError",
+    "FtPolicy",
+    "FtReport",
+    "ft_counter_values",
+    "resolve_policy",
+    "Fault",
+    "FaultPlan",
+    "fault_scope",
+]

@@ -1,0 +1,106 @@
+"""Tile-level ABFT checksums over the block-cyclic layout.
+
+The Huang & Abraham (1984) scheme at nb-tile granularity: a matrix padded
+to its (mt, nt) tile grid gains TWO checksum tile rows,
+
+    CS1[:, j] = sum_i  T(i, j)            (unit weights)
+    CS2[:, j] = sum_i (i + 1) T(i, j)     (ramp weights)
+
+(and symmetrically two checksum tile columns).  Both are linear in the
+rows, so BLAS-3 tile algebra maintains them: GEMM maps them to the
+checksums of C, a right-looking factorization forward-substitutes them
+into the checksums of the output factor (Du, Bosilca & Dongarra, PPoPP
+2012).  The checksum tiles are ORDINARY tiles appended to the grid, so
+on the mesh they are just more shards riding the existing panel
+broadcasts — no new collectives, ~2/p extra flops (plus the lcm grid
+padding on small meshes; see README "Fault tolerance" for the exact
+overhead model and tests/test_comm_audit.py for the proven byte count).
+
+Verification recomputes the tile sums of the output and differences them
+against the carried checksum tiles.  A single corrupted tile row leaves
+per-column discrepancies D1[j] = -E(i*, j), D2[j] = -(i* + 1) E(i*, j):
+the ratio D2/D1 LOCATES the row i*, and adding D1[j] back restores the
+data exactly — including the clean run's rounding, since D1 carries it.
+
+Everything here is either pure-jnp (traceable, used inside the jitted
+verify passes) or plain-numpy host logic (thresholding / location),
+split so slate_lint can trace the jnp parts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# detection threshold: a tile-column discrepancy is a FAULT when its
+# magnitude exceeds TOL_FACTOR * n_ops * eps * column_scale.  The clean
+# residual of a sum of k products is O(sqrt(k) * eps * scale); the factor
+# leaves ~3 orders of margin to the faults worth injecting while keeping
+# clean f32 runs quiet (tests/test_ft.py::test_detect_clean).
+TOL_FACTOR = 64.0
+
+
+def pad_dense(a: jax.Array, rows: int, cols: int) -> jax.Array:
+    m, n = a.shape
+    return jnp.pad(a, ((0, rows - m), (0, cols - n)))
+
+
+def row_checksums(ap: jax.Array, nb: int) -> jax.Array:
+    """(mt*nb, N) -> (2*nb, N): unit-sum tile row stacked on ramp-sum."""
+    mt = ap.shape[0] // nb
+    t = ap.reshape(mt, nb, ap.shape[1])
+    w = jnp.arange(1, mt + 1, dtype=ap.dtype)
+    return jnp.concatenate([t.sum(0), (w[:, None, None] * t).sum(0)], axis=0)
+
+
+def col_checksums(ap: jax.Array, nb: int) -> jax.Array:
+    """(M, nt*nb) -> (M, 2*nb): unit and ramp tile-column sums."""
+    nt = ap.shape[1] // nb
+    t = ap.reshape(ap.shape[0], nt, nb)
+    w = jnp.arange(1, nt + 1, dtype=ap.dtype)
+    return jnp.concatenate([t.sum(1), (w[None, :, None] * t).sum(1)], axis=1)
+
+
+def ratio_locate(
+    d1_blk: np.ndarray, d2_blk: np.ndarray, axis_len: int
+) -> int:
+    """Row (resp. column) index from the ramp/unit discrepancy ratio of
+    one tile block: uses the element of largest |d1| for a well-scaled
+    quotient.  Returns -1 when the ratio is not a consistent integer in
+    range — the can't-locate signal."""
+    if not (np.isfinite(d1_blk).all() and np.isfinite(d2_blk).all()):
+        return -1  # NaN/Inf-poisoned: detectable, never locatable
+    flat = np.abs(d1_blk).ravel()
+    if flat.max() == 0:
+        return -1
+    at = int(flat.argmax())
+    ratio = d2_blk.ravel()[at] / d1_blk.ravel()[at]
+    if not np.isfinite(ratio):
+        return -1
+    idx = int(np.rint(ratio)) - 1
+    if not (0 <= idx < axis_len) or abs(ratio - np.rint(ratio)) > 0.25:
+        return -1
+    return idx
+
+
+def threshold(nt_ops: int, dtype, scale: float) -> float:
+    eps = float(jnp.finfo(dtype).eps)
+    return TOL_FACTOR * max(nt_ops, 1) * eps * max(scale, 1.0)
+
+
+def flag_mismatches(d: np.ndarray, tol: float) -> np.ndarray:
+    """Indices where the per-tile discrepancy exceeds the threshold.
+    Non-finite discrepancies are faults by definition (a NaN-poisoned
+    factor must not read as clean because NaN compares false)."""
+    d = np.asarray(d)
+    return np.nonzero((d > tol) | ~np.isfinite(d))[0]
+
+
+def finite_max(a: np.ndarray) -> float:
+    """Max-abs with non-finite entries treated as 1 — keeps detection
+    thresholds finite on poisoned data (the poison itself is flagged by
+    ``flag_mismatches``)."""
+    return float(
+        np.nan_to_num(np.abs(a), nan=1.0, posinf=1.0, neginf=1.0).max()
+    )

@@ -1,0 +1,194 @@
+"""Deterministic, seeded fault injection for the checksum-carrying kernels.
+
+A ``Fault`` names one perturbation: which op class, which k-step, which
+phase of that step, which logical tile, which mesh coordinate, and how to
+corrupt it.  Faults are applied PURE-JAX inside the jitted abft kernels:
+the active plan is lowered to two small replicated spec arrays (ints +
+values) that ride the kernel as ordinary dynamic operands, so arming /
+disarming a fault never retriggers compilation and the same compiled
+kernel serves clean runs, injected runs and recompute reruns — which is
+what makes the recompute escalation cheap.
+
+Phases (the three places a tile can silently rot in a distributed
+right-looking step):
+
+- ``panel``: the owner's STORED copy of a finalized panel tile is
+  corrupted after the broadcast was issued (an HBM fault after the NIC
+  read the data).  The clean broadcast copy fed every consumer, so the
+  damage stays in one output tile — the exactly-correctable class.
+- ``bcast``: the RECEIVED broadcast copy on one mesh coordinate is
+  corrupted before that device's trailing update consumes it — live-data
+  corruption that propagates; detectable, repaired by recompute.
+- ``trailing``: one trailing-matrix tile is corrupted right after the
+  step-k update lands — live for factorizations (propagates through
+  later panels), final for GEMM's accumulator (exactly correctable).
+
+``persist=False`` (default) models transient SDC: the fault fires on the
+first kernel invocation that matches, then disarms — a recompute rerun
+executes clean.  ``persist=True`` models a hard/recurring fault (stuck-at
+memory): every rerun re-injects, so the recompute escalation re-detects
+and the driver raises ``FtError`` — the graceful-degradation path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+# phase ids shared with the abft kernels
+PH_NONE, PH_PANEL, PH_BCAST, PH_TRAIL = 0, 1, 2, 3
+_PHASES = {"panel": PH_PANEL, "bcast": PH_BCAST, "trailing": PH_TRAIL}
+# corruption modes
+MODE_ZERO, MODE_SCALE, MODE_FLIP = 1, 2, 3
+
+# fixed spec capacity: the kernels always consume MAX_FAULTS slots so the
+# compiled shape never depends on how many faults are armed
+MAX_FAULTS = 2
+# int spec columns: [active, k, phase, ti, tj, r, c, mode]
+_ICOLS = 8
+
+
+@dataclass
+class Fault:
+    op: str  # "gemm" | "potrf" | "getrf_nopiv"
+    k: int  # loop step the fault fires at
+    phase: str  # "panel" | "bcast" | "trailing"
+    ti: int  # logical tile row of the target
+    tj: int  # logical tile column (panel/bcast: the step's column/row)
+    r: int  # target mesh row (bcast: the receiving device)
+    c: int  # target mesh column
+    mode: int = MODE_SCALE
+    value: float = 3.0  # scale factor / flip addend
+    persist: bool = False  # True = re-inject on every invocation
+
+    def phase_id(self) -> int:
+        return _PHASES[self.phase]
+
+
+@dataclass
+class FaultPlan:
+    """An armed set of faults plus the one-shot bookkeeping."""
+
+    faults: List[Fault] = field(default_factory=list)
+    _spent: set = field(default_factory=set)
+
+    def armed(self, op: str) -> List[Fault]:
+        return [
+            f
+            for f in self.faults
+            if f.op == op and (f.persist or id(f) not in self._spent)
+        ]
+
+    def consume(self, op: str) -> None:
+        """Mark this op's non-persistent faults as delivered (called by
+        the ft driver right after the kernel ran with them armed)."""
+        for f in self.faults:
+            if f.op == op and not f.persist:
+                self._spent.add(id(f))
+
+
+_tls = threading.local()
+
+
+def current_plan() -> Optional[FaultPlan]:
+    return getattr(_tls, "plan", None)
+
+
+@contextlib.contextmanager
+def fault_scope(plan: Optional[FaultPlan]):
+    """Activate ``plan`` for every ft driver call in the dynamic scope.
+    Nesting replaces (does not merge) the active plan."""
+    old = current_plan()
+    _tls.plan = plan
+    try:
+        yield plan
+    finally:
+        _tls.plan = old
+
+
+def spec_arrays(op: str, dtype=np.float64) -> Tuple[np.ndarray, np.ndarray]:
+    """Lower the active plan to the kernel spec: ints (MAX_FAULTS, 7)
+    int32 + values (MAX_FAULTS,) float.  Disarmed slots are all-zero
+    (active=0) — the kernels' masks make them exact no-ops."""
+    ints = np.zeros((MAX_FAULTS, _ICOLS), np.int32)
+    vals = np.zeros((MAX_FAULTS,), dtype)
+    plan = current_plan()
+    if plan is None:
+        return ints, vals
+    armed = plan.armed(op)
+    if len(armed) > MAX_FAULTS:
+        # never silently drop planned faults: the kernel spec has a fixed
+        # capacity, and consume() would mark the dropped ones spent — a
+        # test asserting n-fault behavior must fail loudly, not vacuously
+        raise ValueError(
+            f"FaultPlan arms {len(armed)} faults for {op!r}; the kernel "
+            f"spec carries at most MAX_FAULTS={MAX_FAULTS}"
+        )
+    for s, f in enumerate(armed):
+        ints[s] = (1, f.k, f.phase_id(), f.ti, f.tj, f.r, f.c, f.mode)
+        vals[s] = f.value
+    return ints, vals
+
+
+def consume(op: str) -> None:
+    plan = current_plan()
+    if plan is not None:
+        plan.consume(op)
+
+
+def seeded_fault(
+    seed: int,
+    op: str,
+    nt: int,
+    grid: Tuple[int, int],
+    phase: Optional[str] = None,
+    persist: bool = False,
+) -> Fault:
+    """One deterministic fault for ``op`` on an ``nt``-step loop over a
+    (p, q) mesh.  The draw respects each phase's targeting contract:
+
+    - panel: target a finalized panel-column tile (ti > k, tj = k), on
+      the owner coordinate — the exactly-correctable store fault.
+    - bcast: corrupt the received column-panel copy of tile row ti at
+      step k on one (forced row, free column) coordinate.
+    - trailing: a live trailing tile (ti, tj) strictly inside the
+      not-yet-factored block (ti, tj >= k + 2, so no lookahead-narrow
+      slot ambiguity), on its owner coordinate.
+    """
+    rng = np.random.default_rng(seed)
+    p, q = grid
+    if phase is None:
+        # gemm has no stored panel: its phases are bcast / trailing
+        phase = str(rng.choice(
+            ["bcast", "trailing"] if op == "gemm" else list(_PHASES)
+        ))
+    if op == "gemm" and phase == "panel":
+        raise ValueError("gemm has no panel-store phase; use bcast or trailing")
+    if nt < 4:
+        raise ValueError(f"seeded_fault needs nt >= 4 (got {nt})")
+    mode = int(rng.choice([MODE_ZERO, MODE_SCALE, MODE_FLIP]))
+    value = float(rng.choice([2.0, 3.0, 1e3]))
+    if phase == "panel":
+        k = int(rng.integers(0, nt - 1))
+        ti = int(rng.integers(k + 1, nt))
+        return Fault(op, k, phase, ti, k, ti % p, k % q, mode, value, persist)
+    if phase == "bcast":
+        k = int(rng.integers(0, nt - 1))
+        ti = int(rng.integers(k + 1, nt))
+        # receiving column: free for gemm (every column's C tiles consume
+        # the panel); for factorizations pin the column that owns tile
+        # (ti, ti) — elsewhere the trailing mask can swallow the corrupted
+        # slot entirely, making the fault a (correctly undetected) no-op
+        fc = int(rng.integers(0, q)) if op == "gemm" else ti % q
+        return Fault(op, k, phase, ti, k, ti % p, fc, mode, value, persist)
+    k = int(rng.integers(0, nt - 2))
+    ti = int(rng.integers(k + 2, nt))
+    tj = int(rng.integers(k + 2, nt))
+    if op == "potrf" and ti < tj:
+        ti, tj = tj, ti  # Cholesky's upper triangle is dead storage:
+        # a fault there never reaches the factor (harmless, undetected)
+    return Fault(op, k, "trailing", ti, tj, ti % p, tj % q, mode, value, persist)

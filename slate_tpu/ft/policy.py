@@ -1,0 +1,115 @@
+"""Fault-tolerance policy, error type and counters.
+
+``FtPolicy`` is the per-op knob (``Option.FaultTolerance``):
+
+- ``off``: the plain kernels run untouched — bitwise-identical results.
+- ``detect``: checksum-carrying kernels; a detected inconsistency is
+  fail-stop (``FtError`` with the located damage).
+- ``correct``: try the algebraic locate-and-correct first (exact for any
+  single-tile fault in GEMM output and for faults in finalized factor
+  tiles); escalate to one full recompute when the corruption fed later
+  steps; ``FtError`` when the recompute also verifies dirty
+  (multi-tile / persistent corruption).
+- ``recompute``: skip the algebra — any detection triggers one full
+  recompute, then ``FtError`` if still dirty.
+
+Detections / corrections land in the obs metrics registry as ``ft.*``
+counters (tagged with the op name), so a RunReport carries them and
+``obs.report --check`` can gate detection-coverage regressions like any
+perf metric.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+from ..types import Option, Options, SlateError, get_option
+
+
+class FtPolicy(enum.Enum):
+    Off = "off"
+    Detect = "detect"
+    Correct = "correct"
+    Recompute = "recompute"
+
+
+class FtError(SlateError):
+    """Structured ABFT failure: corruption was detected but could not be
+    (or per policy, was not to be) repaired.  Carries the located damage
+    so callers can log / re-dispatch."""
+
+    def __init__(self, op: str, reason: str, detections: Optional[List[dict]] = None):
+        self.op = op
+        self.reason = reason
+        self.detections = list(detections or [])
+        where = "; ".join(
+            f"{d.get('kind', '?')}@{d.get('where', '?')}" for d in self.detections
+        ) or "unlocated"
+        super().__init__(f"ft[{op}]: {reason} ({where})")
+
+
+@dataclass
+class FtReport:
+    """Per-call outcome the rich ft drivers return next to their result.
+
+    ``action`` is one of ``clean | corrected | recomputed``; a run that
+    raises ``FtError`` produces no report.  ``detections`` lists dicts
+    with ``kind`` (row/col/tile), ``where`` (tile coordinates) and the
+    discrepancy magnitude."""
+
+    op: str
+    action: str = "clean"
+    detections: List[dict] = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return self.action == "clean" and not self.detections
+
+
+def resolve_policy(opts: Optional[Options]) -> FtPolicy:
+    """``Option.FaultTolerance`` from an ``opts`` mapping.  Accepts the
+    enum or its string value; absent / None means ``off`` (the plain
+    kernels — FT is a strict opt-in, matching the reference's stance that
+    resilience features never tax the default path)."""
+    raw: Any = get_option(opts, Option.FaultTolerance, default=FtPolicy.Off)
+    if raw is None:
+        return FtPolicy.Off
+    if isinstance(raw, FtPolicy):
+        return raw
+    try:
+        return FtPolicy(str(raw))
+    except ValueError:
+        raise ValueError(
+            f"Option.FaultTolerance must be one of "
+            f"{[p.value for p in FtPolicy]}, got {raw!r}"
+        ) from None
+
+
+# -- counters ----------------------------------------------------------------
+
+_COUNTERS = ("ft.detected", "ft.corrected", "ft.recomputed", "ft.uncorrectable")
+
+
+def _registry():
+    from ..obs import REGISTRY
+
+    return REGISTRY
+
+
+def count(name: str, op: str, n: float = 1.0) -> None:
+    """Bump one ``ft.*`` counter, tagged by op (always on: detection
+    events are rare and load-bearing, unlike span timings)."""
+    _registry().counter_add(name, n, op=op)
+
+
+def ft_counter_values() -> dict:
+    """Totals of every ``ft.*`` counter across op tags — the RunReport
+    ``ft`` section (obs.report.make_report reads this)."""
+    snap = _registry().snapshot()
+    out = {name.split("ft.", 1)[1]: 0.0 for name in _COUNTERS}
+    for entry in snap.get("counters", []):
+        if entry["name"] in _COUNTERS:
+            out[entry["name"].split("ft.", 1)[1]] += float(entry["value"])
+    return out

@@ -32,7 +32,8 @@ VERSION = 1
 
 # substrings marking a metric as lower-is-better; everything else
 # (gflops, gops, value, mfu, ...) is treated as higher-is-better
-_LOWER_BETTER = ("second", "time", "byte", "error", "err", "resid", "latency")
+_LOWER_BETTER = ("second", "time", "byte", "error", "err", "resid", "latency",
+                 "uncorrectable")
 
 # pure cost-model estimates with no better/worse direction: halving the
 # XLA flop estimate is usually an optimization, doubling may be a bigger
@@ -67,6 +68,8 @@ def make_report(
     stream, plus explicit headline ``values``."""
     spans = list(_span.FINISHED) if include_spans else []
     base = min((s["t0"] for s in spans), default=0.0)
+    from ..ft.policy import ft_counter_values
+
     return {
         "schema": SCHEMA,
         "version": VERSION,
@@ -75,6 +78,9 @@ def make_report(
         "env": _env_info(),
         "config": dict(config or {}),
         "values": {k: float(v) for k, v in (values or {}).items()},
+        # fault-tolerance outcome totals (ft.* counters): detections /
+        # corrections / recomputes / uncorrectables accumulated this run
+        "ft": ft_counter_values(),
         "metrics": REGISTRY.snapshot(),
         "spans": [
             {
@@ -122,6 +128,12 @@ def validate_report(rep) -> List[str]:
         not isinstance(m.get(k), list) for k in ("counters", "gauges", "histograms")
     ):
         errs.append("metrics must hold counters/gauges/histograms lists")
+    ftv = rep.get("ft")  # optional (reports predate the ft section)
+    if ftv is not None and (
+        not isinstance(ftv, dict)
+        or any(not isinstance(v, (int, float)) for v in ftv.values())
+    ):
+        errs.append("ft must map outcome name -> number")
     spans = rep.get("spans")
     if not isinstance(spans, list):
         errs.append("spans must be a list")
@@ -147,6 +159,19 @@ def load_values(doc: dict, include_series: bool = False) -> Dict[str, float]:
     vals: Dict[str, float] = {}
     if doc.get("schema") == SCHEMA:
         vals.update(doc.get("values", {}))
+        # ft.* outcome totals gate like any metric: under a fixed fault
+        # injection (ft.smoke), a drop in detected/corrected is a
+        # detection-coverage regression — including a collapse to zero
+        # (check_regression fails higher-is-better metrics that hit 0).
+        # An ALL-zero section (no FT activity this run) stays out of the
+        # comparison surface entirely: those zeros cannot gate and would
+        # pollute headline-values-only comparisons.  The fully-lost-
+        # coverage case (every counter zero under injection) is gated by
+        # ft.smoke's absolute assertions, not this relative check.
+        ftvals = {k: v for k, v in (doc.get("ft") or {}).items()
+                  if isinstance(v, (int, float))}
+        if any(ftvals.values()):
+            vals.update({f"ft_{k}": float(v) for k, v in ftvals.items()})
         if include_series:
             vals.update(flatten_snapshot(doc.get("metrics", {})))
         return {k: float(v) for k, v in vals.items()
@@ -201,6 +226,14 @@ def check_regression(
         if name.split("|", 1)[0] in _NEUTRAL:
             continue  # directionless cost estimates never gate
         old, new = old_vals[name], new_vals[name]
+        if old != 0 and new == 0 and not lower_is_better(name):
+            # a higher-is-better metric collapsing to exactly zero is the
+            # worst regression, not an undefined ratio (e.g. ft_detected
+            # 5 -> 0 under a fixed fault injection = detection coverage
+            # lost; gflops -> 0 = the op never ran)
+            compared += 1
+            failures.append(f"{name}: collapsed to 0 (was {old:.4g})")
+            continue
         if old == 0 or new == 0:
             continue  # ratios undefined; absolute-zero metrics can't gate
         if (old < 0) != (new < 0):

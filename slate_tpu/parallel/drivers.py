@@ -45,6 +45,18 @@ def _la(opts: Optional[Options]):
     return get_option(opts, Option.Lookahead)
 
 
+def _ft_on(opts: Optional[Options]) -> bool:
+    """True when Option.FaultTolerance selects an active ABFT policy.
+    Off (the default) keeps this module on the plain kernels with zero
+    overhead — results stay bitwise-identical; any active policy routes
+    to the checksum-carrying variants in slate_tpu/ft/abft.py (also
+    validates the option value, so a typo'd policy fails loudly here
+    instead of silently running unprotected)."""
+    from ..ft.policy import FtPolicy, resolve_policy
+
+    return resolve_policy(opts) != FtPolicy.Off
+
+
 @instrument("gemm_mesh")
 def gemm_mesh(
     alpha, a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
@@ -52,7 +64,13 @@ def gemm_mesh(
     opts: Optional[Options] = None,
 ) -> jax.Array:
     """Distributed C = alpha A B (+ beta C) via SUMMA (src/gemmC.cc).
-    ``opts`` carries Option.Lookahead (panel-prefetch depth)."""
+    ``opts`` carries Option.Lookahead (panel-prefetch depth) and
+    Option.FaultTolerance (ABFT policy; any active policy reroutes to
+    the checksum-carrying SUMMA in ft/abft.py)."""
+    if _ft_on(opts):
+        from ..ft.abft import gemm_mesh_ft
+
+        return gemm_mesh_ft(alpha, a, b, mesh, nb, beta, c, opts)
     ad = from_dense(a, mesh, nb)
     bd = from_dense(b, mesh, nb)
     cd = from_dense(c, mesh, nb) if c is not None else None
@@ -64,7 +82,13 @@ def potrf_mesh(
     a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
     opts: Optional[Options] = None,
 ) -> Tuple[DistMatrix, jax.Array]:
-    """Distributed lower Cholesky; input is the full/lower Hermitian array."""
+    """Distributed lower Cholesky; input is the full/lower Hermitian
+    array.  Option.FaultTolerance reroutes to the checksum-carrying
+    mesh loop (ft/abft.py)."""
+    if _ft_on(opts):
+        from ..ft.abft import potrf_mesh_ft
+
+        return potrf_mesh_ft(a, mesh, nb, opts)
     return potrf_dist(
         from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts)
     )
@@ -75,7 +99,10 @@ def posv_mesh(
     a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
     opts: Optional[Options] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Distributed SPD solve: potrf + two trsm sweeps (src/posv.cc)."""
+    """Distributed SPD solve: potrf + two trsm sweeps (src/posv.cc).
+    Option.FaultTolerance protects the O(n^3) factorization (rerouted
+    via potrf_mesh); the O(n^2 nrhs) trsm sweeps run unprotected —
+    the factor dominates both flops and fault exposure."""
     la = _la(opts)
     l, info = potrf_mesh(a, mesh, nb, opts)
     bd = from_dense(b, mesh, nb)
@@ -89,6 +116,12 @@ def getrf_nopiv_mesh(
     a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
     opts: Optional[Options] = None,
 ) -> Tuple[DistMatrix, jax.Array]:
+    """Option.FaultTolerance reroutes to the checksum-carrying LU-nopiv
+    mesh loop (ft/abft.py)."""
+    if _ft_on(opts):
+        from ..ft.abft import getrf_nopiv_mesh_ft
+
+        return getrf_nopiv_mesh_ft(a, mesh, nb, opts)
     return getrf_nopiv_dist(
         from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts)
     )
@@ -101,7 +134,9 @@ def gesv_nopiv_mesh(
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed LU solve without pivoting (src/gesv_nopiv path). For
     general matrices use gesv_tntpiv_mesh (tournament pivoting), the RBT
-    preconditioner (linalg.rbt), or the single-chip partial-pivot getrf."""
+    preconditioner (linalg.rbt), or the single-chip partial-pivot getrf.
+    Option.FaultTolerance protects the factorization (via
+    getrf_nopiv_mesh); the trsm sweeps run unprotected."""
     la = _la(opts)
     lu, info = getrf_nopiv_mesh(a, mesh, nb, opts)
     bd = from_dense(b, mesh, nb)

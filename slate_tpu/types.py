@@ -223,6 +223,13 @@ class Option(enum.Enum):
     PrintPrecision = "print_precision"
     Depth = "depth"  # RBT butterfly depth
     Precision = "precision"  # BLAS-3 accumulation tier (Precision enum)
+    # ABFT policy for the distributed kernels (ft.FtPolicy: off | detect |
+    # correct | recompute).  Off (the default) routes to the plain kernels
+    # untouched; any other value runs the checksum-carrying variants in
+    # slate_tpu/ft/abft.py.  No reference analogue: SLATE delegates
+    # resilience to the MPI/ULFM layer, while under XLA/SPMD the natural
+    # unit of protection is the tile algebra itself.
+    FaultTolerance = "fault_tolerance"
 
 
 Options = Mapping[Union[Option, str], Any]

@@ -168,3 +168,67 @@ def test_summa_payload_matches_analytic_bcast_volume():
             assert mults == [kt, kt]
         else:
             assert mults == [1] * (2 * la) + [kt - la] * 2
+
+
+def test_ft_summa_checksum_broadcast_volume():
+    """ISSUE 4 satellite: the ABFT overhead is proven, not estimated.
+
+    The checksum-carrying SUMMA broadcasts the same two panels per
+    k-step as the plain kernel — the checksum tiles are just more tiles
+    of the augmented grid riding the same masked psums, so the audited
+    per-device payload must equal kt * (mtl_aug + ntl_aug) * nb^2 *
+    itemsize EXACTLY, where the augmented local tile counts come from
+    appending 2 checksum tile rows/cols and re-padding to the mesh lcm.
+    The delta against the plain kernel's analytic volume is therefore
+    exactly the augmentation — no hidden collectives, no extra steps."""
+    import math
+
+    import jax.numpy as jnp
+
+    from slate_tpu.ft import abft
+    from slate_tpu.ft.policy import FtPolicy
+    from slate_tpu.parallel import make_mesh
+
+    p, q, n, nb = 2, 4, 64, 8
+    mesh = make_mesh(p, q, devices=jax.devices("cpu")[:8])
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
+    mt = nt = kt = n // nb  # already a multiple of lcm(p, q)
+    lcm = math.lcm(p, q)
+    aug = ((mt + 2 + lcm - 1) // lcm) * lcm  # +2 checksum tile rows, re-padded
+    mtl_aug, ntl_aug = aug // p, aug // q
+    itemsize = 4  # f32
+
+    jax.clear_caches()  # counters record at trace time only
+    with comm_audit() as recs:
+        c, rep = abft.gemm_ft(1.0, a, b, mesh, nb, policy=FtPolicy.Detect)
+    assert rep.clean
+    np.testing.assert_allclose(
+        np.asarray(c), np.asarray(a) @ np.asarray(b), rtol=1e-5, atol=1e-4
+    )
+
+    total = sum(nbytes * m for _, nbytes, m in recs)
+    expect_total = kt * (mtl_aug + ntl_aug) * nb * nb * itemsize
+    assert total == expect_total
+
+    # overhead vs the plain kernel's analytic volume: exactly the
+    # augmented tile rows/cols (2 checksum + lcm pad), nothing else
+    mtl, ntl = mt // p, nt // q
+    plain_total = kt * (mtl + ntl) * nb * nb * itemsize
+    assert total - plain_total == (
+        kt * ((mtl_aug - mtl) + (ntl_aug - ntl)) * nb * nb * itemsize
+    )
+
+    # per-op split: A panel rides axis 'q', B panel axis 'p', kt steps
+    # each, constant payload — same schedule shape as the plain kernel
+    steps, payload = {}, {}
+    for op, nbytes, m in recs:
+        steps[op] = steps.get(op, 0) + m
+        payload.setdefault(op, nbytes)
+        assert payload[op] == nbytes
+    assert set(steps) == {"psum[p]", "psum[q]"}
+    assert steps["psum[q]"] == kt
+    assert payload["psum[q]"] == mtl_aug * nb * nb * itemsize
+    assert steps["psum[p]"] == kt
+    assert payload["psum[p]"] == ntl_aug * nb * nb * itemsize

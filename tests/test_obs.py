@@ -362,3 +362,19 @@ def test_check_cli_inconclusive_on_unreadable_artifacts(tmp_path):
         f.write("{not json")
     assert report.main(["--check", good, garbage]) == 2
     assert report.main(["--check", good, str(tmp_path / "missing.json")]) == 2
+
+
+def test_check_regression_flags_zero_collapse():
+    # round-4 (ft PR) review finding: a higher-is-better metric hitting
+    # exactly zero must gate as a regression, not skip as an undefined
+    # ratio (ft_detected 5 -> 0 = detection coverage silently lost)
+    from slate_tpu.obs.report import check_regression
+
+    fails, n = check_regression(
+        {"x_gflops": 0.0, "ft_detected": 0.0},
+        {"x_gflops": 5.0, "ft_detected": 5.0},
+    )
+    assert n == 2 and len(fails) == 2
+    # lower-is-better hitting zero is an improvement, not a failure
+    fails, n = check_regression({"wall_seconds": 0.0}, {"wall_seconds": 5.0})
+    assert fails == []
