@@ -15,10 +15,16 @@ wins, which is what the driver's tail-parser and obs.report's legacy
 loader read), and the same line is atomically rewritten to
 ``bench_partial.json`` next to this file — so a timeout kill (rc=124,
 BENCH_r05.json's failure mode) never loses already-measured numbers.
-``SLATE_TPU_BENCH_TIMEOUT`` (seconds, 0/unset = off) is a wall-clock
-budget: extras that would start past it are skipped with a reason, and a
-SIGALRM guard aborts a mid-flight extra at the deadline instead of letting
-it eat the whole run.
+``SLATE_TPU_BENCH_TIMEOUT`` (seconds; unset = 600, an explicit 0 = off)
+is a wall-clock budget: extras that would start past it are skipped with a
+reason, and a SIGALRM guard aborts a mid-flight extra at the deadline
+instead of letting it eat the whole run.  Extras run cheapest-first, so
+the f64 n=8192 factorizations (the BENCH_r05 rc=124 culprits: unrolled
+f64 programs with O(10 min) cold compiles) land LAST — a budget kill
+costs the expensive tail, never an already-cheap middle.  A SIGTERM
+(what ``timeout`` sends before SIGKILL) re-emits the current full result
+line on the way out, so the driver's tail parser sees a complete line
+even on the kill path.
 
 vs_baseline: ratio to 19,500 GFLOP/s — the FP64 tensor-core peak of the
 A100 GPUs SLATE-CUDA runs on (its large-n DGEMM approaches peak), since the
@@ -283,7 +289,9 @@ def _alarm(seconds):
 def main():
     from slate_tpu.ops.ozaki import matmul_f64
 
-    budget = float(_os.environ.get("SLATE_TPU_BENCH_TIMEOUT", "0") or 0)
+    # unset = a sane 600 s default (BENCH_r05 died rc=124 with the guard
+    # off); an explicit SLATE_TPU_BENCH_TIMEOUT=0 still disables it
+    budget = float(_os.environ.get("SLATE_TPU_BENCH_TIMEOUT", "600") or 0)
     deadline = _T0 + budget if budget > 0 else None
 
     # correctness gate: Ozaki f64 product vs numpy f64, 3-eps style
@@ -304,15 +312,29 @@ def main():
 
     extras = {"ozaki_check_rel_err": float(rel)}
     _emit(gflops, extras)  # the headline survives even if every extra dies
+
+    def _reemit_on_term(signum, frame):
+        # timeout(1) sends SIGTERM before SIGKILL: flush the current full
+        # line + partial file so the driver's tail parser wins either way
+        _progress("SIGTERM: re-emitting final line and exiting")
+        _emit(gflops, extras)
+        raise SystemExit(124)
+
+    if hasattr(signal, "SIGTERM"):
+        signal.signal(signal.SIGTERM, _reemit_on_term)
+
+    # cheapest-first: the f64 n=8192 factorizations (cold compiles alone
+    # can eat several minutes each) run at the very end, after every
+    # cheap metric has checkpointed
     for name, fn in [
         ("gemm_bf16_gflops", lambda: bench_gemm(jnp.bfloat16, 64, jnp.float32)),
         ("gemm_int8_gops", lambda: bench_gemm(jnp.int8, 64, jnp.int32)),
         ("gemm_f32_gflops", lambda: bench_gemm(jnp.float32, 32)),
         ("potrf_f32_gflops", bench_potrf),
         ("getrf_f32_gflops", bench_getrf),
+        ("gemm_f64_emulated_gflops", bench_gemm_f64_emulated),
         (f"potrf_f64_gflops_n{N_F64}", bench_potrf_f64),
         (f"getrf_f64_gflops_n{N_F64}", bench_getrf_f64),
-        ("gemm_f64_emulated_gflops", bench_gemm_f64_emulated),
     ]:
         remaining = None if deadline is None else deadline - time.time()
         if remaining is not None and remaining <= 0:

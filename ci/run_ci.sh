@@ -41,6 +41,35 @@ python -m slate_tpu.obs.smoke --out artifacts/obs
 # so detection-coverage regressions gate like perf (slate_tpu/ft/smoke.py)
 python -m slate_tpu.ft.smoke --out artifacts/ft
 
+# broadcast-engine cross-impl pass (ISSUE 5): re-run both smokes under the
+# explicit ring lowering so the non-default Option.BcastImpl path is
+# exercised end-to-end on every commit (the default runs above already
+# cover auto -> doubling on the 2x4 grid; slate_lint covers psum via the
+# *_psum registry variants).  Two gates on the ring report vs the
+# default-lowering report: `obs.report --check` at threshold 3 keeps the
+# TIMING metrics from flaking a shared CI runner, and a dedicated exact
+# comparison enforces the byte invariant the loose threshold cannot —
+# ring and doubling move the SAME (s-1)-payload link bytes per rooted
+# broadcast, so the absorbed comm_bytes must be equal to the byte.
+SLATE_TPU_BCAST_IMPL=ring python -m slate_tpu.obs.smoke --out artifacts/obs_ring
+SLATE_TPU_BCAST_IMPL=ring python -m slate_tpu.ft.smoke --out artifacts/ft_ring
+python -m slate_tpu.obs.report --check \
+    artifacts/obs_ring/smoke_report.json artifacts/obs/smoke_report.json \
+    --threshold 3
+python - <<'PY'
+import json
+ring = json.load(open("artifacts/obs_ring/smoke_report.json"))["values"]
+base = json.load(open("artifacts/obs/smoke_report.json"))["values"]
+if ring["comm_bytes"] != base["comm_bytes"]:
+    raise SystemExit(
+        f"cross-impl comm-byte gate: ring smoke absorbed "
+        f"{ring['comm_bytes']:.0f} B/dev but the default lowering "
+        f"{base['comm_bytes']:.0f} — the engine hop schedules must move "
+        "identical link bytes"
+    )
+print(f"ci: cross-impl comm bytes equal ({ring['comm_bytes']:.0f} B/dev)")
+PY
+
 # ruff / mypy: configured in pyproject.toml; the container image may not
 # ship them, so gate on availability rather than skipping silently
 if command -v ruff > /dev/null 2>&1; then

@@ -14,6 +14,13 @@ Three rules, none of which need to import the modules under inspection:
   ``shard_map(check_vma=...)`` TypeError on JAX 0.4.37: the lint compares
   call sites against ``inspect.signature`` of the running JAX, so CI fails
   at lint time instead of at the 30th kernel launch.
+- ``ast-masked-psum-bcast``: ``psum(where(...), axis)`` /
+  ``psum_a(where(...), axis)`` outside ``parallel/comm.py`` — the
+  masked-psum broadcast idiom pays ~2x the bytes of a rooted broadcast
+  and bypasses ``Option.BcastImpl``; new drivers must use the comm
+  engine's ``bcast_from_row``/``bcast_from_col``/``reduce_to_*``
+  wrappers (genuine masked REDUCTIONS whose mask is not a broadcast,
+  e.g. tuple-axis owner selects, take a waiver naming the site).
 """
 
 from __future__ import annotations
@@ -28,7 +35,16 @@ from .findings import Finding
 RAW_COLLECTIVES = frozenset(
     {"psum", "psum_scatter", "all_gather", "ppermute", "all_to_all"}
 )
+# the psum spellings the masked-psum-broadcast rule matches: the raw
+# collective and its audited wrapper (the other audited wrappers —
+# all_gather_a / psum_scatter_a / ppermute_a, the broadcast engine's hop
+# verb — are not reductions, so the idiom cannot ride them)
+_PSUM_NAMES = frozenset({"psum", "psum_a"})
 COMM_MODULE = os.path.join("parallel", "comm.py")
+
+# (rel, source) pairs injected by lint --seed-violation for rules that
+# operate on sources rather than registry drivers (the masked-psum seed)
+SEEDED_SOURCES: list = []
 
 # kwargs shard_map_compat absorbs on purpose (the rename pair); valid at
 # any call site that routes through the compat wrapper
@@ -77,8 +93,14 @@ def _call_root(node: ast.Call) -> Optional[str]:
 def check_file(path: str, rel: str, sigs: Dict[str, frozenset]) -> List[Finding]:
     with open(path) as fh:
         src = fh.read()
+    return check_source(src, rel, sigs, filename=path)
+
+
+def check_source(
+    src: str, rel: str, sigs: Dict[str, frozenset], filename: str = "<src>"
+) -> List[Finding]:
     try:
-        tree = ast.parse(src, filename=path)
+        tree = ast.parse(src, filename=filename)
     except SyntaxError as e:  # a file that cannot parse is its own finding
         return [Finding("ast-parse", f"{rel}:{e.lineno}", str(e))]
 
@@ -140,6 +162,29 @@ def check_file(path: str, rel: str, sigs: Dict[str, frozenset]) -> List[Finding]
                 )
             )
 
+        # masked-psum broadcast idiom: psum(where(...), axis) — whether
+        # through the audited wrapper or raw — outside the comm engine.
+        # The where-mask fed straight into an all-reduce is the broadcast
+        # pattern the ppermute engine replaces at half the bytes.
+        if (
+            not in_comm
+            and (name in _PSUM_NAMES or fn_aliases.get(name) == "psum")
+            and node.args
+            and isinstance(node.args[0], ast.Call)
+            and _call_name(node.args[0]) == "where"
+        ):
+            out.append(
+                Finding(
+                    "ast-masked-psum-bcast",
+                    f"{rel}:{node.lineno}",
+                    "masked-psum broadcast idiom (psum(where(owner-mask), "
+                    "axis)) outside parallel/comm.py — use the broadcast "
+                    "engine (bcast_from_row/bcast_from_col/reduce_to_*) so "
+                    "Option.BcastImpl can lower it to ppermute at half the "
+                    "bytes",
+                )
+            )
+
         # kwarg drift: direct calls (shard_map_compat validates against the
         # same signature + the rename aliases it absorbs)...
         base = sigs.get("shard_map" if name == "shard_map_compat" else name)
@@ -196,4 +241,6 @@ def check_tree(root: Optional[str] = None) -> List[Finding]:
             path = os.path.join(dirpath, fname)
             rel = os.path.relpath(path, pkg_parent)
             out.extend(check_file(path, rel, sigs))
+    for rel, src in SEEDED_SOURCES:  # lint --seed-violation masked-psum
+        out.extend(check_source(src, rel, sigs))
     return out

@@ -184,13 +184,33 @@ def check_comm_upcast(closed: jax_core.ClosedJaxpr, where: str) -> List[Finding]
     return out
 
 
+def _count_loop_collectives(jaxpr: jax_core.Jaxpr, in_loop: bool) -> int:
+    """Data-collective eqns that EXECUTE per loop trip.  A cond/switch
+    runs exactly one of its branches per trip — the broadcast engine's
+    rooted ring/doubling schedules dispatch over the static owner roots
+    this way — so branches contribute the max over branches, not the sum
+    (the audit records one hop set per broadcast, not one per branch)."""
+    n = 0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if in_loop and name in DATA_COLLECTIVES:
+            n += 1
+        if name == "cond":
+            n += max(
+                (_count_loop_collectives(sub, in_loop) for sub in _sub_jaxprs(eqn)),
+                default=0,
+            )
+        else:
+            inner = in_loop or name in LOOP_PRIMS
+            for sub in _sub_jaxprs(eqn):
+                n += _count_loop_collectives(sub, inner)
+    return n
+
+
 def count_loop_collectives(closed: jax_core.ClosedJaxpr) -> int:
-    """Data collectives living inside while/scan bodies."""
-    return sum(
-        1
-        for eqn, depth in iter_eqns(closed.jaxpr)
-        if depth > 0 and eqn.primitive.name in DATA_COLLECTIVES
-    )
+    """Data collectives living inside while/scan bodies (cond branches
+    counted as max-over-branches: one executes per trip)."""
+    return _count_loop_collectives(closed.jaxpr, False)
 
 
 def check_loop_audit(

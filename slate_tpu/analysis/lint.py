@@ -13,9 +13,10 @@ Options:
   --only PATTERN      restrict traced drivers to names containing PATTERN
   --skip-trace        AST + grid + donation checks only (fast, no tracing)
   --list              list registered drivers and exit
-  --seed-violation K  inject a known-bad driver (axis | precision |
-                      donation | loop-audit) — proves the gate trips; used
-                      by tests/test_lint.py and CI self-checks
+  --seed-violation K  inject a known-bad driver or source (axis |
+                      precision | donation | loop-audit | masked-psum) —
+                      proves the gate trips; used by tests/test_lint.py
+                      and CI self-checks
 """
 
 from __future__ import annotations
@@ -104,6 +105,23 @@ def _seed_violation(kind: str) -> None:
             # output (300, 300) can never alias the donated (320, 320)
             return (lambda x: x[:300, :300]), (ap,), (0,)
 
+    elif kind == "masked-psum":
+        # an AST-pass seed: a synthetic source using the masked-psum
+        # broadcast idiom outside comm.py must trip ast-masked-psum-bcast
+        from .ast_checks import SEEDED_SOURCES
+
+        SEEDED_SOURCES.append(
+            (
+                "seeded/masked_psum_kernel.py",
+                "from slate_tpu.parallel.comm import psum_a\n"
+                "import jax.numpy as jnp\n"
+                "from jax import lax\n"
+                "def bad_bcast(x, owner):\n"
+                "    me = lax.axis_index('q')\n"
+                "    return psum_a(jnp.where(me == owner, x, 0), 'q')\n",
+            )
+        )
+
     else:
         raise SystemExit(f"unknown --seed-violation kind: {kind}")
 
@@ -117,7 +135,7 @@ def run(argv: List[str] = None) -> int:
     ap.add_argument(
         "--seed-violation",
         default=None,
-        choices=["axis", "precision", "donation", "loop-audit"],
+        choices=["axis", "precision", "donation", "loop-audit", "masked-psum"],
     )
     args = ap.parse_args(argv)
 
@@ -126,14 +144,17 @@ def run(argv: List[str] = None) -> int:
         # runs: the combination would exit 0 while validating nothing
         ap.error(
             f"--seed-violation {args.seed_violation} requires tracing; "
-            "only 'donation' works with --skip-trace"
+            "only 'donation' and 'masked-psum' work with --skip-trace"
         )
 
-    from .ast_checks import check_tree
+    from .ast_checks import SEEDED_SOURCES, check_tree
     from .findings import Finding
     from .grid_checks import run_grid_checks
     from .waivers import load_waivers
 
+    # stale seeds from a previous in-process run() must not leak into
+    # this one (the masked-psum seed appends to a module global)
+    SEEDED_SOURCES.clear()
     if args.seed_violation:
         _seed_violation(args.seed_violation)
 

@@ -446,6 +446,85 @@ def _getrf_pp_la0(ctx):
 
 
 # ---------------------------------------------------------------------------
+# broadcast-engine variants (ISSUE 5): the default entries above already
+# trace the engine lowering (Option.BcastImpl defaults to auto → doubling
+# on the power-of-two 2 x 4 grid), so every driver's ppermute schedule is
+# under the gate by default.  These pin the OTHER lowerings — the legacy
+# masked-psum fallback and the explicit ring pipeline — so all three stay
+# lint-green (declared axis names on the ppermute hops, audit_scope
+# coverage with the cond-aware loop counting, HIGHEST dots).
+# ---------------------------------------------------------------------------
+
+
+def _with_impl(impl, call):
+    from ..parallel.comm import use_bcast_impl
+
+    def fn(*args):
+        with use_bcast_impl(impl):
+            return call(*args)
+
+    return fn
+
+
+@register("gemm_summa_psum", tags=("bcast",))
+def _gemm_psum(ctx):
+    from ..parallel.summa import gemm_summa
+    from ..types import MethodGemm
+
+    a, b = ctx.dist(), ctx.dist()
+    return _with_impl(
+        "psum", lambda x, y: gemm_summa(1.0, x, y, method=MethodGemm.GemmC)
+    ), (a, b)
+
+
+@register("gemm_summa_ring", tags=("bcast",))
+def _gemm_ring(ctx):
+    from ..parallel.summa import gemm_summa
+    from ..types import MethodGemm
+
+    a, b = ctx.dist(), ctx.dist()
+    return _with_impl(
+        "ring", lambda x, y: gemm_summa(1.0, x, y, method=MethodGemm.GemmC)
+    ), (a, b)
+
+
+@register("potrf_dist_psum", tags=("bcast",))
+def _potrf_psum(ctx):
+    from ..parallel.dist_chol import potrf_dist
+
+    a = ctx.dist(kind="spd", diag_pad=True)
+    return _with_impl("psum", potrf_dist), (a,)
+
+
+@register("potrf_dist_ring", tags=("bcast",))
+def _potrf_ring(ctx):
+    from ..parallel.dist_chol import potrf_dist
+
+    a = ctx.dist(kind="spd", diag_pad=True)
+    return _with_impl("ring", potrf_dist), (a,)
+
+
+@register("getrf_nopiv_dist_ring", tags=("bcast",))
+def _getrf_nopiv_ring(ctx):
+    from ..parallel.dist_lu import getrf_nopiv_dist
+
+    a = ctx.dist(kind="tril", diag_pad=True)
+    return _with_impl("ring", getrf_nopiv_dist), (a,)
+
+
+@register("trsm_dist_psum", tags=("bcast",))
+def _trsm_psum(ctx):
+    from ..parallel.dist_trsm import trsm_dist
+    from ..types import Op, Uplo
+
+    a = ctx.dist(kind="tril", diag_pad=True)
+    b = ctx.dist_thin()
+    return _with_impl(
+        "psum", lambda x, y: trsm_dist(x, y, Uplo.Lower, Op.NoTrans)
+    ), (a, b)
+
+
+# ---------------------------------------------------------------------------
 # observability wrappers (ISSUE 2): the same kernels traced WITH obs on
 # ---------------------------------------------------------------------------
 
@@ -513,6 +592,7 @@ def _ft_spec(armed: bool, op: str):
 
 def _ft_gemm_build(ctx, armed):
     from ..ft import abft
+    from ..parallel.comm import resolve_bcast_impl
     from ..parallel.dist import DistMatrix, from_dense, to_dense
 
     a, b = ctx.dense(), ctx.dense()
@@ -525,7 +605,7 @@ def _ft_gemm_build(ctx, armed):
         cd = from_dense(c_aug, ctx.mesh, NB)
         out = abft._ft_summa_jit(
             ad.tiles, bd.tiles, cd.tiles, 1.0, 0.0,
-            ctx.mesh, ctx.p, ctx.q, kt, 1, fi, fv,
+            ctx.mesh, ctx.p, ctx.q, kt, 1, resolve_bcast_impl(), fi, fv,
         )
         dense = to_dense(DistMatrix(
             tiles=out, m=a_aug.shape[0], n=b_aug.shape[1], nb=NB, mesh=ctx.mesh,
@@ -537,6 +617,7 @@ def _ft_gemm_build(ctx, armed):
 
 def _ft_factor_build(ctx, op, armed):
     from ..ft import abft
+    from ..parallel.comm import resolve_bcast_impl
     from ..parallel.dist import DistMatrix, from_dense, to_dense
 
     is_lu = op == "getrf_nopiv"
@@ -547,7 +628,10 @@ def _ft_factor_build(ctx, op, armed):
     def fn(x):
         aug, mt, _ = abft._encode_factor(x, NB, ctx.mesh, with_cols=is_lu)
         d = from_dense(aug, ctx.mesh, NB)
-        out_t, info = kern(d.tiles, ctx.mesh, ctx.p, ctx.q, mt, 1, fi, fv)
+        out_t, info = kern(
+            d.tiles, ctx.mesh, ctx.p, ctx.q, mt, 1, resolve_bcast_impl(),
+            fi, fv,
+        )
         dense = to_dense(DistMatrix(
             tiles=out_t, m=aug.shape[0], n=aug.shape[1], nb=NB, mesh=ctx.mesh,
         ))

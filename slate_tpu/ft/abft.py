@@ -52,10 +52,12 @@ from ..parallel.comm import (
     bcast_diag_tile,
     bcast_from_col,
     bcast_from_row,
+    bcast_impl_scope,
     la_depth,
     local_indices,
     pipelined_factor_loop,
     prefetch_bcast,
+    resolve_bcast_impl,
     shard_map_compat,
 )
 from ..parallel.dist import DistMatrix, from_dense, padded_tiles, to_dense
@@ -117,8 +119,8 @@ def _hit3(x, hit, li, mode, value):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9))
-def _ft_summa_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, la, fi, fv):
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10))
+def _ft_summa_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, la, bi, fi, fv):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(a_loc, b_loc, fi, fv):
@@ -162,13 +164,14 @@ def _ft_summa_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, la, fi, fv):
         acc0 = jnp.zeros((mtl, ntl, nb, nb), dtype)
         return prefetch_bcast(kt, la, fetch, consume, acc0)
 
-    prod = shard_map_compat(
-        kernel,
-        mesh=mesh,
-        in_specs=(spec, spec, P(), P()),
-        out_specs=spec,
-        check_vma=False,
-    )(at, bt, fi, fv)
+    with bcast_impl_scope(bi):
+        prod = shard_map_compat(
+            kernel,
+            mesh=mesh,
+            in_specs=(spec, spec, P(), P()),
+            out_specs=spec,
+            check_vma=False,
+        )(at, bt, fi, fv)
     return (alpha * prod + beta * ct).astype(at.dtype)
 
 
@@ -177,8 +180,8 @@ def _ft_summa_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, la, fi, fv):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
-def _ft_potrf_jit(at, mesh, p, q, nt, la, fi, fv):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
+def _ft_potrf_jit(at, mesh, p, q, nt, la, bi, fi, fv):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc, fi, fv):
@@ -297,13 +300,14 @@ def _ft_potrf_jit(at, mesh, p, q, nt, la, fi, fv):
         info = jnp.where(info >= big, 0, info).astype(jnp.int32)
         return t_loc, info[None, None]
 
-    lt, info = shard_map_compat(
-        kernel,
-        mesh=mesh,
-        in_specs=(spec, P(), P()),
-        out_specs=(spec, P(ROW_AXIS, COL_AXIS)),
-        check_vma=False,
-    )(at, fi, fv)
+    with bcast_impl_scope(bi):
+        lt, info = shard_map_compat(
+            kernel,
+            mesh=mesh,
+            in_specs=(spec, P(), P()),
+            out_specs=(spec, P(ROW_AXIS, COL_AXIS)),
+            check_vma=False,
+        )(at, fi, fv)
     return lt, jnp.max(info)
 
 
@@ -312,8 +316,8 @@ def _ft_potrf_jit(at, mesh, p, q, nt, la, fi, fv):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
-def _ft_lu_jit(at, mesh, p, q, nt, la, fi, fv):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
+def _ft_lu_jit(at, mesh, p, q, nt, la, bi, fi, fv):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc, fi, fv):
@@ -383,13 +387,14 @@ def _ft_lu_jit(at, mesh, p, q, nt, la, fi, fv):
         info = jnp.where(info >= big, 0, info).astype(jnp.int32)
         return t_loc, info[None, None]
 
-    lut, info = shard_map_compat(
-        kernel,
-        mesh=mesh,
-        in_specs=(spec, P(), P()),
-        out_specs=(spec, P(ROW_AXIS, COL_AXIS)),
-        check_vma=False,
-    )(at, fi, fv)
+    with bcast_impl_scope(bi):
+        lut, info = shard_map_compat(
+            kernel,
+            mesh=mesh,
+            in_specs=(spec, P(), P()),
+            out_specs=(spec, P(ROW_AXIS, COL_AXIS)),
+            check_vma=False,
+        )(at, fi, fv)
     return lut, jnp.max(info)
 
 
@@ -633,7 +638,8 @@ def _factor_result(out_np, n: int, nb: int, mesh) -> DistMatrix:
 
 
 def _factor_ft(
-    op: str, a, mesh, nb: int, policy: FtPolicy, lookahead, _rerun: bool = False
+    op: str, a, mesh, nb: int, policy: FtPolicy, lookahead,
+    bcast_impl=None, _rerun: bool = False,
 ):
     is_lu = op == "getrf_nopiv"
     a = jnp.asarray(a)
@@ -647,7 +653,7 @@ def _factor_ft(
     ints, vals = inject.spec_arrays(op)
     kern = _ft_lu_jit if is_lu else _ft_potrf_jit
     out_t, info = kern(
-        d.tiles, mesh, p, q, mt, la,
+        d.tiles, mesh, p, q, mt, la, resolve_bcast_impl(bcast_impl),
         jnp.asarray(ints), jnp.asarray(vals, jnp.result_type(float)),
     )
     inject.consume(op)
@@ -668,7 +674,9 @@ def _factor_ft(
                 info,
                 FtReport(op=op),
             )
-        res2, info2, rep2 = _factor_ft(op, a, mesh, nb, policy, lookahead, _rerun=True)
+        res2, info2, rep2 = _factor_ft(
+            op, a, mesh, nb, policy, lookahead, bcast_impl, _rerun=True
+        )
         if int(info2) == 0:  # first breakdown was fault-induced
             count("ft.detected", op)
             if policy == FtPolicy.Detect:
@@ -699,7 +707,9 @@ def _factor_ft(
     # recompute — transient faults have disarmed, persistent ones
     # re-detect on the rerun and escalate above
     count("ft.recomputed", op)
-    res, info2, rep2 = _factor_ft(op, a, mesh, nb, policy, lookahead, _rerun=True)
+    res, info2, rep2 = _factor_ft(
+        op, a, mesh, nb, policy, lookahead, bcast_impl, _rerun=True
+    )
     rep2.action = "recomputed"
     rep2.detections = dets + rep2.detections
     return res, info2, rep2
@@ -784,7 +794,7 @@ def _gemm_try_repair(out_np, drn, dcn, verdR, verdC, nb, mt, nt):
 
 def _gemm_ft(
     alpha, a, b, mesh, nb: int, beta, cin, policy: FtPolicy, lookahead,
-    _rerun: bool = False,
+    bcast_impl=None, _rerun: bool = False,
 ):
     a, b = jnp.asarray(a), jnp.asarray(b)
     if a.shape[1] != b.shape[0]:
@@ -798,6 +808,7 @@ def _gemm_ft(
     ints, vals = inject.spec_arrays("gemm")
     out_t = _ft_summa_jit(
         ad.tiles, bd.tiles, cd.tiles, alpha, beta, mesh, p, q, kt, la,
+        resolve_bcast_impl(bcast_impl),
         jnp.asarray(ints), jnp.asarray(vals, jnp.result_type(float)),
     )
     inject.consume("gemm")
@@ -827,7 +838,8 @@ def _gemm_ft(
         raise FtError("gemm", "recompute still fails verification", dets)
     count("ft.recomputed", "gemm")
     out2, rep2 = _gemm_ft(
-        alpha, a, b, mesh, nb, beta, cin, policy, lookahead, _rerun=True
+        alpha, a, b, mesh, nb, beta, cin, policy, lookahead, bcast_impl,
+        _rerun=True,
     )
     rep2.action = "recomputed"
     rep2.detections = dets + rep2.detections
@@ -845,21 +857,31 @@ def _la_opt(opts: Optional[Options]):
     return get_option(opts, Option.Lookahead)
 
 
+def _bi_opt(opts: Optional[Options]):
+    from ..types import Option, get_option
+
+    return get_option(opts, Option.BcastImpl)
+
+
 def gemm_ft(
     alpha, a, b, mesh, nb: int = 256, beta=0.0, c=None,
-    policy: FtPolicy = FtPolicy.Correct, lookahead=None,
+    policy: FtPolicy = FtPolicy.Correct, lookahead=None, bcast_impl=None,
 ) -> Tuple[jax.Array, FtReport]:
     """ABFT SUMMA: C = alpha A B + beta C with carried checksums.
-    Returns (dense C, FtReport); raises FtError per policy."""
+    Returns (dense C, FtReport); raises FtError per policy.  The checksum
+    panels ride the same broadcast engine as the plain kernels, so
+    ``bcast_impl`` (Option.BcastImpl) applies unchanged."""
     if policy == FtPolicy.Off:
         from ..parallel.drivers import gemm_mesh
 
         return gemm_mesh(alpha, a, b, mesh, nb, beta, c), FtReport(op="gemm")
-    return _gemm_ft(alpha, a, b, mesh, nb, beta, c, policy, lookahead)
+    return _gemm_ft(alpha, a, b, mesh, nb, beta, c, policy, lookahead,
+                    bcast_impl)
 
 
 def potrf_ft(
     a, mesh, nb: int = 256, policy: FtPolicy = FtPolicy.Correct, lookahead=None,
+    bcast_impl=None,
 ) -> Tuple[DistMatrix, jax.Array, FtReport]:
     """ABFT mesh Cholesky.  Returns (L DistMatrix, info, FtReport)."""
     if policy == FtPolicy.Off:
@@ -867,11 +889,12 @@ def potrf_ft(
 
         l, info = potrf_mesh(a, mesh, nb)
         return l, info, FtReport(op="potrf")
-    return _factor_ft("potrf", a, mesh, nb, policy, lookahead)
+    return _factor_ft("potrf", a, mesh, nb, policy, lookahead, bcast_impl)
 
 
 def getrf_nopiv_ft(
     a, mesh, nb: int = 256, policy: FtPolicy = FtPolicy.Correct, lookahead=None,
+    bcast_impl=None,
 ) -> Tuple[DistMatrix, jax.Array, FtReport]:
     """ABFT mesh LU-nopiv.  Returns (LU DistMatrix, info, FtReport)."""
     if policy == FtPolicy.Off:
@@ -879,7 +902,8 @@ def getrf_nopiv_ft(
 
         lu, info = getrf_nopiv_mesh(a, mesh, nb)
         return lu, info, FtReport(op="getrf_nopiv")
-    return _factor_ft("getrf_nopiv", a, mesh, nb, policy, lookahead)
+    return _factor_ft("getrf_nopiv", a, mesh, nb, policy, lookahead,
+                      bcast_impl)
 
 
 # opts-driven wrappers with the plain mesh-driver signatures, used by
@@ -890,21 +914,23 @@ def getrf_nopiv_ft(
 def gemm_mesh_ft(alpha, a, b, mesh, nb=256, beta=0.0, c=None,
                  opts: Optional[Options] = None) -> jax.Array:
     out, _ = gemm_ft(alpha, a, b, mesh, nb, beta, c,
-                     policy=resolve_policy(opts), lookahead=_la_opt(opts))
+                     policy=resolve_policy(opts), lookahead=_la_opt(opts),
+                     bcast_impl=_bi_opt(opts))
     return out
 
 
 @instrument("potrf_mesh_ft")
 def potrf_mesh_ft(a, mesh, nb=256, opts: Optional[Options] = None):
     l, info, _ = potrf_ft(a, mesh, nb, policy=resolve_policy(opts),
-                          lookahead=_la_opt(opts))
+                          lookahead=_la_opt(opts), bcast_impl=_bi_opt(opts))
     return l, info
 
 
 @instrument("getrf_nopiv_mesh_ft")
 def getrf_nopiv_mesh_ft(a, mesh, nb=256, opts: Optional[Options] = None):
     lu, info, _ = getrf_nopiv_ft(a, mesh, nb, policy=resolve_policy(opts),
-                                 lookahead=_la_opt(opts))
+                                 lookahead=_la_opt(opts),
+                                 bcast_impl=_bi_opt(opts))
     return lu, info
 
 

@@ -32,6 +32,14 @@ def summarize(records, p, q):
     Ring-lowering receive estimates per executed collective with local
     payload B over an axis of size s: psum (all-reduce) ~ 2 B (s-1)/s,
     psum_scatter (reduce-scatter) ~ B (s-1)/s, all_gather ~ B (s-1).
+
+    ``ppermute`` records come from the broadcast engine's rooted
+    ring/doubling hop schedules (parallel/comm.py) and already carry
+    LINK bytes — operand bytes x source→target pairs of that hop — so
+    the per-device receive estimate is nbytes / s.  A whole rooted
+    broadcast of payload B therefore sums to B (s-1)/s per device —
+    HALF the masked-psum path's 2 B (s-1)/s for the same panel, which
+    is the Option.BcastImpl win tests/test_comm_audit.py asserts.
     """
     payload = recv = calls = 0
     by_op = {}
@@ -46,6 +54,8 @@ def summarize(records, p, q):
             r = nbytes * (s - 1) / s
         elif op.startswith("psum"):
             r = 2 * nbytes * (s - 1) / s
+        elif op.startswith("ppermute"):
+            r = nbytes / s  # nbytes is link bytes for the hop; avg / device
         else:  # all_gather
             r = nbytes * (s - 1)
         payload += nbytes * mult
@@ -162,7 +172,10 @@ def render(rows, p, q, n, nb) -> str:
         f"Config: n={n}, nb={nb}, grid {p}x{q}, f32.  Counters live in "
         "`slate_tpu/parallel/comm.py` (`comm_audit`); kernels declare loop "
         "trip counts via `audit_scope`.  Received-bytes estimates use ring "
-        "lowerings: psum ~ 2B(s-1)/s, all_gather ~ B(s-1) per device.",
+        "lowerings: psum ~ 2B(s-1)/s, all_gather ~ B(s-1) per device; "
+        "`ppermute` hop records (the Option.BcastImpl broadcast engine) "
+        "carry link bytes directly, B_hop/s per device — a whole rooted "
+        "broadcast is B(s-1)/s, half the masked-psum path.",
         "",
         f"2D lower-bound scale per device: n^2 * 4B / sqrt(P) = {lb:,.0f} B.",
         "",
@@ -184,9 +197,12 @@ def render(rows, p, q, n, nb) -> str:
         lines.append(f"- **{name}**: {det}")
     lines += [
         "",
-        "Reading the table: SUMMA's received volume is ~2 n^2/sqrt(P) per",
-        "device (the classic 2D algorithm, a factor 2 of the lower bound);",
-        "the factorizations sit at the same n^2-class scale, so doubling n",
+        "Reading the table: under the broadcast engine's default lowering",
+        "(Option.BcastImpl auto -> ppermute hops) SUMMA's received volume",
+        "is ~1.4 n^2/sqrt(P) per device — the classic 2D algorithm's ~2x",
+        "with its loop broadcasts HALVED; rerun with",
+        "SLATE_TPU_BCAST_IMPL=psum to see the legacy all-reduce volumes.",
+        "The factorizations sit at the same n^2-class scale, so doubling n",
         "at 4x the devices holds received-bytes/device constant — the 2D",
         "weak-scaling invariant (BASELINE config #3).  The `collective",
         "execs` column is the latency story: getrf's per-column pivot",

@@ -2,16 +2,34 @@
 kernels (summa / dist_chol / dist_lu / dist_trsm).
 
 These are the TPU-native forms of the reference's tile-communication verbs
-(BaseMatrix.hh): ``tileBcast`` along a process row/column is a masked
-``lax.psum`` over one mesh axis — the owner contributes its tiles, everyone
-else zeros — which XLA lowers to an ICI all-reduce (cost within 2x of a
-broadcast, zero tag/lifetime bookkeeping).
+(BaseMatrix.hh).  ``tileBcast`` along a process row/column has two
+lowerings, selected by ``Option.BcastImpl`` (see ``resolve_bcast_impl``):
+
+- ``psum`` (the legacy path): a masked ``lax.psum`` over one mesh axis —
+  the owner contributes its tiles, everyone else zeros — which XLA lowers
+  to an ICI all-reduce.  An all-reduce of B bytes moves ~2(s-1)/s * B per
+  link (reduce-scatter + all-gather, Thakur et al., IJHPCA 2005) and burns
+  s-1 pointless tile additions per hop.
+- ``ring`` / ``doubling`` (the broadcast engine): ``lax.ppermute`` point-
+  to-point hops rooted at the owner — a store-and-forward ring pipeline
+  (s-1 single-pair hops) or a recursive-doubling tree (log2 s hops,
+  power-of-two axes) — moving exactly (s-1)/s * B per link, HALF the
+  all-reduce bytes, with no additions at all (the owner's exact bytes
+  arrive, bitwise).  The owner index is usually a traced loop residue
+  (k % q), so the rooted schedules dispatch through one ``lax.switch``
+  over the s static roots; every device evaluates the same replicated
+  branch, and only the links that carry useful data send.
+
+``auto`` (the default) picks doubling on power-of-two axes, ring
+otherwise.  SLATE routes broadcast over point-to-point links for the
+same reason (Gates et al., SC'19).
 """
 
 from __future__ import annotations
 
 import contextlib
 import inspect
+import os
 from typing import Optional
 
 import jax
@@ -138,16 +156,249 @@ def psum_scatter_a(x: jax.Array, axis_name: str, **kw) -> jax.Array:
     return lax.psum_scatter(x, axis_name, **kw)
 
 
+def ppermute_a(x: jax.Array, axis_name: str, perm) -> jax.Array:
+    """Audited lax.ppermute.  The recorded ``nbytes`` is the total bytes
+    crossing links in this hop — operand bytes x len(perm) source→target
+    pairs — NOT the per-device operand size: a collective-permute only
+    sends from the listed sources, so per-hop link bytes (not payload
+    shape) is the honest wire unit.  ``obs.comm_audit.summarize`` divides
+    by the axis size to recover per-device received bytes."""
+    _rec_hop(f"ppermute[{axis_name}]", x, len(perm))
+    return lax.ppermute(x, axis_name, perm)
+
+
+def _rec_hop(op: str, x: jax.Array, npairs: int) -> None:
+    if _AUDIT is not None and npairs > 0:
+        _AUDIT.append(
+            (op, int(x.size) * x.dtype.itemsize * npairs, _AUDIT_MULT[-1])
+        )
+
+
+# ---------------------------------------------------------------------------
+# Broadcast engine (Option.BcastImpl): rooted broadcast/reduce lowerings.
+#
+# Selection is a TRACE-TIME property: every kernel that consumes the
+# wrappers below threads the resolved impl through its jit as a static
+# argument and wraps kernel tracing in ``bcast_impl_scope`` — a cache hit
+# on a different impl is impossible by construction.  Kernels that do NOT
+# thread the option (dist_qr / dist_twostage / dist_aux / dist_stedc's
+# static-owner broadcasts) trace with the scope at its default, ``psum``,
+# keeping their schedules byte-for-byte what they were.
+# ---------------------------------------------------------------------------
+
+BCAST_IMPLS = ("psum", "ring", "doubling", "auto")
+BCAST_IMPL_ENV = "SLATE_TPU_BCAST_IMPL"
+
+_IMPL_DEFAULT = [None]  # session default (use_bcast_impl), outside jit
+_IMPL_ACTIVE = ["psum"]  # trace-time lowering (bcast_impl_scope)
+
+
+def _check_impl(impl: str) -> str:
+    if impl not in BCAST_IMPLS:
+        raise ValueError(
+            f"unknown bcast impl {impl!r}; expected one of {BCAST_IMPLS}"
+        )
+    return impl
+
+
+def resolve_bcast_impl(impl: Optional[str] = None) -> str:
+    """Resolve an Option.BcastImpl value at driver level (OUTSIDE jit):
+    explicit argument > ``use_bcast_impl`` context default >
+    ``SLATE_TPU_BCAST_IMPL`` environment > ``auto``.  The returned string
+    is what drivers pass into their jitted kernels as a static argument
+    (``auto`` stays ``auto``: the per-axis choice depends on each axis'
+    size and is made inside the kernel)."""
+    if impl is None:
+        impl = _IMPL_DEFAULT[-1]
+    if impl is None:
+        impl = os.environ.get(BCAST_IMPL_ENV) or "auto"
+    return _check_impl(impl)
+
+
+@contextlib.contextmanager
+def use_bcast_impl(impl: str):
+    """Set the session-default broadcast lowering for drivers called
+    inside (tests / CI sweeps); an explicit ``bcast_impl=`` argument still
+    wins.  Safe across jit caches: the resolved value is a static kernel
+    argument, so switching impls recompiles rather than reusing."""
+    _IMPL_DEFAULT.append(_check_impl(impl))
+    try:
+        yield
+    finally:
+        _IMPL_DEFAULT.pop()
+
+
+@contextlib.contextmanager
+def bcast_impl_scope(impl: str):
+    """Activate a lowering for the broadcast wrappers traced inside —
+    used by the kernels around their shard_map call, with ``impl`` a
+    static jit argument of the enclosing kernel."""
+    _IMPL_ACTIVE.append(_check_impl(impl))
+    try:
+        yield
+    finally:
+        _IMPL_ACTIVE.pop()
+
+
+def _axis_size(axis: str) -> int:
+    """Static mesh-axis size inside shard_map: psum of a unit literal is
+    evaluated at trace time to the axis size (no runtime collective)."""
+    return int(lax.psum(1, axis))
+
+
+def _impl_for(size: int) -> str:
+    """Concrete per-axis lowering from the active scope: auto prefers the
+    log2-hop doubling tree on power-of-two axes, the ring pipeline
+    otherwise; explicit doubling on a non-power-of-two axis degrades to
+    ring (same bytes, s-1 hops) rather than erroring."""
+    impl = _IMPL_ACTIVE[-1]
+    if impl == "auto":
+        return "doubling" if size & (size - 1) == 0 else "ring"
+    if impl == "doubling" and size & (size - 1):
+        return "ring"
+    return impl
+
+
+def _bcast_hops(impl: str, size: int, root: int):
+    """Static hop schedule for a rooted broadcast: a list of ppermute
+    perms.  ring: s-1 store-and-forward single-pair hops around the ring;
+    doubling: log2(s) hops, hop h multicasting from the 2^h devices that
+    already hold the payload.  Both move exactly (s-1) pair-payloads."""
+    if impl == "ring":
+        return [
+            [((root + h - 1) % size, (root + h) % size)]
+            for h in range(1, size)
+        ]
+    hops, h = [], 1
+    while h < size:  # doubling (size is a power of two here)
+        hops.append(
+            [((root + i) % size, (root + i + h) % size) for i in range(h)]
+        )
+        h *= 2
+    return hops
+
+
+def _concrete_root(owner, size: int):
+    """``owner`` as a Python int when it is trace-time concrete (prologue
+    prefetches index with Python ints; some callers pass static owners),
+    else None.  A concrete root skips the lax.switch dispatch entirely —
+    only the owner's hop schedule is traced."""
+    try:
+        return int(owner) % size
+    except (TypeError, jax.errors.TracerIntegerConversionError,
+            jax.errors.ConcretizationTypeError):
+        return None
+
+
+def _rooted_dispatch(x, owner, axis, size, impl, branch):
+    """Shared tail of the rooted verbs: audit one hop-set for the whole
+    schedule (recording inside every switch branch would overcount by the
+    branch count), then dispatch — directly for a concrete owner, through
+    one lax.switch over the static roots for a traced one."""
+    for perm in _bcast_hops(impl, size, 0):
+        _rec_hop(f"ppermute[{axis}]", x, len(perm))
+    root = _concrete_root(owner, size)
+    if root is not None:
+        return branch(root)(x)
+    return lax.switch(owner, [branch(o) for o in range(size)], x)
+
+
+def _rooted_bcast(x: jax.Array, owner, axis: str) -> jax.Array:
+    """Deliver the owner's ``x`` to every device on ``axis`` (tileBcast).
+
+    ``owner`` may be a traced loop residue; the static hop schedules are
+    dispatched through one ``lax.switch`` over the axis' roots (the owner
+    index is replicated, so every device takes the same branch).  Results
+    are the owner's exact bytes — bitwise identical to the masked-psum
+    path, which only ever adds exact zeros to them."""
+    size = _axis_size(axis)
+    impl = _impl_for(size)
+    if impl == "psum":
+        me = lax.axis_index(axis)
+        return psum_a(jnp.where(me == owner, x, jnp.zeros_like(x)), axis)
+    if size == 1:
+        return x
+    me = lax.axis_index(axis)
+
+    def branch(root):
+        hops = _bcast_hops(impl, size, root)
+
+        def br(v):
+            d = (me - root) % size
+            out = v
+            covered = 1  # devices at ring distance < covered hold the payload
+            for perm in hops:
+                r = lax.ppermute(out, axis, perm)
+                out = jnp.where(
+                    (d >= covered) & (d < covered + len(perm)), r, out
+                )
+                covered += len(perm)
+            return out
+
+        return br
+
+    return _rooted_dispatch(x, owner, axis, size, impl, branch)
+
+
+def _rooted_reduce(x: jax.Array, owner, axis: str) -> jax.Array:
+    """Owner-rooted reduction (the tileReduce counterpart): the sum of
+    ``x`` over ``axis`` lands on mesh index ``owner``; every other device
+    returns zeros.  ring: a deterministic s-1-hop accumulation chain
+    toward the root; doubling: the reversed multicast tree (log2 s hops,
+    pairwise folds).  Half the all-reduce bytes for the same delivered
+    sum — the schedule for owner-consumed reductions (stationary-operand
+    partial sums) where psum wastes the replicated result."""
+    size = _axis_size(axis)
+    me = lax.axis_index(axis)
+    impl = _impl_for(size)
+    if impl == "psum":
+        full = psum_a(x, axis)
+        return jnp.where(me == owner, full, jnp.zeros_like(x))
+    if size == 1:
+        return x
+
+    def branch(root):
+        # the broadcast hop schedule run BACKWARDS with reversed pairs:
+        # partial sums fold toward the root in a fixed order, so the
+        # delivered sum is deterministic (unlike psum's backend order)
+        hops = list(reversed(_bcast_hops(impl, size, root)))
+
+        def br(v):
+            d = (me - root) % size
+            out = v
+            for perm in hops:
+                rev = [(dst, src) for src, dst in perm]
+                r = lax.ppermute(out, axis, rev)
+                recv = False
+                for _, dst in rev:
+                    recv = recv | (d == (dst - root) % size)
+                out = jnp.where(recv, out + r, out)
+            return jnp.where(me == root, out, jnp.zeros_like(out))
+
+        return br
+
+    return _rooted_dispatch(x, owner, axis, size, impl, branch)
+
+
 def bcast_from_col(x: jax.Array, owner_col) -> jax.Array:
     """Broadcast ``x`` from mesh column ``owner_col`` to all columns
-    (tileBcast along a process row, BaseMatrix.hh:1917)."""
-    me = lax.axis_index(COL_AXIS)
-    return psum_a(jnp.where(me == owner_col, x, jnp.zeros_like(x)), COL_AXIS)
+    (tileBcast along a process row, BaseMatrix.hh:1917), lowered per the
+    active ``bcast_impl_scope``."""
+    return _rooted_bcast(x, owner_col, COL_AXIS)
 
 
 def bcast_from_row(x: jax.Array, owner_row) -> jax.Array:
-    me = lax.axis_index(ROW_AXIS)
-    return psum_a(jnp.where(me == owner_row, x, jnp.zeros_like(x)), ROW_AXIS)
+    return _rooted_bcast(x, owner_row, ROW_AXIS)
+
+
+def reduce_to_col(x: jax.Array, owner_col) -> jax.Array:
+    """Sum ``x`` over the column axis INTO mesh column ``owner_col``
+    (owner-rooted listReduce); other columns receive zeros."""
+    return _rooted_reduce(x, owner_col, COL_AXIS)
+
+
+def reduce_to_row(x: jax.Array, owner_row) -> jax.Array:
+    return _rooted_reduce(x, owner_row, ROW_AXIS)
 
 
 def local_indices(p: int, q: int, mtl: int, ntl: int):
@@ -164,18 +415,28 @@ def local_indices(p: int, q: int, mtl: int, ntl: int):
 def bcast_diag_tile(
     t_loc: jax.Array, k, p: int, q: int, nb: int, roff=0, coff=0
 ) -> jax.Array:
-    """Deliver tile (k, k) to every device: masked double psum over both
-    mesh axes (the reference's tileBcast of the panel-head tile).
-    ``roff``/``coff`` shift local tile indexing when ``t_loc`` is a
-    trailing view (bucketed kernels)."""
-    r = lax.axis_index(ROW_AXIS)
-    c = lax.axis_index(COL_AXIS)
-    own = (r == k % p) & (c == k % q)
+    """Deliver tile (k, k) to every device (the reference's tileBcast of
+    the panel-head tile): a two-hop rooted broadcast — along the row axis
+    from mesh row k % p, then along the column axis from mesh column
+    k % q.  Under the legacy ``psum`` lowering this is the historical
+    masked DOUBLE psum (~4x the ring-broadcast bytes: two all-reduces of
+    one tile); the engine lowerings move (p-1)/p + (q-1)/q tile payloads
+    total.  ``roff``/``coff`` shift local tile indexing when ``t_loc`` is
+    a trailing view (bucketed kernels)."""
     dtile = lax.dynamic_slice(
         t_loc, (k // p - roff, k // q - coff, 0, 0), (1, 1, nb, nb)
     )[0, 0]
-    dtile = jnp.where(own, dtile, jnp.zeros_like(dtile))
-    return psum_a(psum_a(dtile, ROW_AXIS), COL_AXIS)
+    if _IMPL_ACTIVE[-1] == "psum":
+        r = lax.axis_index(ROW_AXIS)
+        c = lax.axis_index(COL_AXIS)
+        own = (r == k % p) & (c == k % q)
+        dtile = jnp.where(own, dtile, jnp.zeros_like(dtile))
+        return psum_a(psum_a(dtile, ROW_AXIS), COL_AXIS)
+    # hop 1 delivers mesh row (k % p)'s local slice down each column —
+    # column k % q now holds tile (k, k) everywhere; hop 2 roots there.
+    # No masking anywhere: the owner's exact bytes travel.
+    d1 = _rooted_bcast(dtile, k % p, ROW_AXIS)
+    return _rooted_bcast(d1, k % q, COL_AXIS)
 
 
 def route_to_block_cyclic_rows(
@@ -252,7 +513,7 @@ def prefetch_bcast(nt: int, depth: int, fetch, consume, state):
     """Software-pipelined k-loop over READ-ONLY panel broadcasts.
 
     ``fetch(k)`` builds step k's panel pytree purely from loop-invariant
-    operands (masked-psum broadcasts / gathers of stationary tiles);
+    operands (rooted panel broadcasts / gathers of stationary tiles);
     ``consume(k, panel, state)`` performs step k's update (and any
     serial-chain collectives of its own).  Depth 0 reproduces the strict
     broadcast→update schedule exactly.  Depth d >= 1 double-buffers:

@@ -35,9 +35,11 @@ from .comm import (
     all_gather_a,
     bcast_from_col,
     bcast_from_row,
+    bcast_impl_scope,
     la_depth,
     local_indices,
     prefetch_bcast,
+    resolve_bcast_impl,
     shard_map_compat,
 )
 from .dist import DistMatrix
@@ -151,6 +153,7 @@ def hemm_summa(
     conj: bool = True,
     method=None,
     lookahead: Optional[int] = None,
+    bcast_impl: Optional[str] = None,
 ) -> DistMatrix:
     """C := alpha A B + beta C with A Hermitian (conj=True, src/hemm.cc) or
     symmetric (conj=False, src/symm.cc), A referenced through its ``uplo``
@@ -178,7 +181,8 @@ def hemm_summa(
         al = jnp.conj(alpha) if conj else alpha
         be = jnp.conj(beta) if conj else beta
         prod_t = hemm_summa(Side.Left, al, a, bt_, be, ct_, uplo=uplo,
-                            conj=conj, method=method, lookahead=lookahead)
+                            conj=conj, method=method, lookahead=lookahead,
+                            bcast_impl=bcast_impl)
         return transpose_dist(prod_t, conj=conj)
     if b.grid != (p, q) or b.nb != a.nb or a.n != b.m:
         raise ValueError("hemm_summa operands must share mesh/nb and dims")
@@ -189,7 +193,8 @@ def hemm_summa(
         out = _hemm_a_jit(a.tiles, b.tiles, ct, alpha, beta, a.mesh, p, q, uplo, conj)
     else:
         out = _hemm_jit(a.tiles, b.tiles, ct, alpha, beta, a.mesh, p, q, a.nt,
-                        uplo, conj, la_depth(lookahead, a.nt))
+                        uplo, conj, la_depth(lookahead, a.nt),
+                        resolve_bcast_impl(bcast_impl))
     return DistMatrix(tiles=out, m=a.m, n=b.n, nb=a.nb, mesh=a.mesh)
 
 
@@ -262,8 +267,9 @@ def _hemm_a_jit(at, bt, ct, alpha, beta, mesh, p, q, uplo, conj):
     return (alpha * prod + beta * ct).astype(at.dtype)
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11))
-def _hemm_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, uplo, conj, la=0):
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
+def _hemm_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, uplo, conj, la=0,
+              bi="psum"):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(a_loc, b_loc):
@@ -287,9 +293,11 @@ def _hemm_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, uplo, conj, la=0):
         acc0 = jnp.zeros((mtl, ntl, nb, nb), dtype)
         return prefetch_bcast(kt, la, fetch, consume, acc0)
 
-    prod = shard_map_compat(
-        kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
-    )(at, bt)
+    with bcast_impl_scope(bi):
+        prod = shard_map_compat(
+            kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec,
+            check_vma=False,
+        )(at, bt)
     if ct is None:
         return (alpha * prod).astype(at.dtype)
     return (alpha * prod + beta * ct).astype(at.dtype)
@@ -305,6 +313,7 @@ def trmm_dist(
     a: DistMatrix,
     b: DistMatrix,
     lookahead: Optional[int] = None,
+    bcast_impl: Optional[str] = None,
 ) -> DistMatrix:
     """B := alpha op(A) B (Left) / alpha B op(A) (Right), A triangular
     (src/trmm.cc).  Left runs natively (SUMMA with the triangle mask and,
@@ -325,18 +334,20 @@ def trmm_dist(
             # B A^H = (A B^H)^H: conjugate via double transpose path
             bt_ = transpose_dist(b, conj=True)
             out_t = trmm_dist(Side.Left, uplo, Op.NoTrans, diag,
-                              jnp.conj(alpha), a, bt_, lookahead=lookahead)
+                              jnp.conj(alpha), a, bt_, lookahead=lookahead,
+                              bcast_impl=bcast_impl)
             return transpose_dist(out_t, conj=True)
         out_t = trmm_dist(Side.Left, uplo, opt, diag, alpha, at_, bt_,
-                          lookahead=lookahead)
+                          lookahead=lookahead, bcast_impl=bcast_impl)
         return transpose_dist(out_t)
     out = _trmm_jit(a.tiles, b.tiles, alpha, a.mesh, p, q, a.nt, uplo, op,
-                    diag, la_depth(lookahead, a.nt))
+                    diag, la_depth(lookahead, a.nt),
+                    resolve_bcast_impl(bcast_impl))
     return DistMatrix(tiles=out, m=a.m, n=b.n, nb=a.nb, mesh=a.mesh)
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
-def _trmm_jit(at, bt, alpha, mesh, p, q, kt, uplo, op, diag, la=0):
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
+def _trmm_jit(at, bt, alpha, mesh, p, q, kt, uplo, op, diag, la=0, bi="psum"):
     spec = P(ROW_AXIS, COL_AXIS)
     lower = uplo == Uplo.Lower
 
@@ -385,9 +396,11 @@ def _trmm_jit(at, bt, alpha, mesh, p, q, kt, uplo, op, diag, la=0):
         acc0 = jnp.zeros((mtl, ntl, nb, nb), dtype)
         return prefetch_bcast(kt, la, fetch, consume, acc0)
 
-    prod = shard_map_compat(
-        kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
-    )(at, bt)
+    with bcast_impl_scope(bi):
+        prod = shard_map_compat(
+            kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec,
+            check_vma=False,
+        )(at, bt)
     return (alpha * prod).astype(at.dtype)
 
 
@@ -402,6 +415,7 @@ def her2k_dist(
     conj: bool = True,
     full: bool = False,
     lookahead: Optional[int] = None,
+    bcast_impl: Optional[str] = None,
 ) -> DistMatrix:
     """C := alpha A B^H + conj(alpha) B A^H + beta C (conj=True,
     src/her2k.cc) or the ^T / plain-alpha variant (conj=False, syr2k).
@@ -415,21 +429,22 @@ def her2k_dist(
         raise ValueError("her2k_dist: C layout must match A B^H")
     ct = None if c is None else c.tiles
     out = _her2k_jit(a.tiles, b.tiles, ct, alpha, beta, a.mesh, p, q,
-                     a.nt, a.n, uplo, conj, full, la_depth(lookahead, a.nt))
+                     a.nt, a.n, uplo, conj, full, la_depth(lookahead, a.nt),
+                     resolve_bcast_impl(bcast_impl))
     no_pad = a.mt * a.nb == a.m
     return DistMatrix(tiles=out, m=a.m, n=a.m, nb=a.nb, mesh=a.mesh, diag_pad=no_pad)
 
 
 @instrument("syr2k_dist")
 def syr2k_dist(alpha, a, b, beta=0.0, c=None, uplo: Uplo = Uplo.Lower, full=False,
-               lookahead: Optional[int] = None):
+               lookahead: Optional[int] = None, bcast_impl: Optional[str] = None):
     return her2k_dist(alpha, a, b, beta, c, uplo, conj=False, full=full,
-                      lookahead=lookahead)
+                      lookahead=lookahead, bcast_impl=bcast_impl)
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13))
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13, 14))
 def _her2k_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, k_true, uplo, conj,
-               full, la=0):
+               full, la=0, bi="psum"):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(a_loc, b_loc):
@@ -469,9 +484,11 @@ def _her2k_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, k_true, uplo, conj,
             acc = jnp.where(keep, acc, 0)
         return acc
 
-    prod = shard_map_compat(
-        kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
-    )(at, bt)
+    with bcast_impl_scope(bi):
+        prod = shard_map_compat(
+            kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec,
+            check_vma=False,
+        )(at, bt)
     if ct is None:
         return prod.astype(at.dtype)
     return (prod + beta * ct).astype(at.dtype)

@@ -8,11 +8,14 @@ listBcastMT pattern, potrf.cc:124-134), herk the trailing matrix.
 Design inversion: the OpenMP task graph + MOSI tile migration becomes ONE
 ``lax.fori_loop`` inside ``shard_map_compat``.  Per iteration:
 
-- diagonal tile -> all devices via two masked psums; every device factors the
+- diagonal tile -> all devices via a two-hop rooted broadcast
+  (comm.bcast_diag_tile; ppermute ring/doubling under Option.BcastImpl,
+  masked double psum under the legacy lowering); every device factors the
   nb x nb tile redundantly (replicated flops are cheaper than a second
   broadcast — the panel is latency-bound, reference P4).
-- panel trsm happens on the owning mesh column, then one psum over axis 'q'
-  gives every device the panel tiles for its row set (tileBcast down rows).
+- panel trsm happens on the owning mesh column, then one rooted broadcast
+  along axis 'q' gives every device the panel tiles for its row set
+  (tileBcast down rows).
 - the her-k update needs the panel indexed by *column* too: an all_gather
   over axis 'p' (n * nb elements — small) plus a cyclic index-map gather
   replaces the reference's transposed bcast list (potrf.cc:129-133).
@@ -47,10 +50,12 @@ from .comm import (
     audit_scope,
     bcast_diag_tile,
     bcast_from_col,
+    bcast_impl_scope,
     bucket_plan,
     la_depth,
     local_indices,
     pipelined_factor_loop,
+    resolve_bcast_impl,
     shard_map_compat,
 )
 
@@ -58,7 +63,8 @@ from typing import Optional
 
 @instrument("potrf_dist")
 def potrf_dist(
-    a: DistMatrix, lookahead: Optional[int] = None
+    a: DistMatrix, lookahead: Optional[int] = None,
+    bcast_impl: Optional[str] = None,
 ) -> Tuple[DistMatrix, jax.Array]:
     """Factor A = L L^H (lower). ``a`` holds the lower triangle (upper tile
     content ignored). Returns (L as DistMatrix, info).
@@ -67,19 +73,24 @@ def potrf_dist(
     software-pipelines the k-loop: each step's trailing herk is deferred
     into the next iteration so the panel broadcasts overlap it
     (potrf.cc:129-133's lookahead queues).  Results are bitwise-identical
-    at any depth."""
+    at any depth.  ``bcast_impl`` (Option.BcastImpl) picks the panel /
+    diag-tile broadcast lowering — masked psum or the ppermute engine —
+    also bitwise-identical."""
     p, q = mesh_shape(a.mesh)
     if a.mt != a.nt:
         raise ValueError("potrf_dist needs a square tile grid")
     a.require_diag_pad("potrf_dist")
-    lt, info = _potrf_jit(a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt))
+    lt, info = _potrf_jit(
+        a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
+        resolve_bcast_impl(bcast_impl),
+    )
     return DistMatrix(
         tiles=lt, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True
     ), info
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
-def _potrf_jit(at, mesh, p, q, nt, la):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
+def _potrf_jit(at, mesh, p, q, nt, la, bi):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
@@ -206,19 +217,21 @@ def _potrf_jit(at, mesh, p, q, nt, la):
         info = jnp.where(info >= big, 0, info).astype(jnp.int32)
         return t_loc, info[None, None]
 
-    lt, info = shard_map_compat(
-        kernel,
-        mesh=mesh,
-        in_specs=(spec,),
-        out_specs=(spec, P(ROW_AXIS, COL_AXIS)),
-        check_vma=False,
-    )(at)
+    with bcast_impl_scope(bi):
+        lt, info = shard_map_compat(
+            kernel,
+            mesh=mesh,
+            in_specs=(spec,),
+            out_specs=(spec, P(ROW_AXIS, COL_AXIS)),
+            check_vma=False,
+        )(at)
     return lt, jnp.max(info)
 
 
 @instrument("pbtrf_band_dist")
 def pbtrf_band_dist(
-    a: DistMatrix, kd: int, lookahead: Optional[int] = None
+    a: DistMatrix, kd: int, lookahead: Optional[int] = None,
+    bcast_impl: Optional[str] = None,
 ) -> Tuple[DistMatrix, jax.Array]:
     """Band Cholesky on the mesh at band cost (src/pbtrf.cc): the k-loop
     only ever touches the O(wd^2) tile window inside the bandwidth —
@@ -235,15 +248,16 @@ def pbtrf_band_dist(
     # last tile row touched by column k*nb..k*nb+nb-1 under bandwidth kd
     wd = min(((nb - 1) + kd) // nb + 1, a.nt)
     lt, info = _pbtrf_band_jit(
-        a.tiles, a.mesh, p, q, a.nt, wd, la_depth(lookahead, a.nt)
+        a.tiles, a.mesh, p, q, a.nt, wd, la_depth(lookahead, a.nt),
+        resolve_bcast_impl(bcast_impl),
     )
     return DistMatrix(
         tiles=lt, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True
     ), info
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
-def _pbtrf_band_jit(at, mesh, p, q, nt, wd, la):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
+def _pbtrf_band_jit(at, mesh, p, q, nt, wd, la, bi):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
@@ -371,11 +385,12 @@ def _pbtrf_band_jit(at, mesh, p, q, nt, wd, la):
         info = jnp.where(info >= big, 0, info).astype(jnp.int32)
         return t_loc, info[None, None]
 
-    lt, info = shard_map_compat(
-        kernel,
-        mesh=mesh,
-        in_specs=(spec,),
-        out_specs=(spec, P(ROW_AXIS, COL_AXIS)),
-        check_vma=False,
-    )(at)
+    with bcast_impl_scope(bi):
+        lt, info = shard_map_compat(
+            kernel,
+            mesh=mesh,
+            in_specs=(spec,),
+            out_specs=(spec, P(ROW_AXIS, COL_AXIS)),
+            check_vma=False,
+        )(at)
     return lt, jnp.max(info)

@@ -6,9 +6,11 @@ potrf: panel, bcast, trailing gemm) and ``src/getrf_tntpiv.cc`` (CALU) with
 ``src/internal/internal_swap.cc``'s cross-rank row motion.
 
 Per k inside one ``lax.fori_loop`` (see dist_chol.py for the pattern):
-- diagonal tile -> everyone (masked psums), factored redundantly with the
-  recursive no-pivot tile LU (linalg.lu._getrf_nopiv_rec — the analogue of
-  the reference delegating the diag tile to lapack::getrf).
+- diagonal tile -> everyone (comm.bcast_diag_tile: rooted two-hop
+  broadcast under Option.BcastImpl, masked double psum under the legacy
+  lowering), factored redundantly with the recursive no-pivot tile LU
+  (linalg.lu._getrf_nopiv_rec — the analogue of the reference delegating
+  the diag tile to lapack::getrf).
 - owning column solves L[i,k] U_kk^{-1} (trsm right-upper), owning row
   solves L_kk^{-1} A[k,j] (trsm left-unit-lower) — internal::trsm specials.
 - panel column bcast along 'q', panel row bcast along 'p'
@@ -49,11 +51,13 @@ from .comm import (
     bcast_diag_tile,
     bcast_from_col,
     bcast_from_row,
+    bcast_impl_scope,
     bucket_plan,
     la_depth,
     local_indices,
     pipelined_factor_loop,
     psum_a,
+    resolve_bcast_impl,
     shard_map_compat,
 )
 
@@ -61,19 +65,25 @@ from typing import Optional
 
 @instrument("getrf_nopiv_dist")
 def getrf_nopiv_dist(
-    a: DistMatrix, lookahead: Optional[int] = None
+    a: DistMatrix, lookahead: Optional[int] = None,
+    bcast_impl: Optional[str] = None,
 ) -> Tuple[DistMatrix, jax.Array]:
     """Factor A = L U in place (packed LU tiles). Returns (LU, info).
 
     ``lookahead`` (Option.Lookahead; None = the option default, 1) defers
     each step's trailing gemm into the next iteration so the panel
     broadcasts overlap it (getrf_nopiv.cc's lookahead queues); results
-    are bitwise-identical at any depth."""
+    are bitwise-identical at any depth.  ``bcast_impl``
+    (Option.BcastImpl) picks the panel-broadcast lowering, also
+    bitwise-identical."""
     p, q = mesh_shape(a.mesh)
     if a.mt != a.nt:
         raise ValueError("getrf_nopiv_dist needs a square tile grid")
     a.require_diag_pad("getrf_nopiv_dist")
-    lut, info = _lu_jit(a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt))
+    lut, info = _lu_jit(
+        a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
+        resolve_bcast_impl(bcast_impl),
+    )
     return DistMatrix(
         tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True
     ), info
@@ -205,8 +215,8 @@ def _lu_info_dist(t_loc, i_log, j_log, nt, nb):
     return jnp.where(info >= big, 0, info).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
-def _lu_jit(at, mesh, p, q, nt, la):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
+def _lu_jit(at, mesh, p, q, nt, la, bi):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
@@ -246,13 +256,14 @@ def _lu_jit(at, mesh, p, q, nt, la):
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, info[None, None]
 
-    lut, info = shard_map_compat(
-        kernel,
-        mesh=mesh,
-        in_specs=(spec,),
-        out_specs=(spec, P(ROW_AXIS, COL_AXIS)),
-        check_vma=False,
-    )(at)
+    with bcast_impl_scope(bi):
+        lut, info = shard_map_compat(
+            kernel,
+            mesh=mesh,
+            in_specs=(spec,),
+            out_specs=(spec, P(ROW_AXIS, COL_AXIS)),
+            check_vma=False,
+        )(at)
     return lut, jnp.max(info)
 
 
@@ -263,7 +274,8 @@ def _lu_jit(at, mesh, p, q, nt, la):
 
 @instrument("getrf_tntpiv_dist")
 def getrf_tntpiv_dist(
-    a: DistMatrix, lookahead: Optional[int] = None
+    a: DistMatrix, lookahead: Optional[int] = None,
+    bcast_impl: Optional[str] = None,
 ) -> Tuple[DistMatrix, jax.Array, jax.Array]:
     """Factor P A = L U with tournament pivoting across the mesh.
 
@@ -284,7 +296,8 @@ def getrf_tntpiv_dist(
         raise ValueError("getrf_tntpiv_dist needs a square tile grid")
     a.require_diag_pad("getrf_tntpiv_dist")
     lut, perm, info = _tntpiv_jit(
-        a.tiles, a.mesh, p, q, a.nt, a.m, la_depth(lookahead, a.nt)
+        a.tiles, a.mesh, p, q, a.nt, a.m, la_depth(lookahead, a.nt),
+        resolve_bcast_impl(bcast_impl),
     )
     return (
         DistMatrix(tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True),
@@ -293,8 +306,8 @@ def getrf_tntpiv_dist(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
-def _tntpiv_jit(at, mesh, p, q, nt, m_true, la):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
+def _tntpiv_jit(at, mesh, p, q, nt, m_true, la, bi):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
@@ -425,13 +438,14 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true, la):
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, rowperm[None], info[None, None]
 
-    lut, perm, info = shard_map_compat(
-        kernel,
-        mesh=mesh,
-        in_specs=(spec,),
-        out_specs=(spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS)),
-        check_vma=False,
-    )(at)
+    with bcast_impl_scope(bi):
+        lut, perm, info = shard_map_compat(
+            kernel,
+            mesh=mesh,
+            in_specs=(spec,),
+            out_specs=(spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS)),
+            check_vma=False,
+        )(at)
     # every device computes the identical replicated permutation; the
     # out-spec stacks one copy per mesh row — take the first
     return lut, perm[0], jnp.max(info)
@@ -446,7 +460,8 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true, la):
 
 @instrument("getrf_pp_dist")
 def getrf_pp_dist(
-    a: DistMatrix, lookahead: Optional[int] = None
+    a: DistMatrix, lookahead: Optional[int] = None,
+    bcast_impl: Optional[str] = None,
 ) -> Tuple[DistMatrix, jax.Array, jax.Array]:
     """Factor P A = L U with classic partial (per-column argmax) pivoting.
 
@@ -471,7 +486,8 @@ def getrf_pp_dist(
         raise ValueError("getrf_pp_dist needs a square tile grid")
     a.require_diag_pad("getrf_pp_dist")
     lut, perm, info = _pp_jit(
-        a.tiles, a.mesh, p, q, a.nt, a.m, la_depth(lookahead, a.nt)
+        a.tiles, a.mesh, p, q, a.nt, a.m, la_depth(lookahead, a.nt),
+        resolve_bcast_impl(bcast_impl),
     )
     return (
         DistMatrix(tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True),
@@ -648,8 +664,8 @@ def _pp_panel_and_swaps(t_loc, rowperm, k, p, q, r, c, nt, m_true,
     )
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
-def _pp_jit(at, mesh, p, q, nt, m_true, la):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
+def _pp_jit(at, mesh, p, q, nt, m_true, la, bi):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
@@ -709,19 +725,21 @@ def _pp_jit(at, mesh, p, q, nt, m_true, la):
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, rowperm[None], info[None, None]
 
-    lut, perm, info = shard_map_compat(
-        kernel,
-        mesh=mesh,
-        in_specs=(spec,),
-        out_specs=(spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS)),
-        check_vma=False,
-    )(at)
+    with bcast_impl_scope(bi):
+        lut, perm, info = shard_map_compat(
+            kernel,
+            mesh=mesh,
+            in_specs=(spec,),
+            out_specs=(spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS)),
+            check_vma=False,
+        )(at)
     return lut, perm[0], jnp.max(info)
 
 
 @instrument("gbtrf_band_dist")
 def gbtrf_band_dist(
-    a: DistMatrix, kl: int, ku: int, lookahead: Optional[int] = None
+    a: DistMatrix, kl: int, ku: int, lookahead: Optional[int] = None,
+    bcast_impl: Optional[str] = None,
 ) -> Tuple[DistMatrix, jax.Array, jax.Array]:
     """Band partial-pivot LU on the mesh at band cost (src/gbtrf.cc):
     the shared getrf_pp_dist pivoting/swap machinery (_pp_panel_and_swaps)
@@ -751,7 +769,8 @@ def gbtrf_band_dist(
     # tile k - (wd_l - 1); its U fill right to tile k + wd_usw - 1
     wd_usw = min(((nb - 1) + 2 * kl + ku) // nb + 1, a.nt)
     lut, perm, info = _gb_pp_jit(
-        a.tiles, a.mesh, p, q, a.nt, a.m, wd_l, wd_u, wd_usw
+        a.tiles, a.mesh, p, q, a.nt, a.m, wd_l, wd_u, wd_usw,
+        resolve_bcast_impl(bcast_impl),
     )
     return (
         DistMatrix(tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True),
@@ -760,8 +779,8 @@ def gbtrf_band_dist(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8))
-def _gb_pp_jit(at, mesh, p, q, nt, m_true, wd_l, wd_u, wd_usw):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9))
+def _gb_pp_jit(at, mesh, p, q, nt, m_true, wd_l, wd_u, wd_usw, bi):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
@@ -836,13 +855,14 @@ def _gb_pp_jit(at, mesh, p, q, nt, m_true, wd_l, wd_u, wd_usw):
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, rowperm[None], info[None, None]
 
-    lut, perm, info = shard_map_compat(
-        kernel,
-        mesh=mesh,
-        in_specs=(spec,),
-        out_specs=(spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS)),
-        check_vma=False,
-    )(at)
+    with bcast_impl_scope(bi):
+        lut, perm, info = shard_map_compat(
+            kernel,
+            mesh=mesh,
+            in_specs=(spec,),
+            out_specs=(spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS)),
+            check_vma=False,
+        )(at)
     return lut, perm[0], jnp.max(info)
 
 

@@ -42,7 +42,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..linalg.tridiag import _DC_SMALL, _secular_roots_shard, _zhat_shard, steqr
 from ..obs import instrument
-from .comm import PRECISE, all_gather_a, psum_a, shard_map_compat
+from .comm import PRECISE, all_gather_a, bcast_from_row, shard_map_compat
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 
 
@@ -122,9 +122,11 @@ def _stedc_dist_jit(dp, ep, mesh, p, q, N, levels):
             rho = ep[(2 * jnp.arange(m) + 1) * s - 1]
             dd = w.reshape(m, 2 * s)
             qp = q_loc.reshape(m, 2, rows_per, s)
-            # boundary rows -> replicated z (psum over the row axis)
-            bot = psum_a(jnp.where(r == p - 1, qp[:, 0, -1, :], 0), ROW_AXIS)
-            top = psum_a(jnp.where(r == 0, qp[:, 1, 0, :], 0), ROW_AXIS)
+            # boundary rows -> replicated z: rooted broadcasts from the
+            # static owner rows (comm engine; psum lowering by default —
+            # this kernel does not thread Option.BcastImpl)
+            bot = bcast_from_row(qp[:, 0, -1, :], p - 1)
+            top = bcast_from_row(qp[:, 1, 0, :], 0)
             z = jnp.concatenate([bot, top], axis=1)  # (m, 2s)
             order = jnp.argsort(dd, axis=1)
             dd_s = jnp.take_along_axis(dd, order, axis=1)

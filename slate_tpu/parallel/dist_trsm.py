@@ -31,10 +31,12 @@ from .comm import (
     bcast_diag_tile,
     bcast_from_col,
     bcast_from_row,
+    bcast_impl_scope,
     la_depth,
     local_indices,
     prefetch_bcast,
     psum_scatter_a,
+    resolve_bcast_impl,
     route_to_block_cyclic_rows,
     shard_map_compat,
 )
@@ -51,6 +53,7 @@ def trsm_dist(
     diag: Diag = Diag.NonUnit,
     method: Optional[MethodTrsm] = None,
     lookahead: Optional[int] = None,
+    bcast_impl: Optional[str] = None,
 ) -> DistMatrix:
     """Solve op(A) X = B; A triangular-distributed, B distributed. X
     overwrites B's layout (left side; alpha folded by callers).
@@ -81,17 +84,20 @@ def trsm_dist(
     if method is None:
         method = select_trsm_method(Side.Left, b.mt, b.nt)
     la = la_depth(lookahead, a.nt)
+    bi = resolve_bcast_impl(bcast_impl)
     if method == MethodTrsm.TrsmA:
-        xt = _trsm_a_jit(a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag, la)
+        xt = _trsm_a_jit(
+            a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag, la, bi
+        )
     else:
         xt = _trsm_jit(
-            a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag, la
+            a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag, la, bi
         )
     return DistMatrix(tiles=xt, m=b.m, n=b.n, nb=b.nb, mesh=b.mesh)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
-def _trsm_a_jit(at, bt, mesh, p, q, nt, uplo, op, diag, la=0):
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10))
+def _trsm_a_jit(at, bt, mesh, p, q, nt, uplo, op, diag, la=0, bi="psum"):
     """Stationary-A left solve, all ops (slate::trsmA, src/trsmA.cc
     semantics): per step the solved X row is all-gathered and multiplied
     against A's stationary tiles where they live — column k of A for
@@ -177,13 +183,15 @@ def _trsm_a_jit(at, bt, mesh, p, q, nt, uplo, op, diag, la=0):
 
         return prefetch_bcast(nt, la, fetch, consume, b_loc)
 
-    return shard_map_compat(
-        kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
-    )(at, bt)
+    with bcast_impl_scope(bi):
+        return shard_map_compat(
+            kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec,
+            check_vma=False,
+        )(at, bt)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
-def _trsm_jit(at, bt, mesh, p, q, nt, uplo, op, diag, la=0):
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10))
+def _trsm_jit(at, bt, mesh, p, q, nt, uplo, op, diag, la=0, bi="psum"):
     spec = P(ROW_AXIS, COL_AXIS)
     trans = op != Op.NoTrans
     conj = op == Op.ConjTrans
@@ -251,9 +259,11 @@ def _trsm_jit(at, bt, mesh, p, q, nt, uplo, op, diag, la=0):
 
         return prefetch_bcast(nt, la, fetch, consume, b_loc)
 
-    return shard_map_compat(
-        kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
-    )(at, bt)
+    with bcast_impl_scope(bi):
+        return shard_map_compat(
+            kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec,
+            check_vma=False,
+        )(at, bt)
 
 
 @instrument("trsm_dist_right")
@@ -264,6 +274,7 @@ def trsm_dist_right(
     op: Op = Op.NoTrans,
     diag: Diag = Diag.NonUnit,
     lookahead: Optional[int] = None,
+    bcast_impl: Optional[str] = None,
 ) -> DistMatrix:
     """Solve X op(A) = B; A triangular-distributed (n, n), B (m, n).
     X overwrites B's layout.  ``lookahead`` prefetches A's read-only
@@ -277,13 +288,13 @@ def trsm_dist_right(
     a.require_diag_pad("trsm_dist_right")
     xt = _trsm_right_jit(
         a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag,
-        la_depth(lookahead, a.nt),
+        la_depth(lookahead, a.nt), resolve_bcast_impl(bcast_impl),
     )
     return DistMatrix(tiles=xt, m=b.m, n=b.n, nb=b.nb, mesh=b.mesh)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9))
-def _trsm_right_jit(at, bt, mesh, p, q, nt, uplo, op, diag, la=0):
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10))
+def _trsm_right_jit(at, bt, mesh, p, q, nt, uplo, op, diag, la=0, bi="psum"):
     spec = P(ROW_AXIS, COL_AXIS)
     trans = op != Op.NoTrans
     conj = op == Op.ConjTrans
@@ -349,6 +360,8 @@ def _trsm_right_jit(at, bt, mesh, p, q, nt, uplo, op, diag, la=0):
 
         return prefetch_bcast(nt, la, fetch, consume, b_loc)
 
-    return shard_map_compat(
-        kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
-    )(at, bt)
+    with bcast_impl_scope(bi):
+        return shard_map_compat(
+            kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec,
+            check_vma=False,
+        )(at, bt)

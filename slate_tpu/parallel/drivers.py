@@ -45,6 +45,14 @@ def _la(opts: Optional[Options]):
     return get_option(opts, Option.Lookahead)
 
 
+def _bi(opts: Optional[Options]):
+    """Raw Option.BcastImpl value from a driver ``opts`` mapping — the
+    tileBcast lowering every mesh k-loop consumes.  May be None:
+    ``comm.resolve_bcast_impl`` inside each kernel is the single
+    authority for the context/env/auto default chain."""
+    return get_option(opts, Option.BcastImpl)
+
+
 def _ft_on(opts: Optional[Options]) -> bool:
     """True when Option.FaultTolerance selects an active ABFT policy.
     Off (the default) keeps this module on the plain kernels with zero
@@ -74,7 +82,8 @@ def gemm_mesh(
     ad = from_dense(a, mesh, nb)
     bd = from_dense(b, mesh, nb)
     cd = from_dense(c, mesh, nb) if c is not None else None
-    return to_dense(gemm_summa(alpha, ad, bd, beta, cd, lookahead=_la(opts)))
+    return to_dense(gemm_summa(alpha, ad, bd, beta, cd, lookahead=_la(opts),
+                               bcast_impl=_bi(opts)))
 
 
 @instrument("potrf_mesh")
@@ -90,7 +99,8 @@ def potrf_mesh(
 
         return potrf_mesh_ft(a, mesh, nb, opts)
     return potrf_dist(
-        from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts)
+        from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts),
+        bcast_impl=_bi(opts),
     )
 
 
@@ -103,11 +113,11 @@ def posv_mesh(
     Option.FaultTolerance protects the O(n^3) factorization (rerouted
     via potrf_mesh); the O(n^2 nrhs) trsm sweeps run unprotected —
     the factor dominates both flops and fault exposure."""
-    la = _la(opts)
+    la, bi = _la(opts), _bi(opts)
     l, info = potrf_mesh(a, mesh, nb, opts)
     bd = from_dense(b, mesh, nb)
-    y = trsm_dist(l, bd, Uplo.Lower, Op.NoTrans, lookahead=la)
-    x = trsm_dist(l, y, Uplo.Lower, Op.ConjTrans, lookahead=la)
+    y = trsm_dist(l, bd, Uplo.Lower, Op.NoTrans, lookahead=la, bcast_impl=bi)
+    x = trsm_dist(l, y, Uplo.Lower, Op.ConjTrans, lookahead=la, bcast_impl=bi)
     return to_dense(x), info
 
 
@@ -123,7 +133,8 @@ def getrf_nopiv_mesh(
 
         return getrf_nopiv_mesh_ft(a, mesh, nb, opts)
     return getrf_nopiv_dist(
-        from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts)
+        from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts),
+        bcast_impl=_bi(opts),
     )
 
 
@@ -137,11 +148,12 @@ def gesv_nopiv_mesh(
     preconditioner (linalg.rbt), or the single-chip partial-pivot getrf.
     Option.FaultTolerance protects the factorization (via
     getrf_nopiv_mesh); the trsm sweeps run unprotected."""
-    la = _la(opts)
+    la, bi = _la(opts), _bi(opts)
     lu, info = getrf_nopiv_mesh(a, mesh, nb, opts)
     bd = from_dense(b, mesh, nb)
-    y = trsm_dist(lu, bd, Uplo.Lower, Op.NoTrans, Diag.Unit, lookahead=la)
-    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans, lookahead=la)
+    y = trsm_dist(lu, bd, Uplo.Lower, Op.NoTrans, Diag.Unit, lookahead=la,
+                  bcast_impl=bi)
+    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans, lookahead=la, bcast_impl=bi)
     return to_dense(x), info
 
 
@@ -274,7 +286,8 @@ def getrf_tntpiv_mesh(
     """Distributed tournament-pivoted LU (src/getrf_tntpiv.cc): P A = L U.
     Returns (LU, perm over the padded row space, info)."""
     return getrf_tntpiv_dist(
-        from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts)
+        from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts),
+        bcast_impl=_bi(opts),
     )
 
 
@@ -285,12 +298,13 @@ def gesv_tntpiv_mesh(
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed general solve with tournament pivoting
     (src/gesv.cc with MethodLU::CALU): factor, permute B, two trsm sweeps."""
-    la = _la(opts)
+    la, bi = _la(opts), _bi(opts)
     lu, perm, info = getrf_tntpiv_mesh(a, mesh, nb, opts)
     bd = from_dense(b, mesh, nb)
     pb = permute_rows_dist(bd, perm)
-    y = trsm_dist(lu, pb, Uplo.Lower, Op.NoTrans, Diag.Unit, lookahead=la)
-    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans, lookahead=la)
+    y = trsm_dist(lu, pb, Uplo.Lower, Op.NoTrans, Diag.Unit, lookahead=la,
+                  bcast_impl=bi)
+    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans, lookahead=la, bcast_impl=bi)
     return to_dense(x), info
 
 
@@ -463,7 +477,7 @@ def hbmm_mesh(
     bd = from_dense(b, mesh, nb)
     cd = from_dense(c, mesh, nb) if c is not None else None
     return to_dense(hemm_summa(side, alpha, ad, bd, beta, cd, uplo=uplo,
-                               lookahead=_la(opts)))
+                               lookahead=_la(opts), bcast_impl=_bi(opts)))
 
 
 @instrument("tbsm_mesh")
@@ -499,13 +513,13 @@ def pbsv_mesh(
     from ..core.matrix import band_project
     from .dist_chol import pbtrf_band_dist
 
-    la = _la(opts)
+    la, bi = _la(opts), _bi(opts)
     ab = band_project(a, kd, kd)
     ad = from_dense(ab, mesh, nb, diag_pad_one=True)
-    l, info = pbtrf_band_dist(ad, kd, lookahead=la)
+    l, info = pbtrf_band_dist(ad, kd, lookahead=la, bcast_impl=bi)
     bd = from_dense(b, mesh, nb)
-    y = trsm_dist(l, bd, Uplo.Lower, Op.NoTrans, lookahead=la)
-    x = trsm_dist(l, y, Uplo.Lower, Op.ConjTrans, lookahead=la)
+    y = trsm_dist(l, bd, Uplo.Lower, Op.NoTrans, lookahead=la, bcast_impl=bi)
+    x = trsm_dist(l, y, Uplo.Lower, Op.ConjTrans, lookahead=la, bcast_impl=bi)
     return to_dense(x), info
 
 
@@ -522,14 +536,15 @@ def gbsv_mesh(
     from ..core.matrix import band_project
     from .dist_lu import gbtrf_band_dist
 
-    la = _la(opts)
+    la, bi = _la(opts), _bi(opts)
     ab = band_project(a, kl, ku)
     ad = from_dense(ab, mesh, nb, diag_pad_one=True)
-    lu, perm, info = gbtrf_band_dist(ad, kl, ku, lookahead=la)
+    lu, perm, info = gbtrf_band_dist(ad, kl, ku, lookahead=la, bcast_impl=bi)
     bd = from_dense(b, mesh, nb)
     pb = permute_rows_dist(bd, perm)
-    y = trsm_dist(lu, pb, Uplo.Lower, Op.NoTrans, Diag.Unit, lookahead=la)
-    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans, lookahead=la)
+    y = trsm_dist(lu, pb, Uplo.Lower, Op.NoTrans, Diag.Unit, lookahead=la,
+                  bcast_impl=bi)
+    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans, lookahead=la, bcast_impl=bi)
     return to_dense(x), info
 
 
@@ -542,7 +557,8 @@ def getrf_mesh(
     (src/getrf.cc:23-200): P A = L U with per-column argmax pivoting.
     Returns (LU, perm over the padded row space, info)."""
     return getrf_pp_dist(
-        from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts)
+        from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts),
+        bcast_impl=_bi(opts),
     )
 
 
@@ -553,10 +569,11 @@ def gesv_mesh(
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed general solve with partial pivoting (src/gesv.cc
     default MethodLU::PartialPiv): factor, permute B, two trsm sweeps."""
-    la = _la(opts)
+    la, bi = _la(opts), _bi(opts)
     lu, perm, info = getrf_mesh(a, mesh, nb, opts)
     bd = from_dense(b, mesh, nb)
     pb = permute_rows_dist(bd, perm)
-    y = trsm_dist(lu, pb, Uplo.Lower, Op.NoTrans, Diag.Unit, lookahead=la)
-    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans, lookahead=la)
+    y = trsm_dist(lu, pb, Uplo.Lower, Op.NoTrans, Diag.Unit, lookahead=la,
+                  bcast_impl=bi)
+    x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans, lookahead=la, bcast_impl=bi)
     return to_dense(x), info

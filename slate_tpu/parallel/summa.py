@@ -4,11 +4,11 @@ TPU-native analogue of ``slate::gemmC`` (src/gemmC.cc:78-192): the reference
 runs a k-loop that broadcasts A's tile-column k along process rows and B's
 tile-row k along process columns (listBcastMT, BaseMatrix.hh:2093), then
 fires batched cuBLAS gemms per device.  Here the same schedule is a
-``shard_map_compat`` kernel: the broadcast is a masked ``lax.psum`` over one mesh
-axis (owner contributes its tiles, everyone else zeros — lowering to an ICI
-all-reduce whose cost equals a broadcast's within 2x, with no tags or
-lifetimes), and the local batched gemm is one einsum over the device's tile
-stack that XLA maps onto the MXU.  Lookahead/overlap (gemmC.cc:147-176) is
+``shard_map_compat`` kernel: the broadcast is a rooted ``comm`` engine verb
+(Option.BcastImpl — a ppermute ring/doubling pipeline by default, the
+legacy masked ``lax.psum`` all-reduce at ~2x the bytes as fallback), and
+the local batched gemm is one einsum over the device's tile stack that XLA
+maps onto the MXU.  Lookahead/overlap (gemmC.cc:147-176) is
 explicit: the k-loop is software-pipelined through ``comm.prefetch_bcast``
 with depth ``Option.Lookahead`` — step k+d's panel broadcasts are issued in
 the same loop body that runs step k's MXU update, so the ICI collective and
@@ -51,6 +51,7 @@ def gemm_summa(
     c: Optional[DistMatrix] = None,
     method: Optional[MethodGemm] = None,
     lookahead: Optional[int] = None,
+    bcast_impl: Optional[str] = None,
 ) -> DistMatrix:
     """C := alpha A B + beta C on block-cyclic tile stacks.
 
@@ -67,6 +68,12 @@ def gemm_summa(
     the option default, 1).  GemmC pipelines its k-loop through
     ``comm.prefetch_bcast``; GemmA has no k-loop (one-shot all_gather
     schedule), so the depth is accepted and ignored there.
+
+    ``bcast_impl`` selects the panel-broadcast lowering (Option.BcastImpl;
+    None = comm.resolve_bcast_impl's default chain): the legacy masked
+    psum or the half-the-bytes ppermute ring/doubling engine — results
+    are bitwise-identical either way.  GemmA's all_gather/psum-reduce
+    schedule has no rooted broadcasts, so the choice is ignored there.
     """
     p, q = mesh_shape(a.mesh)
     if b.grid != (p, q) or b.nb != a.nb:
@@ -83,11 +90,11 @@ def gemm_summa(
     if method == MethodGemm.GemmA:
         return _gemm_summa_a(alpha, a, b, beta, c)
     ctiles = None if c is None else c.tiles
-    from .comm import la_depth
+    from .comm import la_depth, resolve_bcast_impl
 
     out_t = _summa_jit(
         a.tiles, b.tiles, ctiles, alpha, beta, a.mesh, p, q, kt,
-        la_depth(lookahead, kt),
+        la_depth(lookahead, kt), resolve_bcast_impl(bcast_impl),
     )
     return DistMatrix(tiles=out_t, m=a.m, n=b.n, nb=a.nb, mesh=a.mesh)
 
@@ -144,8 +151,8 @@ def _summa_a_jit(at, bt, ct, alpha, beta, mesh, p, q):
     return (alpha * prod + beta * ct).astype(at.dtype)
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9))
-def _summa_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, la):
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10))
+def _summa_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, la, bi):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(a_loc, b_loc):
@@ -171,13 +178,16 @@ def _summa_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, la):
         acc0 = jnp.zeros((mtl, ntl, nb, nb), dtype)
         return prefetch_bcast(kt, la, fetch, consume, acc0)
 
-    prod = shard_map_compat(
-        kernel,
-        mesh=mesh,
-        in_specs=(spec, spec),
-        out_specs=spec,
-        check_vma=False,
-    )(at, bt)
+    from .comm import bcast_impl_scope
+
+    with bcast_impl_scope(bi):  # kernel traces under the static lowering
+        prod = shard_map_compat(
+            kernel,
+            mesh=mesh,
+            in_specs=(spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )(at, bt)
     if ct is None:
         return (alpha * prod).astype(at.dtype)
     return (alpha * prod + beta * ct).astype(at.dtype)

@@ -230,6 +230,16 @@ class Option(enum.Enum):
     # resilience to the MPI/ULFM layer, while under XLA/SPMD the natural
     # unit of protection is the tile algebra itself.
     FaultTolerance = "fault_tolerance"
+    # Broadcast lowering for the mesh k-loops' tileBcast verbs
+    # (parallel/comm.py engine): "psum" (legacy masked all-reduce, ~2x the
+    # bytes a broadcast needs), "ring" (pipelined collective_permute ring,
+    # (s-1)/s * B per link), "doubling" (log2(s)-hop recursive doubling on
+    # power-of-two axes), or "auto" (the default: doubling on power-of-two
+    # axes, ring otherwise).  All lowerings are bitwise-identical in
+    # results; they differ only in wire bytes and hop latency.  Resolution
+    # order: explicit option > comm.use_bcast_impl context >
+    # SLATE_TPU_BCAST_IMPL environment > auto.
+    BcastImpl = "bcast_impl"
 
 
 Options = Mapping[Union[Option, str], Any]

@@ -1,12 +1,38 @@
 """comm_audit coverage (ISSUE 1 satellite): nested audit_scope
 multiplicities, the jit-cache-hit-records-nothing contract, and the
-trace-time recording the jaxpr lint's loop-audit check relies on."""
+trace-time recording the jaxpr lint's loop-audit check relies on.
+
+ISSUE 5 (broadcast engine): the analytic SUMMA/ABFT volume formulas gain
+a per-impl factor — the masked-psum lowering records per-device payload
+bytes, the ppermute ring/doubling lowerings record per-hop LINK bytes
+summing to (s-1) payloads per broadcast — and the acceptance assertion:
+the ring lowering's loop-broadcast wire bytes are <= 0.55x the
+masked-psum path's for summa, potrf, and LU-nopiv at identical
+schedules (they are exactly 0.5x under the documented byte model)."""
+
+import pytest
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from slate_tpu.parallel.comm import audit_scope, comm_audit, psum_a
+
+
+def _wire_bytes(records, p, q, prefix):
+    """Per-device wire bytes of the ``prefix``-op records under the
+    documented byte model: psum (ring all-reduce) 2B(s-1)/s; ppermute
+    hop records carry link bytes, B_hop/s per device."""
+    total = 0.0
+    for op, nbytes, mult in records:
+        if not op.startswith(prefix):
+            continue
+        s = p if "[p]" in op else q
+        if prefix == "psum":
+            total += 2 * nbytes * (s - 1) / s * mult
+        else:
+            total += nbytes / s * mult
+    return total
 
 
 def _psum_i(x):
@@ -112,20 +138,34 @@ def test_summarize_ring_estimates():
     assert np.isclose(recv, expect)
     assert set(by_op) == {"psum", "all_gather", "psum_scatter"}
 
+    # ppermute hop records carry link bytes: recv estimate is nbytes / s,
+    # so a whole rooted q-axis broadcast of B=120 (3 single-pair ring
+    # hops) receives 120 * (q-1)/q per device — half psum's 2B(q-1)/q
+    hop_recs = [("ppermute[q]", 120, 1)] * (q - 1)
+    _, recv_ring, _, by_op2 = mod.summarize(hop_recs, p, q)
+    assert np.isclose(recv_ring, 120 * (q - 1) / q)
+    _, recv_psum, _, _ = mod.summarize([("psum[q]", 120, 1)], p, q)
+    assert np.isclose(recv_ring, recv_psum / 2)
+    assert set(by_op2) == {"ppermute"}
 
-def test_summa_payload_matches_analytic_bcast_volume():
-    """ISSUE 2 satellite: prove the comm_audit counters against the
-    closed-form SUMMA communication volume, not just exercise them.
+
+@pytest.mark.parametrize("impl", ["psum", "ring", "doubling"])
+def test_summa_payload_matches_analytic_bcast_volume(impl):
+    """ISSUE 2 satellite + ISSUE 5 per-impl factor: prove the comm_audit
+    counters against the closed-form SUMMA communication volume.
 
     C-stationary SUMMA broadcasts, per k-step and per device, its A
     tile-column (mtl tiles) along mesh axis 'q' and its B tile-row (ntl
-    tiles) along 'p' — each as one masked psum of nb x nb tiles.  The
-    audited per-device payload must equal kt * (mtl + ntl) * nb^2 *
-    itemsize EXACTLY at every lookahead depth; the depth only moves
-    broadcasts between the prologue (multiplicity 1) and the
-    audit-scoped loop (multiplicity kt - depth), never changing the
-    per-op totals (ISSUE 3: lookahead changes when bytes move, not how
-    many)."""
+    tiles) along 'p'.  Under ``psum`` each broadcast is one masked psum
+    whose audited per-device payload sums to kt * (mtl + ntl) * nb^2 *
+    itemsize EXACTLY at every lookahead depth.  Under the ppermute
+    engine the same schedule records per-hop LINK bytes: every rooted
+    broadcast of payload B moves exactly (s-1) * B across the axis'
+    links (ring: s-1 single-pair hops; doubling: log2 s hops of 1, 2,
+    4... pairs), so the total is kt * ((q-1)*mtl + (p-1)*ntl) * nb^2 *
+    itemsize — and the per-device wire bytes are exactly HALF the psum
+    path's 2B(s-1)/s.  Lookahead still only moves records between the
+    prologue (multiplicity 1) and the scoped loop, never the totals."""
     import jax.numpy as jnp
 
     from slate_tpu.parallel import from_dense, gemm_summa, make_mesh
@@ -140,47 +180,71 @@ def test_summa_payload_matches_analytic_bcast_volume():
                    mesh, nb)
     kt, mtl, ntl = a.nt, a.mt // p, b.nt // q
     itemsize = 4  # f32
-    expect_total = kt * (mtl + ntl) * nb * nb * itemsize
+    a_bytes, b_bytes = mtl * nb * nb * itemsize, ntl * nb * nb * itemsize
+    if impl == "psum":
+        expect_total = kt * (a_bytes + b_bytes)
+        ops = {"psum[p]", "psum[q]"}
+        # one record per broadcast
+        recs_per_bcast = {"psum[q]": 1, "psum[p]": 1}
+    else:
+        # (s-1) link-payloads per rooted broadcast, either hop schedule
+        expect_total = kt * ((q - 1) * a_bytes + (p - 1) * b_bytes)
+        ops = {"ppermute[p]", "ppermute[q]"}
+        hops = (lambda s: s - 1) if impl == "ring" else (
+            lambda s: max(1, s.bit_length() - 1))
+        recs_per_bcast = {"ppermute[q]": hops(q), "ppermute[p]": hops(p)}
 
     for la in (0, 1, 2):
         jax.clear_caches()  # counters record at trace time only
         with comm_audit() as recs:
-            gemm_summa(1.0, a, b, method=MethodGemm.GemmC,
-                       lookahead=la).tiles.block_until_ready()
+            gemm_summa(1.0, a, b, method=MethodGemm.GemmC, lookahead=la,
+                       bcast_impl=impl).tiles.block_until_ready()
 
         assert sum(nbytes * m for _, nbytes, m in recs) == expect_total, la
 
-        # per-op totals: multiplicity-weighted step counts sum to kt
-        steps = {}
-        payload = {}
+        # per-op totals: multiplicity-weighted link bytes per op
+        by_op_bytes, by_op_recs = {}, {}
         for op, nbytes, m in recs:
-            steps[op] = steps.get(op, 0) + m
-            payload.setdefault(op, nbytes)
-            assert payload[op] == nbytes  # same panel size in every record
-        assert set(steps) == {"psum[p]", "psum[q]"}
+            by_op_bytes[op] = by_op_bytes.get(op, 0) + nbytes * m
+            by_op_recs[op] = by_op_recs.get(op, 0) + m
+        assert set(by_op_bytes) == ops
         # A column panel rides axis 'q' (bcast_from_col), B row panel 'p'
-        assert steps["psum[q]"] == kt and payload["psum[q]"] == mtl * nb * nb * itemsize
-        assert steps["psum[p]"] == kt and payload["psum[p]"] == ntl * nb * nb * itemsize
-        # strict: one scoped record per op; depth d: d prologue records
-        # at multiplicity 1 per op + one loop record at kt - d
+        if impl == "psum":
+            assert by_op_bytes["psum[q]"] == kt * a_bytes
+            assert by_op_bytes["psum[p]"] == kt * b_bytes
+        else:
+            assert by_op_bytes["ppermute[q]"] == kt * (q - 1) * a_bytes
+            assert by_op_bytes["ppermute[p]"] == kt * (p - 1) * b_bytes
+        # strict: all records scoped at kt; depth d: d prologue record
+        # sets at multiplicity 1 + the loop records at kt - d
+        n_per_step = sum(recs_per_bcast.values())
         mults = sorted(m for _, _, m in recs)
         if la == 0:
-            assert mults == [kt, kt]
+            assert mults == [kt] * n_per_step
         else:
-            assert mults == [1] * (2 * la) + [kt - la] * 2
+            assert mults == [1] * (la * n_per_step) + [kt - la] * n_per_step
+
+        # the acceptance ratio: engine wire bytes are exactly half psum's
+        if impl != "psum":
+            wire = _wire_bytes(recs, p, q, "ppermute")
+            psum_wire = kt * (2 * a_bytes * (q - 1) / q
+                             + 2 * b_bytes * (p - 1) / p)
+            assert np.isclose(wire, psum_wire / 2)
 
 
-def test_ft_summa_checksum_broadcast_volume():
-    """ISSUE 4 satellite: the ABFT overhead is proven, not estimated.
+@pytest.mark.parametrize("impl", ["psum", "ring"])
+def test_ft_summa_checksum_broadcast_volume(impl):
+    """ISSUE 4 satellite + ISSUE 5 per-impl factor: the ABFT overhead is
+    proven, not estimated.
 
     The checksum-carrying SUMMA broadcasts the same two panels per
     k-step as the plain kernel — the checksum tiles are just more tiles
-    of the augmented grid riding the same masked psums, so the audited
-    per-device payload must equal kt * (mtl_aug + ntl_aug) * nb^2 *
-    itemsize EXACTLY, where the augmented local tile counts come from
-    appending 2 checksum tile rows/cols and re-padding to the mesh lcm.
-    The delta against the plain kernel's analytic volume is therefore
-    exactly the augmentation — no hidden collectives, no extra steps."""
+    of the augmented grid riding the same broadcasts.  Under ``psum``
+    the audited per-device payload equals kt * (mtl_aug + ntl_aug) *
+    nb^2 * itemsize EXACTLY; under ``ring`` the link-byte total is the
+    same panels x (s-1) hop payloads.  The delta against the plain
+    kernel's analytic volume is exactly the augmentation — no hidden
+    collectives, no extra steps — under either lowering."""
     import math
 
     import jax.numpy as jnp
@@ -199,36 +263,176 @@ def test_ft_summa_checksum_broadcast_volume():
     aug = ((mt + 2 + lcm - 1) // lcm) * lcm  # +2 checksum tile rows, re-padded
     mtl_aug, ntl_aug = aug // p, aug // q
     itemsize = 4  # f32
+    a_bytes = mtl_aug * nb * nb * itemsize  # A panel (axis 'q') per step
+    b_bytes = ntl_aug * nb * nb * itemsize  # B panel (axis 'p') per step
 
     jax.clear_caches()  # counters record at trace time only
     with comm_audit() as recs:
-        c, rep = abft.gemm_ft(1.0, a, b, mesh, nb, policy=FtPolicy.Detect)
+        c, rep = abft.gemm_ft(1.0, a, b, mesh, nb, policy=FtPolicy.Detect,
+                              bcast_impl=impl)
     assert rep.clean
     np.testing.assert_allclose(
         np.asarray(c), np.asarray(a) @ np.asarray(b), rtol=1e-5, atol=1e-4
     )
 
     total = sum(nbytes * m for _, nbytes, m in recs)
-    expect_total = kt * (mtl_aug + ntl_aug) * nb * nb * itemsize
+    by_op = {}
+    for op, nbytes, m in recs:
+        by_op[op] = by_op.get(op, 0) + nbytes * m
+
+    if impl == "psum":
+        expect_total = kt * (a_bytes + b_bytes)
+        assert set(by_op) == {"psum[p]", "psum[q]"}
+        assert by_op["psum[q]"] == kt * a_bytes
+        assert by_op["psum[p]"] == kt * b_bytes
+        # overhead vs the plain kernel's analytic volume: exactly the
+        # augmented tile rows/cols (2 checksum + lcm pad), nothing else
+        mtl, ntl = mt // p, nt // q
+        plain_total = kt * (mtl + ntl) * nb * nb * itemsize
+        assert total - plain_total == (
+            kt * ((mtl_aug - mtl) + (ntl_aug - ntl)) * nb * nb * itemsize
+        )
+    else:
+        expect_total = kt * ((q - 1) * a_bytes + (p - 1) * b_bytes)
+        assert set(by_op) == {"ppermute[p]", "ppermute[q]"}
+        assert by_op["ppermute[q]"] == kt * (q - 1) * a_bytes
+        assert by_op["ppermute[p]"] == kt * (p - 1) * b_bytes
+        # same per-impl halving as the plain kernel: ring wire bytes are
+        # exactly half the masked-psum wire bytes for the same schedule
+        wire = _wire_bytes(recs, p, q, "ppermute")
+        psum_wire = kt * (2 * a_bytes * (q - 1) / q
+                          + 2 * b_bytes * (p - 1) / p)
+        assert np.isclose(wire, psum_wire / 2)
     assert total == expect_total
 
-    # overhead vs the plain kernel's analytic volume: exactly the
-    # augmented tile rows/cols (2 checksum + lcm pad), nothing else
-    mtl, ntl = mt // p, nt // q
-    plain_total = kt * (mtl + ntl) * nb * nb * itemsize
-    assert total - plain_total == (
-        kt * ((mtl_aug - mtl) + (ntl_aug - ntl)) * nb * nb * itemsize
-    )
 
-    # per-op split: A panel rides axis 'q', B panel axis 'p', kt steps
-    # each, constant payload — same schedule shape as the plain kernel
-    steps, payload = {}, {}
-    for op, nbytes, m in recs:
-        steps[op] = steps.get(op, 0) + m
-        payload.setdefault(op, nbytes)
-        assert payload[op] == nbytes
-    assert set(steps) == {"psum[p]", "psum[q]"}
-    assert steps["psum[q]"] == kt
-    assert payload["psum[q]"] == mtl_aug * nb * nb * itemsize
-    assert steps["psum[p]"] == kt
-    assert payload["psum[p]"] == ntl_aug * nb * nb * itemsize
+# ---------------------------------------------------------------------------
+# ISSUE 5 acceptance: the ring lowering moves <= 0.55x the masked-psum
+# loop-broadcast bytes for summa, potrf, and LU-nopiv at identical
+# schedules (exactly 0.5x under the documented byte model).
+# ---------------------------------------------------------------------------
+
+
+def _loop_bcast_wire(fn, impl):
+    """Per-device broadcast wire bytes of one driver run under ``impl``.
+    Every psum in these three kernels IS a broadcast (the pivot/panel
+    gathers are all_gather records and excluded by construction), so the
+    broadcast subset is the psum records under psum and the ppermute
+    records under ring/doubling."""
+    from slate_tpu.parallel.comm import use_bcast_impl
+
+    jax.clear_caches()  # counters record at trace time only
+    with comm_audit() as recs:
+        with use_bcast_impl(impl):
+            fn()
+    prefix = "psum" if impl == "psum" else "ppermute"
+    assert any(op.startswith(prefix) for op, _, _ in recs), (impl, recs)
+    return _wire_bytes(list(recs), 2, 4, prefix)
+
+
+@pytest.mark.parametrize("op", ["summa", "potrf", "lu_nopiv"])
+def test_ring_halves_loop_broadcast_bytes(op, rng):
+    from slate_tpu.parallel import from_dense, gemm_summa, make_mesh
+    from slate_tpu.parallel.dist_chol import potrf_dist
+    from slate_tpu.parallel.dist_lu import getrf_nopiv_dist
+    from slate_tpu.types import MethodGemm
+
+    p, q, n, nb = 2, 4, 64, 8
+    mesh = make_mesh(p, q, devices=jax.devices("cpu")[:8])
+    a = jnp.asarray(rng.standard_normal((n, n)))
+    if op == "summa":
+        ad = from_dense(a, mesh, nb)
+        bd = from_dense(jnp.asarray(rng.standard_normal((n, n))), mesh, nb)
+        fn = lambda: gemm_summa(
+            1.0, ad, bd, method=MethodGemm.GemmC
+        ).tiles.block_until_ready()
+    elif op == "potrf":
+        spd = a @ a.T + n * jnp.eye(n)
+        sd = from_dense(spd, mesh, nb, diag_pad_one=True)
+        fn = lambda: potrf_dist(sd)[0].tiles.block_until_ready()
+    else:
+        tl = jnp.asarray(np.tril(np.asarray(a)) + n * np.eye(n))
+        td = from_dense(tl, mesh, nb, diag_pad_one=True)
+        fn = lambda: getrf_nopiv_dist(td)[0].tiles.block_until_ready()
+
+    psum_wire = _loop_bcast_wire(fn, "psum")
+    ring_wire = _loop_bcast_wire(fn, "ring")
+    dbl_wire = _loop_bcast_wire(fn, "doubling")
+    # the acceptance bound, and the exact model value behind it
+    assert ring_wire <= 0.55 * psum_wire, (op, ring_wire, psum_wire)
+    assert np.isclose(ring_wire, psum_wire / 2), (op, ring_wire, psum_wire)
+    # doubling moves the same total link bytes as ring (s-1 payloads)
+    assert np.isclose(dbl_wire, ring_wire), (op, dbl_wire, ring_wire)
+
+
+def test_bcast_diag_tile_two_hop_volume():
+    """ISSUE 5 satellite: bcast_diag_tile was a masked DOUBLE psum (two
+    all-reduces of one tile, ~4x ring-broadcast bytes); under the engine
+    it is a two-hop rooted broadcast — (p-1) row-axis hops then (q-1)
+    column-axis hops of exactly one tile each — delivering the owner's
+    exact bytes to every device."""
+    from jax.sharding import PartitionSpec as P
+
+    from slate_tpu.parallel import make_mesh
+    from slate_tpu.parallel.comm import (
+        bcast_diag_tile, bcast_impl_scope, shard_map_compat,
+    )
+    from slate_tpu.parallel.mesh import COL_AXIS, ROW_AXIS
+
+    p, q, nb = 2, 4, 4
+    mesh = make_mesh(p, q, devices=jax.devices("cpu")[:8])
+    spec = P(ROW_AXIS, COL_AXIS)
+    rng_ = np.random.default_rng(3)
+    # (mt, nt, nb, nb) cyclic tile stack with distinguishable tiles
+    mt = nt = 4
+    tiles = jnp.asarray(rng_.standard_normal((mt, nt, nb, nb)), jnp.float32)
+
+    outs, recs_by = {}, {}
+    for impl in ("psum", "ring", "doubling"):
+        def kernel(t_loc):
+            # deliver tile (k, k) for k = 3 (owner (1, 3) on the 2x4 grid)
+            return bcast_diag_tile(t_loc, 3, p, q, nb)[None, None]
+
+        jax.clear_caches()
+        with comm_audit() as recs:
+            with bcast_impl_scope(impl):
+                out = shard_map_compat(
+                    kernel, mesh=mesh, in_specs=(spec,), out_specs=spec,
+                    check_vma=False,
+                )(tiles)
+            out = np.asarray(jax.block_until_ready(out))
+        outs[impl], recs_by[impl] = out, list(recs)
+
+    # every device got tile (3, 3), bitwise, under every lowering
+    for impl, out in outs.items():
+        for i in range(p):
+            for j in range(q):
+                np.testing.assert_array_equal(
+                    out[i, j], np.asarray(tiles[3, 3]), err_msg=impl
+                )
+
+    tile_bytes = nb * nb * 4
+    # legacy: two full all-reduces of one tile
+    assert recs_by["psum"] == [("psum[p]", tile_bytes, 1),
+                               ("psum[q]", tile_bytes, 1)]
+    # engine: (p-1) + (q-1) single-tile link payloads, row hop first
+    for impl in ("ring", "doubling"):
+        total = sum(nbytes * m for _, nbytes, m in recs_by[impl])
+        assert total == ((p - 1) + (q - 1)) * tile_bytes, impl
+        wire = _wire_bytes(recs_by[impl], p, q, "ppermute")
+        psum_wire = _wire_bytes(recs_by["psum"], p, q, "psum")
+        assert wire == pytest.approx(psum_wire / 2)
+
+
+def test_ppermute_a_records_link_bytes():
+    """The audited ppermute wrapper records operand bytes x pairs (link
+    bytes for the hop), under the enclosing audit_scope multiplicity."""
+    from slate_tpu.parallel.comm import ppermute_a
+
+    def fn(x):
+        with audit_scope(5):
+            return ppermute_a(x, "i", [(0, 1), (1, 0)])
+
+    with comm_audit() as recs:
+        jax.make_jaxpr(jax.vmap(fn, axis_name="i"))(jnp.zeros((2, 4)))
+    assert recs == [("ppermute[i]", 2 * 4 * 8, 5)]  # 2 pairs x 4 f64 lanes

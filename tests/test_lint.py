@@ -292,3 +292,86 @@ def test_cli_exit_codes():
     )
     assert r2.returncode == 1, r2.stdout + r2.stderr
     assert "donation" in r2.stdout
+
+
+def test_ast_pass_flags_masked_psum_bcast(tmp_path):
+    """ISSUE 5: the masked-psum broadcast idiom outside comm.py is a
+    finding (it pays ~2x a rooted broadcast's bytes and bypasses
+    Option.BcastImpl); routing through the engine wrappers is clean."""
+    from slate_tpu.analysis.ast_checks import (
+        _installed_signatures, check_file, check_source,
+    )
+
+    bad = tmp_path / "masked.py"
+    bad.write_text(
+        "from slate_tpu.parallel.comm import psum_a\n"
+        "import jax.numpy as jnp\n"
+        "def k(x, me, owner):\n"
+        "    return psum_a(jnp.where(me == owner, x, 0), 'q')\n"
+    )
+    found = check_file(str(bad), "toy/masked.py", _installed_signatures())
+    rules = [f.rule for f in found]
+    assert rules == ["ast-masked-psum-bcast"], found
+
+    ok = (
+        "from slate_tpu.parallel.comm import bcast_from_col, psum_a\n"
+        "import jax.numpy as jnp\n"
+        "def k(x, me, owner, masked):\n"
+        "    a = bcast_from_col(jnp.where(me == owner, x, 0), owner)\n"
+        "    return a + psum_a(masked, 'q')\n"  # pre-masked var: a reduction
+    )
+    assert check_source(ok, "toy/ok.py", _installed_signatures()) == []
+
+    # inside parallel/comm.py the idiom IS the psum lowering itself
+    in_comm = (
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "def bcast(x, owner):\n"
+        "    me = lax.axis_index('q')\n"
+        "    return lax.psum(jnp.where(me == owner, x, 0), 'q')\n"
+    )
+    assert check_source(in_comm, "slate_tpu/parallel/comm.py",
+                        _installed_signatures()) == []
+
+
+def test_loop_audit_counts_switch_branches_once():
+    """The broadcast engine dispatches rooted hop schedules through
+    lax.switch: exactly one branch executes per trip, so the loop-audit
+    eqn count must take the max over cond branches, not their sum —
+    otherwise every engine-lowered driver would need q x the audit
+    records it can honestly emit."""
+    from slate_tpu.analysis.jaxpr_checks import (
+        check_loop_audit, count_loop_collectives,
+    )
+    from slate_tpu.parallel.comm import audit_scope, comm_audit, psum_a
+
+    def body(i, acc):
+        # 3 branches, each with ONE collective; one audited record is
+        # emitted per loop step by the shared recording below
+        def br(k):
+            return lambda a: a + jax.lax.psum(a * k, "i")
+
+        return acc + jax.lax.switch(i % 3, [br(0), br(1), br(2)], acc)
+
+    def fn(x):
+        with audit_scope(3):
+            # the engine's pattern: record once per hop, outside the switch
+            _ = psum_a(x, "i")  # stands in for the per-hop _rec call
+            return jax.lax.fori_loop(0, 3, body, x)
+
+    with comm_audit() as recs:
+        closed = jax.make_jaxpr(jax.vmap(fn, axis_name="i"))(jnp.zeros((2, 4)))
+    # 3 branches x 1 collective counts as ONE executed collective
+    assert count_loop_collectives(closed) == 1
+    assert check_loop_audit(closed, list(recs), "driver:toy") == []
+
+
+def test_lint_cli_masked_psum_seed():
+    """--seed-violation masked-psum works with --skip-trace and exits 1."""
+    base = [sys.executable, "-m", "slate_tpu.analysis.lint", "--skip-trace"]
+    r = subprocess.run(
+        base + ["--seed-violation", "masked-psum"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "ast-masked-psum-bcast" in r.stdout

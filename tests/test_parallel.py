@@ -1023,14 +1023,19 @@ def test_band_mesh_kernels_band_cost(rng):
             ca = ca[0]
         return ca["flops"]
 
-    dense = _potrf_jit.lower(tiles, mesh, 2, 4, nt, 1).compile()
-    band = _pbtrf_band_jit.lower(tiles, mesh, 2, 4, nt, wd, 1).compile()
+    # lowering pinned to psum: the flop-class gate is impl-independent
+    # (ppermute adds bytes bookkeeping, not flops) but the jits now take
+    # the bcast-impl static arg
+    dense = _potrf_jit.lower(tiles, mesh, 2, 4, nt, 1, "psum").compile()
+    band = _pbtrf_band_jit.lower(tiles, mesh, 2, 4, nt, wd, 1, "psum").compile()
     assert flops(band) < flops(dense) / 4, (flops(band), flops(dense))
 
-    dense_lu = _pp_jit.lower(tiles, mesh, 2, 4, nt, n, 1).compile()
+    dense_lu = _pp_jit.lower(tiles, mesh, 2, 4, nt, n, 1, "psum").compile()
     wd_u = ((nb - 1) + 2 * kd) // nb + 1
     wd_usw = ((nb - 1) + 3 * kd) // nb + 1
-    band_lu = _gb_pp_jit.lower(tiles, mesh, 2, 4, nt, n, wd, wd_u, wd_usw).compile()
+    band_lu = _gb_pp_jit.lower(
+        tiles, mesh, 2, 4, nt, n, wd, wd_u, wd_usw, "psum"
+    ).compile()
     assert flops(band_lu) < flops(dense_lu) / 4, (flops(band_lu), flops(dense_lu))
 
 
