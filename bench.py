@@ -150,6 +150,118 @@ def bench_getrf():
     return 2.0 * N**3 / 3.0 / t / 1e9
 
 
+# ---------------------------------------------------------------------------
+# panel microbenches (ISSUE 6): the fused Pallas panel kernels vs their XLA
+# reference chains, at the mesh kernels' panel shape (nb = 256, 63 below
+# tiles = one n = 16384 panel column).  These isolate exactly the latency
+# story the fused kernels target — SURVEY "Hard parts": potrf f32 runs at
+# ~2.4 TF/s while gemm f32 hits ~101 TF/s because the panel phase is nb
+# tiny dispatches; the kernel collapses it to ONE.
+# ---------------------------------------------------------------------------
+
+NB_PANEL = 256
+L_PANEL = 63
+
+
+def _panel_operands(kind):
+    rng = np.random.default_rng(7)
+    d = rng.standard_normal((NB_PANEL, NB_PANEL)).astype(np.float32)
+    if kind == "potrf":
+        d = d @ d.T / NB_PANEL + 2 * np.eye(NB_PANEL, dtype=np.float32)
+    else:
+        d = d + NB_PANEL * np.eye(NB_PANEL, dtype=np.float32)
+    tiles = rng.standard_normal((L_PANEL, NB_PANEL, NB_PANEL)).astype(np.float32)
+    return jnp.asarray(d), jnp.asarray(tiles)
+
+
+def bench_panel_potrf(impl):
+    """One potrf panel phase: diag factor (+inverse) then 63 tile solves.
+    xla = today's cholesky + batched-trsm chain; pallas = the fused
+    chol_panel_tiles kernel."""
+    from slate_tpu.ops.pallas_ops import chol_panel_tiles_pallas
+
+    d, tiles = _panel_operands("potrf")
+    if impl == "pallas":
+
+        @jax.jit
+        def run(d, t):
+            lkk, solved = chol_panel_tiles_pallas(d, t)
+            return jnp.sum(jnp.abs(lkk)) + jnp.sum(solved[:, :1, :1])
+
+    else:
+
+        @jax.jit
+        def run(d, t):
+            lkk = jax.lax.linalg.cholesky(d)
+            solved = jax.lax.linalg.triangular_solve(
+                jnp.broadcast_to(lkk.T, t.shape), t,
+                left_side=False, lower=False, transpose_a=False,
+            )
+            return jnp.sum(jnp.abs(lkk)) + jnp.sum(solved[:, :1, :1])
+
+    t = _timeit(run, d, tiles)
+    flops = NB_PANEL**3 / 3.0 + L_PANEL * NB_PANEL**3
+    return flops / t / 1e9
+
+
+def bench_panel_getrf(impl):
+    """One LU-nopiv panel-column phase (diag L\\U + 63 right-solves)."""
+    from slate_tpu.linalg.lu import _getrf_nopiv_rec
+    from slate_tpu.ops.pallas_ops import lu_panel_tiles_pallas
+
+    d, tiles = _panel_operands("getrf")
+    if impl == "pallas":
+
+        @jax.jit
+        def run(d, t):
+            lu, solved = lu_panel_tiles_pallas(d, t)
+            return jnp.sum(jnp.abs(lu)) + jnp.sum(solved[:, :1, :1])
+
+    else:
+
+        @jax.jit
+        def run(d, t):
+            lu = _getrf_nopiv_rec(d)
+            solved = jax.lax.linalg.triangular_solve(
+                jnp.broadcast_to(jnp.triu(lu), t.shape), t,
+                left_side=False, lower=False, transpose_a=False,
+            )
+            return jnp.sum(jnp.abs(lu)) + jnp.sum(solved[:, :1, :1])
+
+    t = _timeit(run, d, tiles)
+    flops = 2.0 * NB_PANEL**3 / 3.0 + L_PANEL * NB_PANEL**3
+    return flops / t / 1e9
+
+
+def bench_panel_qr(impl):
+    """One tall-skinny Householder panel (m = 16384, w = 64) WITH the
+    compact-WY T accumulation — the CAQR / two-stage building block."""
+    from slate_tpu.linalg.qr import _larft, _panel_qr
+    from slate_tpu.ops.pallas_ops import qr_panel_pallas
+
+    m, w = L_PANEL * NB_PANEL + NB_PANEL, 64
+    a = jnp.asarray(
+        np.random.default_rng(8).standard_normal((m, w)).astype(np.float32)
+    )
+    if impl == "pallas":
+
+        @jax.jit
+        def run(a):
+            vr, tau, t = qr_panel_pallas(a)
+            return jnp.sum(jnp.abs(tau)) + jnp.sum(t[:1])
+
+    else:
+
+        @jax.jit
+        def run(a):
+            vr, tau = _panel_qr(a)
+            t = _larft(vr, tau)
+            return jnp.sum(jnp.abs(tau)) + jnp.sum(t[:1])
+
+    t = _timeit(run, a)
+    return 2.0 * m * w * w / t / 1e9
+
+
 # f64 factorizations: the shipped dispatch routes f64 (n >= 4096) to the
 # LEFT-LOOKING forms (round 4) whose panel updates are large-k gemms — the
 # shape where the Ozaki int8-MXU path wins — with digit-plane caching for
@@ -330,6 +442,14 @@ def main():
         ("gemm_bf16_gflops", lambda: bench_gemm(jnp.bfloat16, 64, jnp.float32)),
         ("gemm_int8_gops", lambda: bench_gemm(jnp.int8, 64, jnp.int32)),
         ("gemm_f32_gflops", lambda: bench_gemm(jnp.float32, 32)),
+        # fused-panel story (ISSUE 6): the same panel phase under both
+        # lowerings — the pallas/xla ratio IS the panel speedup headline
+        ("panel_potrf_xla_gflops", lambda: bench_panel_potrf("xla")),
+        ("panel_potrf_pallas_gflops", lambda: bench_panel_potrf("pallas")),
+        ("panel_getrf_xla_gflops", lambda: bench_panel_getrf("xla")),
+        ("panel_getrf_pallas_gflops", lambda: bench_panel_getrf("pallas")),
+        ("panel_qr_xla_gflops", lambda: bench_panel_qr("xla")),
+        ("panel_qr_pallas_gflops", lambda: bench_panel_qr("pallas")),
         ("potrf_f32_gflops", bench_potrf),
         ("getrf_f32_gflops", bench_getrf),
         ("gemm_f64_emulated_gflops", bench_gemm_f64_emulated),
@@ -350,6 +470,11 @@ def main():
             extras[name] = f"failed: {type(e).__name__}"
             _progress(f"extra: {name} failed: {e!r:.200}")
         _emit(gflops, extras)  # atomic checkpoint after every metric
+    for kind in ("potrf", "getrf", "qr"):
+        px = extras.get(f"panel_{kind}_xla_gflops")
+        pp = extras.get(f"panel_{kind}_pallas_gflops")
+        if isinstance(px, float) and isinstance(pp, float) and px > 0:
+            extras[f"panel_{kind}_pallas_speedup"] = round(pp / px, 2)
     if isinstance(extras.get("gemm_bf16_gflops"), float):
         extras["bf16_mfu_vs_peak"] = round(extras["gemm_bf16_gflops"] / V5E_BF16_PEAK, 3)
     ge = extras.get("gemm_f64_emulated_gflops")

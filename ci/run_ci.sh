@@ -70,6 +70,29 @@ if ring["comm_bytes"] != base["comm_bytes"]:
 print(f"ci: cross-impl comm bytes equal ({ring['comm_bytes']:.0f} B/dev)")
 PY
 
+# fused-panel cross-impl pass (ISSUE 6): re-run both smokes under the
+# explicit Pallas panel lowering — on this CPU harness every fused panel
+# kernel runs under the Pallas interpreter, so Option.PanelImpl=pallas is
+# exercised end-to-end (dist potrf / LU-nopiv panels, the ABFT fused
+# trailing-update+checksum consume) on every commit.  The default runs
+# above cover auto -> xla (bitwise today's schedules); slate_lint covers
+# the pallas jaxprs via the *_panel_pallas registry variants.
+SLATE_TPU_PANEL_IMPL=pallas python -m slate_tpu.obs.smoke --out artifacts/obs_panel
+SLATE_TPU_PANEL_IMPL=pallas python -m slate_tpu.ft.smoke --out artifacts/ft_panel
+
+# panel parity artifact: regenerate the fused-kernel vs XLA-reference
+# RunReports and gate the backward-error parity (QR must be bitwise; the
+# explicit-inverse panels must stay within the threshold class).  The
+# tool gates internally; the obs.report --check pass re-validates the
+# COMMITTED artifact shape through the standard CLI (the acceptance
+# gate) — one threshold source for both.
+PANEL_PARITY_THRESHOLD=3
+python tools/panel_report.py --out artifacts/obs \
+    --threshold "$PANEL_PARITY_THRESHOLD"
+python -m slate_tpu.obs.report --check \
+    artifacts/obs/panel_pallas.report.json artifacts/obs/panel_xla.report.json \
+    --threshold "$PANEL_PARITY_THRESHOLD"
+
 # ruff / mypy: configured in pyproject.toml; the container image may not
 # ship them, so gate on availability rather than skipping silently
 if command -v ruff > /dev/null 2>&1; then

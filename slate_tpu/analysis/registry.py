@@ -512,6 +512,36 @@ def _getrf_nopiv_ring(ctx):
     return _with_impl("ring", getrf_nopiv_dist), (a,)
 
 
+@register("geqrf_dist_ring", tags=("bcast",))
+def _geqrf_ring(ctx):
+    """CAQR under the explicit ring lowering (ISSUE 6 satellite: the
+    formerly-unthreaded collectives now consume the engine)."""
+    from ..parallel.dist_qr import geqrf_dist
+
+    a = ctx.dist()
+    return (lambda x: geqrf_dist(x, bcast_impl="ring")), (a,)
+
+
+@register("stedc_dist_ring", tags=("bcast",))
+def _stedc_ring(ctx):
+    import numpy as np
+    import jax.numpy as jnp
+    from ..parallel.dist_stedc import stedc_dist
+
+    rng = np.random.default_rng(2)
+    d = jnp.asarray(rng.standard_normal(256))
+    e = jnp.asarray(rng.standard_normal(255))
+    return (lambda dd, ee: stedc_dist(dd, ee, ctx.mesh, bcast_impl="ring")), (d, e)
+
+
+@register("herk_dist_ring", tags=("bcast",))
+def _herk_ring(ctx):
+    from ..parallel.dist_aux import herk_dist
+
+    a = ctx.dist()
+    return (lambda x: herk_dist(1.0, x, bcast_impl="ring")), (a,)
+
+
 @register("trsm_dist_psum", tags=("bcast",))
 def _trsm_psum(ctx):
     from ..parallel.dist_trsm import trsm_dist
@@ -590,8 +620,9 @@ def _ft_spec(armed: bool, op: str):
     return jnp.asarray(ints), jnp.asarray(vals)
 
 
-def _ft_gemm_build(ctx, armed):
+def _ft_gemm_build(ctx, armed, panel_impl=None):
     from ..ft import abft
+    from ..ops.pallas_ops import resolve_panel_impl
     from ..parallel.comm import resolve_bcast_impl
     from ..parallel.dist import DistMatrix, from_dense, to_dense
 
@@ -603,20 +634,22 @@ def _ft_gemm_build(ctx, armed):
         ad = from_dense(a_aug, ctx.mesh, NB)
         bd = from_dense(b_aug, ctx.mesh, NB)
         cd = from_dense(c_aug, ctx.mesh, NB)
-        out = abft._ft_summa_jit(
+        out, disc = abft._ft_summa_jit(
             ad.tiles, bd.tiles, cd.tiles, 1.0, 0.0,
-            ctx.mesh, ctx.p, ctx.q, kt, 1, resolve_bcast_impl(), fi, fv,
+            ctx.mesh, ctx.p, ctx.q, kt, 1, resolve_bcast_impl(),
+            resolve_panel_impl(panel_impl), mt, fi, fv,
         )
         dense = to_dense(DistMatrix(
             tiles=out, m=a_aug.shape[0], n=b_aug.shape[1], nb=NB, mesh=ctx.mesh,
         ))
-        return abft._gemm_residual(dense, NB, mt, nt)
+        return abft._gemm_residual(dense, NB, mt, nt), disc
 
     return fn, (a, b)
 
 
-def _ft_factor_build(ctx, op, armed):
+def _ft_factor_build(ctx, op, armed, panel_impl=None):
     from ..ft import abft
+    from ..ops.pallas_ops import resolve_panel_impl
     from ..parallel.comm import resolve_bcast_impl
     from ..parallel.dist import DistMatrix, from_dense, to_dense
 
@@ -630,7 +663,7 @@ def _ft_factor_build(ctx, op, armed):
         d = from_dense(aug, ctx.mesh, NB)
         out_t, info = kern(
             d.tiles, ctx.mesh, ctx.p, ctx.q, mt, 1, resolve_bcast_impl(),
-            fi, fv,
+            resolve_panel_impl(panel_impl), fi, fv,
         )
         dense = to_dense(DistMatrix(
             tiles=out_t, m=aug.shape[0], n=aug.shape[1], nb=NB, mesh=ctx.mesh,
@@ -669,3 +702,42 @@ def _ft_lu_detect(ctx):
 @register("getrf_nopiv_abft_correct", tags=("ft",))
 def _ft_lu_correct(ctx):
     return _ft_factor_build(ctx, "getrf_nopiv", armed=True)
+
+
+# ---------------------------------------------------------------------------
+# fused-panel variants (ISSUE 6): the Option.PanelImpl=pallas lowerings
+# under the gate.  The default entries above trace the XLA panel forms
+# (auto resolves to xla on the CPU trace mesh, keeping them bitwise
+# today's schedules); these pin the fused Pallas panel kernels — the
+# interpret-mode pallas_call sub-jaxprs are walked by the same passes, so
+# declared axis names, audit_scope coverage, and Precision.HIGHEST on the
+# in-kernel MXU dots all stay under the gate.
+# ---------------------------------------------------------------------------
+
+
+@register("potrf_dist_panel_pallas", tags=("panel",))
+def _potrf_pallas(ctx):
+    from ..parallel.dist_chol import potrf_dist
+
+    a = ctx.dist(kind="spd", diag_pad=True)
+    return (lambda x: potrf_dist(x, panel_impl="pallas")), (a,)
+
+
+@register("getrf_nopiv_dist_panel_pallas", tags=("panel",))
+def _getrf_nopiv_pallas(ctx):
+    from ..parallel.dist_lu import getrf_nopiv_dist
+
+    a = ctx.dist(kind="tril", diag_pad=True)
+    return (lambda x: getrf_nopiv_dist(x, panel_impl="pallas")), (a,)
+
+
+@register("gemm_abft_panel_pallas", tags=("panel", "ft"))
+def _ft_gemm_pallas(ctx):
+    """The fused trailing-update+checksum SUMMA consume (and its online
+    Huang-Abraham discrepancy reduction) under the gate."""
+    return _ft_gemm_build(ctx, armed=False, panel_impl="pallas")
+
+
+@register("potrf_abft_panel_pallas", tags=("panel", "ft"))
+def _ft_potrf_pallas(ctx):
+    return _ft_factor_build(ctx, "potrf", armed=False, panel_impl="pallas")

@@ -33,6 +33,11 @@ import jax.numpy as jnp
 from ..blas3.blas3 import trsm_array
 from ..core.matrix import tri_project
 from ..ops.matmul import matmul
+from ..ops.pallas_ops import (
+    panel_engaged,
+    qr_panel_offset_pallas,
+    qr_panel_pallas,
+)
 from ..types import Diag, MethodGels, Op, Option, Options, Side, SlateError, Uplo, get_option
 
 Array = jax.Array
@@ -191,6 +196,29 @@ def _larft(vr: Array, tau: Array) -> Array:
     return jax.lax.fori_loop(0, w, step, t0)
 
 
+def _panel_qr_t(a: Array) -> Tuple[Array, Array, Array]:
+    """(packed VR, tau, T) of one panel — the ``_panel_qr`` + ``_larft``
+    pair, fused into ONE Pallas dispatch (reflector generation and the
+    compact-WY T accumulation run on the VMEM-resident panel) when
+    ``Option.PanelImpl`` engages; the XLA pair is the reference and is
+    bitwise-identical to the kernel under interpret mode (same op
+    sequence)."""
+    if panel_engaged(a.dtype, a.size * a.dtype.itemsize):
+        return qr_panel_pallas(a)
+    vr, tau = _panel_qr(a)
+    return vr, tau, _larft(vr, tau)
+
+
+def _panel_qr_offset_t(a: Array, row0) -> Tuple[Array, Array, Array, Array]:
+    """(r, v, tau, T) of one offset-pivot panel — ``_panel_qr_offset`` +
+    ``_larft_v`` as one fused dispatch when ``Option.PanelImpl``
+    engages (``row0`` may be traced; it rides as a scalar operand)."""
+    if panel_engaged(a.dtype, a.size * a.dtype.itemsize):
+        return qr_panel_offset_pallas(a, row0)
+    r, v, tau = _panel_qr_offset(a, row0)
+    return r, v, tau, _larft_v(v, tau)
+
+
 def _v_of(vr: Array, k: Optional[int] = None) -> Array:
     """Extract unit-lower V from packed storage (first k reflectors)."""
     m, n = vr.shape
@@ -212,8 +240,8 @@ def _geqrf_rec(a: Array) -> Tuple[Array, Array]:
     """Recursive blocked QR. Returns (packed VR, T)."""
     m, n = a.shape
     if n <= _QR_PANEL:
-        vr, tau = _panel_qr(a)
-        return vr, _larft(vr, tau)
+        vr, _, t = _panel_qr_t(a)
+        return vr, t
     h = _split_qr(n)
     vr1, t1 = _geqrf_rec(a[:, :h])
     v1 = _v_of(vr1)
@@ -276,8 +304,7 @@ def geqrf_scan_array(a: Array, nb: int = _QR_PANEL) -> QRScanFactors:
         j1 = j0 + nb
         colblk = lax.dynamic_slice(ap, (0, j0), (mp, nb))
         masked = jnp.where((rows >= j0)[:, None], colblk, 0)
-        r_a, v, tau = _panel_qr_offset(masked, j0)
-        t = _larft_v(v, tau)
+        r_a, v, tau, t = _panel_qr_offset_t(masked, j0)
         w1 = matmul(jnp.conj(v).T, ap)
         upd = matmul(v, matmul(jnp.conj(t).T, w1)).astype(ap.dtype)
         ap = ap - upd * (cols >= j1)[None, :].astype(ap.dtype)

@@ -29,8 +29,10 @@ from .comm import (
     all_gather_a,
     audit_scope,
     bcast_from_col,
+    bcast_impl_scope,
     local_indices,
     psum_a,
+    resolve_bcast_impl,
     shard_map_compat,
 )
 from .dist import DistMatrix
@@ -98,19 +100,23 @@ def herk_dist(
     c: Optional[DistMatrix] = None,
     uplo: Uplo = Uplo.Lower,
     full: bool = False,
+    bcast_impl=None,
 ) -> DistMatrix:
     """C := alpha A A^H + beta C, C Hermitian (m, m) distributed.
 
     ``full=True`` fills both triangles (handy for residual checks);
     otherwise only the ``uplo`` triangle (+ diagonal) is written, matching
-    slate::herk's storage contract (src/herk.cc).
+    slate::herk's storage contract (src/herk.cc).  ``bcast_impl``
+    (Option.BcastImpl) lowers the k-loop panel broadcasts through the
+    rooted engine — bitwise-identical.
     """
     p, q = mesh_shape(a.mesh)
     if c is not None and (c.m != a.m or c.n != a.m or c.grid != (p, q) or c.nb != a.nb):
         raise ValueError("herk_dist: C layout must match A A^H")
     ct = None if c is None else c.tiles
     out = _herk_jit(
-        a.tiles, ct, alpha, beta, a.mesh, p, q, a.nt, a.n, uplo, full
+        a.tiles, ct, alpha, beta, a.mesh, p, q, a.nt, a.n, uplo, full,
+        resolve_bcast_impl(bcast_impl),
     )
     no_pad = a.mt * a.nb == a.m  # C is (m, m) on A's row tile grid
     return DistMatrix(
@@ -118,8 +124,8 @@ def herk_dist(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9, 10))
-def _herk_jit(at, ct, alpha, beta, mesh, p, q, kt, k_true, uplo, full):
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+def _herk_jit(at, ct, alpha, beta, mesh, p, q, kt, k_true, uplo, full, bi):
     spec = P(ROW_AXIS, COL_AXIS)
     cplx = jnp.issubdtype(at.dtype, jnp.complexfloating)
 
@@ -157,9 +163,10 @@ def _herk_jit(at, ct, alpha, beta, mesh, p, q, kt, k_true, uplo, full):
             acc = jnp.where(keep, acc, 0)
         return acc
 
-    prod = shard_map_compat(
-        kernel, mesh=mesh, in_specs=(spec,), out_specs=spec, check_vma=False
-    )(at)
+    with bcast_impl_scope(bi):
+        prod = shard_map_compat(
+            kernel, mesh=mesh, in_specs=(spec,), out_specs=spec, check_vma=False
+        )(at)
     if ct is None:
         return (alpha * prod).astype(at.dtype)
     return (alpha * prod + beta * ct).astype(at.dtype)

@@ -42,6 +42,12 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..obs import instrument
+from ..ops.pallas_ops import (
+    chol_panel_tiles_pallas,
+    panel_engaged,
+    panel_impl_scope,
+    resolve_panel_impl,
+)
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 from .comm import (
@@ -64,7 +70,7 @@ from typing import Optional
 @instrument("potrf_dist")
 def potrf_dist(
     a: DistMatrix, lookahead: Optional[int] = None,
-    bcast_impl: Optional[str] = None,
+    bcast_impl: Optional[str] = None, panel_impl: Optional[str] = None,
 ) -> Tuple[DistMatrix, jax.Array]:
     """Factor A = L L^H (lower). ``a`` holds the lower triangle (upper tile
     content ignored). Returns (L as DistMatrix, info).
@@ -75,22 +81,51 @@ def potrf_dist(
     (potrf.cc:129-133's lookahead queues).  Results are bitwise-identical
     at any depth.  ``bcast_impl`` (Option.BcastImpl) picks the panel /
     diag-tile broadcast lowering — masked psum or the ppermute engine —
-    also bitwise-identical."""
+    also bitwise-identical.  ``panel_impl`` (Option.PanelImpl) picks the
+    panel-phase lowering: ``xla`` (today's cholesky + batched-trsm chain,
+    bitwise) or ``pallas`` (one fused on-chip kernel per panel; matches
+    to the documented explicit-inverse tolerance class)."""
     p, q = mesh_shape(a.mesh)
     if a.mt != a.nt:
         raise ValueError("potrf_dist needs a square tile grid")
     a.require_diag_pad("potrf_dist")
     lt, info = _potrf_jit(
         a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
-        resolve_bcast_impl(bcast_impl),
+        resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
     )
     return DistMatrix(
         tiles=lt, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True
     ), info
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
-def _potrf_jit(at, mesh, p, q, nt, la, bi):
+def _chol_panel_factor_solve(dtile, pcol, cplx):
+    """Diag-tile factor + panel-column tile solves, dispatched by the
+    active Option.PanelImpl scope.  XLA branch: today's ops, bitwise
+    (cholesky, f32 for bf16, then one batched trsm).  Pallas branch: one
+    fused kernel — column-loop factor with the inverse in VMEM scratch,
+    tile solves as MXU matmuls (documented-tolerance parity)."""
+    dtype = dtile.dtype
+    if panel_engaged(dtype, dtile.size * dtile.dtype.itemsize):
+        if dtype == jnp.bfloat16:  # no bf16 sqrt/div path worth keeping
+            lkk32, solved32 = chol_panel_tiles_pallas(
+                dtile.astype(jnp.float32), pcol.astype(jnp.float32)
+            )
+            return lkk32.astype(dtype), solved32.astype(dtype)
+        return chol_panel_tiles_pallas(dtile, pcol)
+    if dtype == jnp.bfloat16:
+        lkk = lax.linalg.cholesky(dtile.astype(jnp.float32)).astype(dtype)
+    else:
+        lkk = lax.linalg.cholesky(dtile)
+    lkk_h = jnp.conj(lkk).T if cplx else lkk.T
+    solved = lax.linalg.triangular_solve(
+        jnp.broadcast_to(lkk_h, pcol.shape), pcol,
+        left_side=False, lower=False, transpose_a=False,
+    )
+    return lkk, solved
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
+def _potrf_jit(at, mesh, p, q, nt, la, bi, pi):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
@@ -110,21 +145,13 @@ def _potrf_jit(at, mesh, p, q, nt, la, bi):
             def panel(k, view):
                 """Diag factor + panel trsm + panel broadcasts of step k.
                 Reads only column slot k // q - coff (refreshed by
-                ``narrow`` when the update is deferred)."""
+                ``narrow`` when the update is deferred).  The factor +
+                solve pair dispatches by Option.PanelImpl
+                (_chol_panel_factor_solve)."""
                 kc = k // q - coff
                 dtile = bcast_diag_tile(view, k, p, q, nb, roff, coff)
-                # bf16 inputs: the LAPACK-kernel base case has no bf16
-                # variant on any backend — factor the diag tile in f32
-                if dtype == jnp.bfloat16:
-                    lkk = lax.linalg.cholesky(dtile.astype(jnp.float32)).astype(dtype)
-                else:
-                    lkk = lax.linalg.cholesky(dtile)
                 pcol = lax.dynamic_slice_in_dim(view, kc, 1, axis=1)[:, 0]
-                lkk_h = jnp.conj(lkk).T if cplx else lkk.T
-                solved = lax.linalg.triangular_solve(
-                    jnp.broadcast_to(lkk_h, pcol.shape), pcol,
-                    left_side=False, lower=False, transpose_a=False,
-                )
+                lkk, solved = _chol_panel_factor_solve(dtile, pcol, cplx)
                 below = (i_log > k)[:, None, None]
                 on_diag = (i_log == k)[:, None, None]
                 newcol = jnp.where(below, solved, jnp.where(on_diag, lkk, pcol))
@@ -217,7 +244,7 @@ def _potrf_jit(at, mesh, p, q, nt, la, bi):
         info = jnp.where(info >= big, 0, info).astype(jnp.int32)
         return t_loc, info[None, None]
 
-    with bcast_impl_scope(bi):
+    with bcast_impl_scope(bi), panel_impl_scope(pi):
         lt, info = shard_map_compat(
             kernel,
             mesh=mesh,

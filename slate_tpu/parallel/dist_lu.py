@@ -42,6 +42,13 @@ from jax.sharding import PartitionSpec as P
 
 from ..linalg.lu import _getrf_nopiv_rec, _tournament_reduce
 from ..obs import instrument
+from ..ops.pallas_ops import (
+    lu_panel_tiles_pallas,
+    lu_rowsolve_tiles_pallas,
+    panel_engaged,
+    panel_impl_scope,
+    resolve_panel_impl,
+)
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 from .comm import (
@@ -66,7 +73,7 @@ from typing import Optional
 @instrument("getrf_nopiv_dist")
 def getrf_nopiv_dist(
     a: DistMatrix, lookahead: Optional[int] = None,
-    bcast_impl: Optional[str] = None,
+    bcast_impl: Optional[str] = None, panel_impl: Optional[str] = None,
 ) -> Tuple[DistMatrix, jax.Array]:
     """Factor A = L U in place (packed LU tiles). Returns (LU, info).
 
@@ -75,18 +82,58 @@ def getrf_nopiv_dist(
     broadcasts overlap it (getrf_nopiv.cc's lookahead queues); results
     are bitwise-identical at any depth.  ``bcast_impl``
     (Option.BcastImpl) picks the panel-broadcast lowering, also
-    bitwise-identical."""
+    bitwise-identical.  ``panel_impl`` (Option.PanelImpl) picks the
+    panel-phase lowering: ``xla`` (today's recursive diag factor +
+    batched trsm pair, bitwise) or ``pallas`` (fused on-chip panel
+    kernels; documented-tolerance parity)."""
     p, q = mesh_shape(a.mesh)
     if a.mt != a.nt:
         raise ValueError("getrf_nopiv_dist needs a square tile grid")
     a.require_diag_pad("getrf_nopiv_dist")
     lut, info = _lu_jit(
         a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
-        resolve_bcast_impl(bcast_impl),
+        resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
     )
     return DistMatrix(
         tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True
     ), info
+
+
+def _lu_cast(x):
+    """bf16 panels factor in f32 (no bf16 reciprocal path worth keeping);
+    every other engaged dtype runs natively."""
+    return x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+
+
+def _lu_panel_factor_solve(dtile, pcol):
+    """Diag-tile no-pivot LU + panel-column tile solves, dispatched by
+    the active Option.PanelImpl scope.  XLA branch: today's ops, bitwise
+    (recursive tile LU + one batched trsm).  Pallas branch: one fused
+    kernel — the packed L\\U column loop with U^-1 in VMEM scratch, tile
+    solves as MXU matmuls (documented-tolerance parity)."""
+    if panel_engaged(dtile.dtype, dtile.size * dtile.dtype.itemsize):
+        luk, solved = lu_panel_tiles_pallas(_lu_cast(dtile), _lu_cast(pcol))
+        return luk.astype(dtile.dtype), solved.astype(pcol.dtype)
+    luk = _getrf_nopiv_rec(dtile)  # packed L\U, unit L diag implicit
+    solved = lax.linalg.triangular_solve(
+        jnp.broadcast_to(jnp.triu(luk), pcol.shape), pcol,
+        left_side=False, lower=False, transpose_a=False,
+    )
+    return luk, solved
+
+
+def _lu_panel_rowsolve(luk, prow, eye):
+    """Panel-row solve L_kk^{-1} A[k, j], dispatched like the column
+    half (fused unit-L^-1 kernel under pallas)."""
+    if panel_engaged(luk.dtype, luk.size * luk.dtype.itemsize):
+        return lu_rowsolve_tiles_pallas(_lu_cast(luk), _lu_cast(prow)).astype(
+            prow.dtype
+        )
+    return lax.linalg.triangular_solve(
+        jnp.broadcast_to(jnp.tril(luk, -1) + eye, prow.shape), prow,
+        left_side=True, lower=True, transpose_a=False,
+        unit_diagonal=True,
+    )
 
 
 def _nopiv_panel(t_loc, k, p, q, i_log, j_log, r, c, roff=0, coff=0,
@@ -114,15 +161,10 @@ def _nopiv_panel(t_loc, k, p, q, i_log, j_log, r, c, roff=0, coff=0,
         newcol = pcol
     else:
         dtile = bcast_diag_tile(t_loc, k, p, q, nb, roff, coff)
-        luk = _getrf_nopiv_rec(dtile)  # packed L\U, unit L diag implicit
-        ukk = jnp.triu(luk)
-
-        # panel column: L[i,k] = A[i,k] U_kk^{-1}  (i > k)
+        # panel column: L[i,k] = A[i,k] U_kk^{-1}  (i > k); factor + solve
+        # dispatch by Option.PanelImpl (_lu_panel_factor_solve)
         pcol = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)[:, 0]
-        lsolved = lax.linalg.triangular_solve(
-            jnp.broadcast_to(ukk, pcol.shape), pcol,
-            left_side=False, lower=False, transpose_a=False,
-        )
+        luk, lsolved = _lu_panel_factor_solve(dtile, pcol)
         on_d = (i_log == k)[:, None, None]
         newcol = jnp.where(below, lsolved, jnp.where(on_d, luk, pcol))
         t_loc = lax.dynamic_update_slice_in_dim(
@@ -131,11 +173,7 @@ def _nopiv_panel(t_loc, k, p, q, i_log, j_log, r, c, roff=0, coff=0,
 
     # panel row: U[k,j] = L_kk^{-1} A[k,j]  (j > k)
     prow = lax.dynamic_slice_in_dim(t_loc, kr, 1, axis=0)[0]
-    usolved = lax.linalg.triangular_solve(
-        jnp.broadcast_to(jnp.tril(luk, -1) + eye, prow.shape), prow,
-        left_side=True, lower=True, transpose_a=False,
-        unit_diagonal=True,
-    )
+    usolved = _lu_panel_rowsolve(luk, prow, eye)
     right = (j_log > k)[:, None, None]
     newrow = jnp.where(right, usolved, prow)
     mine_r = (r == k % p)
@@ -215,8 +253,8 @@ def _lu_info_dist(t_loc, i_log, j_log, nt, nb):
     return jnp.where(info >= big, 0, info).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
-def _lu_jit(at, mesh, p, q, nt, la, bi):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
+def _lu_jit(at, mesh, p, q, nt, la, bi, pi):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
@@ -256,7 +294,7 @@ def _lu_jit(at, mesh, p, q, nt, la, bi):
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, info[None, None]
 
-    with bcast_impl_scope(bi):
+    with bcast_impl_scope(bi), panel_impl_scope(pi):
         lut, info = shard_map_compat(
             kernel,
             mesh=mesh,
@@ -438,7 +476,11 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true, la, bi):
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, rowperm[None], info[None, None]
 
-    with bcast_impl_scope(bi):
+    # pivoted kernels keep the XLA panel forms: their k-step cost is the
+    # pivot machinery (tournament / argmax collectives + row swaps), and
+    # pinning the scope keeps this jit's cache impl-independent — the
+    # nopiv kernel (and the ft variants) are the PanelImpl consumers
+    with bcast_impl_scope(bi), panel_impl_scope("xla"):
         lut, perm, info = shard_map_compat(
             kernel,
             mesh=mesh,
@@ -725,7 +767,7 @@ def _pp_jit(at, mesh, p, q, nt, m_true, la, bi):
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, rowperm[None], info[None, None]
 
-    with bcast_impl_scope(bi):
+    with bcast_impl_scope(bi), panel_impl_scope("xla"):  # see _tntpiv_jit
         lut, perm, info = shard_map_compat(
             kernel,
             mesh=mesh,
@@ -754,10 +796,15 @@ def gbtrf_band_dist(
     band LU pays).
 
     ``lookahead`` is accepted for API symmetry but runs the strict
-    schedule: the pivoted band step's swap column window slides with k
-    and its exclusion set would depend on the pivot choices, so the
-    windowed analogue of getrf_pp_dist's deferred update is future work
-    (the dense kernels carry the overlap story)."""
+    schedule — a TESTED invariant, not just a note
+    (tests/test_lookahead.py::test_gbtrf_lookahead_is_strict_schedule_invariant
+    asserts the traced schedule is identical at every depth): the band
+    structure genuinely forbids the overlap — there is no read-only
+    operand for ``comm.prefetch_bcast`` (every panel reads column k as
+    updated by step k-1), and the deferred-update form is illegal
+    because the swap column window slides with k and its exclusion set
+    would depend on the run-time pivot choices (the dense kernels carry
+    the overlap story)."""
     p, q = mesh_shape(a.mesh)
     if a.mt != a.nt:
         raise ValueError("gbtrf_band_dist needs a square tile grid")
@@ -855,7 +902,7 @@ def _gb_pp_jit(at, mesh, p, q, nt, m_true, wd_l, wd_u, wd_usw, bi):
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, rowperm[None], info[None, None]
 
-    with bcast_impl_scope(bi):
+    with bcast_impl_scope(bi), panel_impl_scope("xla"):  # see _tntpiv_jit
         lut, perm, info = shard_map_compat(
             kernel,
             mesh=mesh,

@@ -46,7 +46,9 @@ from .comm import (
     all_gather_a,
     audit_scope,
     bcast_from_col,
+    bcast_impl_scope,
     local_indices,
+    resolve_bcast_impl,
     shard_map_compat,
 )
 
@@ -82,13 +84,18 @@ def _merge_ids(p: int) -> List[List[int]]:
 
 
 @instrument("geqrf_dist")
-def geqrf_dist(a: DistMatrix) -> DistQR:
-    """Factor A = Q R across the mesh (m >= n)."""
+def geqrf_dist(a: DistMatrix, bcast_impl=None) -> DistQR:
+    """Factor A = Q R across the mesh (m >= n).  ``bcast_impl``
+    (Option.BcastImpl) picks the panel-broadcast lowering — the rooted
+    ppermute engine or the legacy masked psum — bitwise-identical
+    (PR 5's engine, threaded here per the ROADMAP "finish the collective
+    story" item)."""
     p, q = mesh_shape(a.mesh)
     if a.m < a.n:
         raise ValueError(f"geqrf_dist requires m >= n, got {a.m}x{a.n}")
     fact, tloc, treev, treet = _geqrf_jit(
-        a.tiles, a.mesh, p, q, a.nt, a.m, a.n
+        a.tiles, a.mesh, p, q, a.nt, a.m, a.n,
+        resolve_bcast_impl(bcast_impl),
     )
     fd = DistMatrix(
         tiles=fact, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True
@@ -145,8 +152,8 @@ def _apply_tree_tops(tops, treev_k, treet_k, k, p, nb, adjoint: bool):
     return tops[jnp.argsort(rot)]
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
-def _geqrf_jit(at, mesh, p, q, nt, m_true, n_true):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
+def _geqrf_jit(at, mesh, p, q, nt, m_true, n_true, bi):
     spec = P(ROW_AXIS, COL_AXIS)
     nmerge = max(1, p)
 
@@ -252,31 +259,35 @@ def _geqrf_jit(at, mesh, p, q, nt, m_true, n_true):
         t_loc = jnp.where(dmask, jnp.ones((), at.dtype), t_loc)
         return t_loc, tls, tvs[None, None], tts[None, None]
 
-    return shard_map_compat(
-        kernel,
-        mesh=mesh,
-        in_specs=(spec,),
-        out_specs=(spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS), P(ROW_AXIS, COL_AXIS)),
-        check_vma=False,
-    )(at)
+    with bcast_impl_scope(bi):
+        return shard_map_compat(
+            kernel,
+            mesh=mesh,
+            in_specs=(spec,),
+            out_specs=(spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS), P(ROW_AXIS, COL_AXIS)),
+            check_vma=False,
+        )(at)
 
 
 @instrument("unmqr_dist")
-def unmqr_dist(f: DistQR, b: DistMatrix, op: Op = Op.ConjTrans) -> DistMatrix:
-    """B <- Q^H B (op=ConjTrans) or Q B (op=NoTrans) from CAQR factors."""
+def unmqr_dist(
+    f: DistQR, b: DistMatrix, op: Op = Op.ConjTrans, bcast_impl=None
+) -> DistMatrix:
+    """B <- Q^H B (op=ConjTrans) or Q B (op=NoTrans) from CAQR factors.
+    ``bcast_impl`` as in :func:`geqrf_dist`."""
     a = f.fact
     p, q = mesh_shape(a.mesh)
     if b.mt != a.mt or b.nb != a.nb or b.grid != a.grid:
         raise ValueError("unmqr_dist operand mismatch")
     bt = _unmqr_jit(
         a.tiles, f.tloc, f.treev, f.treet, b.tiles, a.mesh, p, q, a.nt,
-        a.m, op == Op.ConjTrans,
+        a.m, op == Op.ConjTrans, resolve_bcast_impl(bcast_impl),
     )
     return DistMatrix(tiles=bt, m=b.m, n=b.n, nb=b.nb, mesh=b.mesh)
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10))
-def _unmqr_jit(at, tloc, treev, treet, bt, mesh, p, q, nt, m_true, adjoint):
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _unmqr_jit(at, tloc, treev, treet, bt, mesh, p, q, nt, m_true, adjoint, bi):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(a_loc, tls, tvs, tts, b_loc):
@@ -334,10 +345,11 @@ def _unmqr_jit(at, tloc, treev, treet, bt, mesh, p, q, nt, m_true, adjoint):
         with audit_scope(nt):
             return lax.fori_loop(0, nt, step, b_loc)
 
-    return shard_map_compat(
-        kernel,
-        mesh=mesh,
-        in_specs=(spec, P(ROW_AXIS), P(), P(), spec),
-        out_specs=spec,
-        check_vma=False,
-    )(at, tloc, treev, treet, bt)
+    with bcast_impl_scope(bi):
+        return shard_map_compat(
+            kernel,
+            mesh=mesh,
+            in_specs=(spec, P(ROW_AXIS), P(), P(), spec),
+            out_specs=spec,
+            check_vma=False,
+        )(at, tloc, treev, treet, bt)

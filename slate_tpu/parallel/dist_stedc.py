@@ -42,17 +42,28 @@ from jax.sharding import PartitionSpec as P
 
 from ..linalg.tridiag import _DC_SMALL, _secular_roots_shard, _zhat_shard, steqr
 from ..obs import instrument
-from .comm import PRECISE, all_gather_a, bcast_from_row, shard_map_compat
+from .comm import (
+    PRECISE,
+    all_gather_a,
+    bcast_from_row,
+    bcast_impl_scope,
+    resolve_bcast_impl,
+    shard_map_compat,
+)
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 
 
 @instrument("stedc_dist")
-def stedc_dist(d: jax.Array, e: jax.Array, mesh) -> Tuple[jax.Array, jax.Array]:
+def stedc_dist(
+    d: jax.Array, e: jax.Array, mesh, bcast_impl=None
+) -> Tuple[jax.Array, jax.Array]:
     """Eigen-decomposition of the symmetric tridiagonal (d, e) with the
     merge tree sharded over ``mesh``.  Returns (w ascending, Z) where Z is
     a global (n, n) array row-sharded over the mesh row axis (each device
     holds n/p rows; columns replicated across the mesh column axis after
-    the final gather).  Math follows linalg.tridiag._stedc_levels."""
+    the final gather).  Math follows linalg.tridiag._stedc_levels.
+    ``bcast_impl`` (Option.BcastImpl) lowers the static-owner boundary
+    broadcasts through the rooted engine — bitwise-identical."""
     p, q = mesh_shape(mesh)
     n = d.shape[0]
     if n <= max(_DC_SMALL, 2) or _DC_SMALL % p or (2 * _DC_SMALL) % q:
@@ -72,7 +83,9 @@ def stedc_dist(d: jax.Array, e: jax.Array, mesh) -> Tuple[jax.Array, jax.Array]:
     seams = _DC_SMALL * jnp.arange(1, nblk) - 1
     dp = dp.at[seams].add(-ep[seams]).at[seams + 1].add(-ep[seams])
 
-    w, z = _stedc_dist_jit(dp, ep, mesh, p, q, N, levels)
+    w, z = _stedc_dist_jit(
+        dp, ep, mesh, p, q, N, levels, resolve_bcast_impl(bcast_impl)
+    )
     # Undo the deterministic row interleave of the recursive
     # [child0-shard; child1-shard] stacking: device row r's local rows of
     # the final block are ids_r = U_l (s_l + ids_{l-1}) — a function of r
@@ -99,8 +112,8 @@ def stedc_dist(d: jax.Array, e: jax.Array, mesh) -> Tuple[jax.Array, jax.Array]:
     return w[:n][order], z
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
-def _stedc_dist_jit(dp, ep, mesh, p, q, N, levels):
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
+def _stedc_dist_jit(dp, ep, mesh, p, q, N, levels, bi):
     S = _DC_SMALL
 
     def kernel(dp, ep):
@@ -123,8 +136,7 @@ def _stedc_dist_jit(dp, ep, mesh, p, q, N, levels):
             dd = w.reshape(m, 2 * s)
             qp = q_loc.reshape(m, 2, rows_per, s)
             # boundary rows -> replicated z: rooted broadcasts from the
-            # static owner rows (comm engine; psum lowering by default —
-            # this kernel does not thread Option.BcastImpl)
+            # static owner rows, lowered per the threaded Option.BcastImpl
             bot = bcast_from_row(qp[:, 0, -1, :], p - 1)
             top = bcast_from_row(qp[:, 1, 0, :], 0)
             z = jnp.concatenate([bot, top], axis=1)  # (m, 2s)
@@ -228,13 +240,14 @@ def _stedc_dist_jit(dp, ep, mesh, p, q, N, levels):
         # q_loc: (1, N/p, N) my rows, full cols
         return w[None], q_loc[0][None]
 
-    w, z = shard_map_compat(
-        kernel,
-        mesh=mesh,
-        in_specs=(P(), P()),
-        out_specs=(P(ROW_AXIS), P(ROW_AXIS, None)),
-        check_vma=False,
-    )(dp, ep)
+    with bcast_impl_scope(bi):
+        w, z = shard_map_compat(
+            kernel,
+            mesh=mesh,
+            in_specs=(P(), P()),
+            out_specs=(P(ROW_AXIS), P(ROW_AXIS, None)),
+            check_vma=False,
+        )(dp, ep)
     # w was emitted once per mesh row (replicated): take the first copy
     return w.reshape(p, -1)[0], z.reshape(N, N)
 

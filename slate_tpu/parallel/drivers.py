@@ -53,6 +53,15 @@ def _bi(opts: Optional[Options]):
     return get_option(opts, Option.BcastImpl)
 
 
+def _pi(opts: Optional[Options]):
+    """Raw Option.PanelImpl value from a driver ``opts`` mapping — the
+    panel-factorization lowering the factor kernels consume (fused
+    Pallas panel kernels vs the XLA reference chains).  May be None:
+    ``ops.pallas_ops.resolve_panel_impl`` inside each kernel is the
+    single authority for the context/env/auto default chain."""
+    return get_option(opts, Option.PanelImpl)
+
+
 def _ft_on(opts: Optional[Options]) -> bool:
     """True when Option.FaultTolerance selects an active ABFT policy.
     Off (the default) keeps this module on the plain kernels with zero
@@ -100,7 +109,7 @@ def potrf_mesh(
         return potrf_mesh_ft(a, mesh, nb, opts)
     return potrf_dist(
         from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts),
-        bcast_impl=_bi(opts),
+        bcast_impl=_bi(opts), panel_impl=_pi(opts),
     )
 
 
@@ -134,7 +143,7 @@ def getrf_nopiv_mesh(
         return getrf_nopiv_mesh_ft(a, mesh, nb, opts)
     return getrf_nopiv_dist(
         from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts),
-        bcast_impl=_bi(opts),
+        bcast_impl=_bi(opts), panel_impl=_pi(opts),
     )
 
 
@@ -158,14 +167,19 @@ def gesv_nopiv_mesh(
 
 
 @instrument("geqrf_mesh")
-def geqrf_mesh(a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB):
-    """Distributed CAQR factorization (src/geqrf.cc). Returns DistQR."""
-    return geqrf_dist(from_dense(a, mesh, nb))
+def geqrf_mesh(
+    a: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
+    opts: Optional[Options] = None,
+):
+    """Distributed CAQR factorization (src/geqrf.cc). Returns DistQR.
+    ``opts`` carries Option.BcastImpl (panel-broadcast lowering)."""
+    return geqrf_dist(from_dense(a, mesh, nb), bcast_impl=_bi(opts))
 
 
 @instrument("gels_mesh")
 def gels_mesh(
-    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB
+    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
+    opts: Optional[Options] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Distributed least squares min ||A X - B|| for m >= n via CAQR
     (src/gels_qr.cc): X = R^-1 (Q^H B)[:n].  Returns (X, R diag info).
@@ -174,12 +188,14 @@ def gels_mesh(
     the tile-level redistribute is the scalable path (redistribute()).
     """
     m, n = a.shape
-    f = geqrf_mesh(a, mesh, nb)
+    bi = _bi(opts)
+    f = geqrf_mesh(a, mesh, nb, opts)
     bd = from_dense(b, mesh, nb)
-    qb = to_dense(unmqr_dist(f, bd, Op.ConjTrans))[:n]
+    qb = to_dense(unmqr_dist(f, bd, Op.ConjTrans, bcast_impl=bi))[:n]
     r = jnp.triu(to_dense(f.fact)[:n, :n])
     rd = from_dense(r, mesh, nb, diag_pad_one=True)
-    xd = trsm_dist(rd, from_dense(qb, mesh, nb), Uplo.Upper, Op.NoTrans)
+    xd = trsm_dist(rd, from_dense(qb, mesh, nb), Uplo.Upper, Op.NoTrans,
+                   bcast_impl=bi)
     rdiag = jnp.diagonal(r)
     info = jnp.where(
         jnp.any(rdiag == 0), jnp.argmax(rdiag == 0) + 1, 0
@@ -190,7 +206,7 @@ def gels_mesh(
 @instrument("heev_mesh")
 def heev_mesh(
     a: jax.Array, mesh: Mesh, nb: int = 64, want_vectors: bool = True,
-    distributed_solver: bool = True,
+    distributed_solver: bool = True, opts: Optional[Options] = None,
 ):
     """Distributed Hermitian eigensolver (src/heev.cc with a grid): stage 1
     (he2hb, the O(n^3) reduction) and the stage-1 back-transform run on the
@@ -229,7 +245,7 @@ def heev_mesh(
     if not want_vectors:
         return sterf(d, e)
     if distributed_solver:
-        w, ztri = stedc_dist(d, e, mesh)
+        w, ztri = stedc_dist(d, e, mesh, bcast_impl=_bi(opts))
     else:
         w, ztri = stedc(d, e)
     z = ztri.astype(a.dtype)

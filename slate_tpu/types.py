@@ -240,6 +240,21 @@ class Option(enum.Enum):
     # order: explicit option > comm.use_bcast_impl context >
     # SLATE_TPU_BCAST_IMPL environment > auto.
     BcastImpl = "bcast_impl"
+    # Panel-factorization lowering for the fused Pallas panel kernels
+    # (ops/pallas_ops.py): "xla" (the reference semantics — today's
+    # cholesky/triangular_solve/Householder dispatch chains, bitwise),
+    # "pallas" (one fused on-chip kernel per panel phase: MAGMA-style
+    # blocked panels; f64/complex panels fall back to xla on a real TPU,
+    # and on CPU the kernels run under the Pallas interpreter), or
+    # "auto" (the default: pallas on a real TPU backend for MXU dtypes,
+    # xla elsewhere — CPU tier-1 stays bitwise today's results).
+    # Resolution order: explicit option > pallas_ops.use_panel_impl
+    # context > SLATE_TPU_PANEL_IMPL environment > auto (the
+    # Option.BcastImpl pattern).  The pallas forms match the XLA
+    # references to the documented O(eps cond) explicit-inverse class
+    # (QR panels are bitwise); parity is gated by
+    # tests/test_pallas_panels.py under interpret mode.
+    PanelImpl = "panel_impl"
 
 
 Options = Mapping[Union[Option, str], Any]

@@ -334,3 +334,41 @@ def test_prefetch_bcast_fetch_counts():
         want = (d + 1) if d > 0 else 1
         assert len(fetched) == want, (depth, fetched)
         assert len(consumed) == want, (depth, consumed)
+
+
+def test_gbtrf_lookahead_is_strict_schedule_invariant(rng):
+    """gbtrf accepts Option.Lookahead for API symmetry but runs the
+    STRICT schedule at every depth — the pivoted band step's swap column
+    window slides with k and its exclusion set would depend on the pivot
+    choices, so there is no legal deferred-update reorder (and no
+    read-only operand to prefetch: every panel reads column k as updated
+    by step k-1).  PR 3 documented this in the driver docstring; this
+    test turns the note into an enforced invariant: the traced schedule
+    must be IDENTICAL at every depth (not merely bitwise-equal outputs —
+    a depth-dependent schedule that happened to agree numerically would
+    still fail here), and execution must agree bitwise."""
+    from slate_tpu.parallel.dist_lu import gbtrf_band_dist
+
+    mesh = mesh24()
+    kl = ku = 2 * NB
+    a = rng.standard_normal((N, N))
+    band = np.triu(np.tril(a, kl), -ku).T  # any band-limited matrix
+    ad = from_dense(jnp.asarray(band + N * np.eye(N)), mesh, NB,
+                    diag_pad_one=True)
+
+    jaxprs = {
+        la: str(jax.make_jaxpr(
+            lambda x: gbtrf_band_dist(x, kl, ku, lookahead=la)
+        )(ad))
+        for la in (0, 1, 3)
+    }
+    assert jaxprs[1] == jaxprs[0], "gbtrf schedule must not depend on depth"
+    assert jaxprs[3] == jaxprs[0], "gbtrf schedule must not depend on depth"
+
+    outs = {}
+    for la in (0, 2):
+        lu, perm, info = gbtrf_band_dist(ad, kl, ku, lookahead=la)
+        assert int(info) == 0
+        outs[la] = (np.asarray(to_dense(lu)), np.asarray(perm))
+    np.testing.assert_array_equal(outs[2][0], outs[0][0])
+    np.testing.assert_array_equal(outs[2][1], outs[0][1])

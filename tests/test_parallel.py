@@ -1023,10 +1023,11 @@ def test_band_mesh_kernels_band_cost(rng):
             ca = ca[0]
         return ca["flops"]
 
-    # lowering pinned to psum: the flop-class gate is impl-independent
-    # (ppermute adds bytes bookkeeping, not flops) but the jits now take
-    # the bcast-impl static arg
-    dense = _potrf_jit.lower(tiles, mesh, 2, 4, nt, 1, "psum").compile()
+    # lowering pinned to psum + the xla panel forms: the flop-class gate
+    # is impl-independent (ppermute adds bytes bookkeeping, not flops;
+    # the fused panel kernels change dispatch count, not flop class) but
+    # the jits now take the bcast-impl / panel-impl static args
+    dense = _potrf_jit.lower(tiles, mesh, 2, 4, nt, 1, "psum", "xla").compile()
     band = _pbtrf_band_jit.lower(tiles, mesh, 2, 4, nt, wd, 1, "psum").compile()
     assert flops(band) < flops(dense) / 4, (flops(band), flops(dense))
 

@@ -1,0 +1,214 @@
+"""Fused-panel RunReport evidence (ISSUE 6 acceptance artifact).
+
+Runs every fused Pallas panel kernel against its XLA reference chain —
+chol diag+inv, the potrf/LU panel-tile phases, the Householder panel
+(+T), and the fused ABFT trailing-update+checksum step — and writes one
+RunReport per lowering plus a diff summary:
+
+- each side's values are its BACKWARD-ERROR residuals against an f64
+  numpy ground truth (``*_resid_err``: lower-is-better names, so the
+  ``python -m slate_tpu.obs.report --check PALLAS XLA`` gate enforces
+  the parity contract: the fused kernels may not be numerically worse
+  than the XLA chains beyond the threshold), and ``*_bitwise`` = 1.0
+  for the QR kernels, which must reproduce the XLA pair exactly;
+- on this CPU harness the kernels run under the Pallas interpreter, so
+  the artifact certifies PARITY (the numerics shipped to the MXU), not
+  speed — the on-chip speed story is bench.py's ``panel_*`` extras.
+
+Usage:
+  JAX_PLATFORMS=cpu python tools/panel_report.py [--out artifacts/obs]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+NB = 32
+L = 7
+
+
+def _operands():
+    rng = np.random.default_rng(0)
+    g = rng.standard_normal((NB, NB)).astype(np.float32)
+    spd = jnp.asarray(g @ g.T + NB * np.eye(NB, dtype=np.float32))
+    dd = jnp.asarray(g + NB * np.eye(NB, dtype=np.float32))
+    tiles = jnp.asarray(rng.standard_normal((L, NB, NB)).astype(np.float32))
+    qpanel = jnp.asarray(rng.standard_normal((8 * NB, 16)).astype(np.float32))
+    return spd, dd, tiles, qpanel
+
+
+def run(impl: str) -> dict:
+    """Residuals of one lowering's panel phases vs f64 numpy truth."""
+    from slate_tpu.linalg.lu import _getrf_nopiv_rec
+    from slate_tpu.linalg.qr import _larft, _panel_qr
+    from slate_tpu.ops import pallas_ops as po
+
+    spd, dd, tiles, qpanel = _operands()
+    spd64 = np.asarray(spd, np.float64)
+    dd64 = np.asarray(dd, np.float64)
+    t64 = np.asarray(tiles, np.float64)
+    vals = {}
+
+    # --- potrf panel: diag factor + tile solves ---
+    if impl == "pallas":
+        lkk, solved = po.chol_panel_tiles_pallas(spd, tiles)
+    else:
+        lkk = jax.lax.linalg.cholesky(spd)
+        solved = jax.lax.linalg.triangular_solve(
+            jnp.broadcast_to(lkk.T, tiles.shape), tiles,
+            left_side=False, lower=False, transpose_a=False,
+        )
+    lref = np.linalg.cholesky(spd64)
+    sref = t64 @ np.linalg.inv(lref).T
+    scale = np.abs(spd64).max()
+    vals["panel_potrf_factor_resid_err"] = float(
+        np.abs(np.asarray(lkk, np.float64) - lref).max() / scale
+    )
+    vals["panel_potrf_solve_resid_err"] = float(
+        np.abs(np.asarray(solved, np.float64) - sref).max() / np.abs(sref).max()
+    )
+
+    # --- LU-nopiv panel: diag L\U + column/row tile solves ---
+    if impl == "pallas":
+        lu, csolved = po.lu_panel_tiles_pallas(dd, tiles)
+        rsolved = po.lu_rowsolve_tiles_pallas(lu, tiles)
+    else:
+        lu = _getrf_nopiv_rec(dd)
+        csolved = jax.lax.linalg.triangular_solve(
+            jnp.broadcast_to(jnp.triu(lu), tiles.shape), tiles,
+            left_side=False, lower=False, transpose_a=False,
+        )
+        rsolved = jax.lax.linalg.triangular_solve(
+            jnp.broadcast_to(jnp.tril(lu, -1) + jnp.eye(NB, dtype=lu.dtype),
+                             tiles.shape),
+            tiles, left_side=True, lower=True, transpose_a=False,
+            unit_diagonal=True,
+        )
+    lun = np.asarray(lu, np.float64)
+    Lf = np.tril(lun, -1) + np.eye(NB)
+    Uf = np.triu(lun)
+    vals["panel_getrf_factor_resid_err"] = float(
+        np.abs(Lf @ Uf - dd64).max() / np.abs(dd64).max()
+    )
+    cref = t64 @ np.linalg.inv(Uf)
+    rref = np.linalg.inv(Lf) @ t64
+    vals["panel_getrf_colsolve_resid_err"] = float(
+        np.abs(np.asarray(csolved, np.float64) - cref).max() / np.abs(cref).max()
+    )
+    vals["panel_getrf_rowsolve_resid_err"] = float(
+        np.abs(np.asarray(rsolved, np.float64) - rref).max() / np.abs(rref).max()
+    )
+
+    # --- Householder panel (+T): pallas must be BITWISE vs the XLA pair ---
+    vr_ref, tau_ref = _panel_qr(qpanel)
+    t_ref = _larft(vr_ref, tau_ref)
+    if impl == "pallas":
+        vr, tau, t = po.qr_panel_pallas(qpanel)
+        bitwise = (
+            np.array_equal(np.asarray(vr), np.asarray(vr_ref))
+            and np.array_equal(np.asarray(tau), np.asarray(tau_ref))
+            and np.array_equal(np.asarray(t), np.asarray(t_ref))
+        )
+    else:
+        vr, tau, t = vr_ref, tau_ref, t_ref
+        bitwise = True
+    vals["panel_qr_bitwise"] = float(bitwise)
+    qv = np.asarray(vr, np.float64)
+    rq = np.triu(qv[:16])
+    qref = np.linalg.qr(np.asarray(qpanel, np.float64))[1]
+    vals["panel_qr_factor_resid_err"] = float(
+        np.abs(np.abs(rq) - np.abs(qref)).max() / np.abs(qref).max()
+    )
+
+    # --- fused ABFT trailing update + Huang-Abraham partial sums ---
+    acc = jnp.zeros((L, 3, NB, NB), jnp.float32)
+    urow = tiles[:3]
+    w1 = jnp.ones((L,), jnp.float32)
+    w2 = jnp.arange(1.0, L + 1.0, dtype=jnp.float32)
+    part0 = jnp.zeros((2, 3, NB, NB), jnp.float32)
+    if impl == "pallas":
+        out, part = po.ft_summa_update_pallas(acc, tiles, urow, w1, w2, part0)
+    else:
+        upd = jnp.einsum("iab,jbc->ijac", tiles, urow,
+                         precision=jax.lax.Precision.HIGHEST)
+        out = acc + upd
+        part = part0 + jnp.stack([
+            jnp.einsum("i,ijab->jab", w1, upd,
+                       precision=jax.lax.Precision.HIGHEST),
+            jnp.einsum("i,ijab->jab", w2, upd,
+                       precision=jax.lax.Precision.HIGHEST),
+        ])
+    upd64 = np.einsum("iab,jbc->ijac", t64, t64[:3])
+    p64 = np.stack([
+        np.einsum("i,ijab->jab", np.asarray(w1, np.float64), upd64),
+        np.einsum("i,ijab->jab", np.asarray(w2, np.float64), upd64),
+    ])
+    vals["panel_ft_update_resid_err"] = float(
+        np.abs(np.asarray(out, np.float64) - upd64).max() / np.abs(upd64).max()
+    )
+    vals["panel_ft_checksum_resid_err"] = float(
+        np.abs(np.asarray(part, np.float64) - p64).max() / np.abs(p64).max()
+    )
+    vals["panel_kernels_checked"] = 5.0
+    return vals
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "..", "artifacts", "obs"))
+    ap.add_argument("--threshold", type=float, default=3.0)
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+
+    from slate_tpu.obs.report import check_regression, write_report
+    from slate_tpu.ops.pallas_ops import use_panel_impl
+
+    reports = {}
+    for impl in ("xla", "pallas"):
+        with use_panel_impl(impl):
+            jax.clear_caches()
+            vals = run(impl)
+        path = os.path.join(args.out, f"panel_{impl}.report.json")
+        write_report(path, name=f"panel_{impl}",
+                     config={"nb": NB, "tiles": L, "impl": impl}, values=vals)
+        reports[impl] = vals
+        print(f"panel_report: wrote {path}")
+
+    if reports["pallas"].get("panel_qr_bitwise") != 1.0:
+        raise SystemExit("panel_report: QR kernel is not bitwise vs XLA")
+    failures, compared = check_regression(
+        reports["pallas"], reports["xla"], threshold=args.threshold
+    )
+    diff = {
+        "threshold": args.threshold,
+        "compared": compared,
+        "failures": failures,
+        "xla": reports["xla"],
+        "pallas": reports["pallas"],
+    }
+    dpath = os.path.join(args.out, "panel_diff.json")
+    with open(dpath, "w") as f:
+        json.dump(diff, f, indent=1)
+    print(f"panel_report: wrote {dpath} ({compared} metrics compared)")
+    if failures:
+        for msg in failures:
+            print(f"panel_report: REGRESSION {msg}", file=sys.stderr)
+        raise SystemExit(1)
+    print("panel_report: OK — fused kernels within parity threshold")
+
+
+if __name__ == "__main__":
+    main()
