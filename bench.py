@@ -489,6 +489,7 @@ def main():
 
     _emit(gflops, extras)  # final line carries the derived ratios too
     _emit_obs_report(gflops, extras)
+    _emit_flight_report()
 
 
 def _emit_obs_report(gflops, extras):
@@ -514,6 +515,37 @@ def _emit_obs_report(gflops, extras):
         _progress(f"obs report written to {path}")
     except Exception as e:  # the headline line must never die on obs
         _progress(f"obs report failed: {e!r}")
+
+
+def _emit_flight_report():
+    """Flight-recorder twin (ISSUE 7): when SLATE_TPU_OBS_FLIGHT=<path>
+    is set, run a small per-step potrf flight on the available devices
+    and write the FlightReport there — the per-k-step schedule timeline
+    (critical path, overlap efficiency, exposed comm) next to the
+    headline numbers.  Step dispatch fences every phase, so this runs
+    AFTER the headline measurements and never touches them."""
+    path = _os.environ.get("SLATE_TPU_OBS_FLIGHT")
+    if not path:
+        return
+    try:
+        import jax as _jax
+
+        from slate_tpu.obs import flight as _flight
+        from slate_tpu.parallel import make_mesh as _make_mesh
+
+        devs = _jax.devices()
+        if len(devs) >= 8:
+            mesh = _make_mesh(2, 4, devices=devs[:8])
+        else:
+            mesh = _make_mesh(1, len(devs), devices=devs)
+        rep = _flight.run_flight("potrf", n=256, nb=32, depth=1, mesh=mesh)
+        _flight.write_flight_report(path, rep)
+        _progress(
+            f"flight report written to {path} (overlap_eff "
+            f"{rep['sched']['overlap_eff']:.3f}, critical_path "
+            f"{rep['sched']['critical_path_s']:.4f}s)")
+    except Exception as e:  # the headline line must never die on obs
+        _progress(f"flight report failed: {e!r}")
 
 
 if __name__ == "__main__":

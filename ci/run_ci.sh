@@ -34,6 +34,36 @@ fi
 # a synthetic 2x regression (slate_tpu/obs/smoke.py validates all of it)
 python -m slate_tpu.obs.smoke --out artifacts/obs
 
+# flight smoke (ISSUE 7): the step-level flight recorder — tiny summa +
+# potrf re-run as per-step fenced dispatches under BOTH broadcast
+# lowerings (psum + ring).  Gates: schema-valid FlightReports, per-device
+# Perfetto Gantt with broadcast hop flow events, overlap_eff == 0 at
+# lookahead depth 0 and > 0 at depth 1 (the number that proves the
+# Option.Lookahead overlap), results numerically correct.  The fresh ring
+# reports then gate against the committed references on the
+# machine-independent keys only (modeled/measured bytes, resid): the
+# millisecond wall-clock keys AND overlap_eff (a ratio of measured
+# durations) depend on the runner's per-dispatch host round-trip, so
+# they are --ignore'd rather than gated against another machine's
+# numbers — the smoke itself asserts the depth-1-vs-0 overlap contrast
+# on THIS machine.
+python -m slate_tpu.obs.flight --smoke --out artifacts/obs_flight
+python -m slate_tpu.obs.report --check \
+    artifacts/obs_flight/flight_summa.flight.json \
+    artifacts/obs/flight_summa.flight.json --threshold 4 \
+    --ignore 'sched.*_s' --ignore 'sched.overlap_eff'
+python -m slate_tpu.obs.report --check \
+    artifacts/obs_flight/flight_potrf.flight.json \
+    artifacts/obs/flight_potrf.flight.json --threshold 4 \
+    --ignore 'sched.*_s' --ignore 'sched.overlap_eff'
+
+# scaling-curve artifact (ISSUE 7 satellite): fold the MULTICHIP round
+# artifacts into one RunReport-schema curve and schema-validate it
+# through the standard CLI (the committed twin lives at
+# artifacts/obs/scaling.report.json)
+python tools/scaling_report.py --out artifacts/obs_flight/scaling.report.json
+python -m slate_tpu.obs.report artifacts/obs_flight/scaling.report.json > /dev/null
+
 # ft smoke: the ABFT acceptance run — one injected single-tile fault per
 # op class (SUMMA gemm / mesh potrf / LU-nopiv) must be detected and
 # corrected on the 8-device mesh, the recompute + FtError escalations

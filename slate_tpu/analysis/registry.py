@@ -741,3 +741,45 @@ def _ft_gemm_pallas(ctx):
 @register("potrf_abft_panel_pallas", tags=("panel", "ft"))
 def _ft_potrf_pallas(ctx):
     return _ft_factor_build(ctx, "potrf", armed=False, panel_impl="pallas")
+
+
+# ---------------------------------------------------------------------------
+# Flight-recorder variants (ISSUE 7): the step-dispatch phase programs
+# under the gate.  Each traces one full flight k-step (panel -> bcast ->
+# narrow/bulk composition via obs.flight.step_traceable) with k a RUNTIME
+# scalar, so the per-step jits' actual jaxpr surface — rooted broadcasts
+# through the engine's lax.switch dispatch, HIGHEST-precision update
+# einsums, audited collectives with declared axis names — stays
+# lint-green alongside the fused kernels.
+# ---------------------------------------------------------------------------
+
+
+def _flight_build(ctx, op, kind):
+    import jax.numpy as jnp
+
+    from ..obs.flight import step_traceable
+
+    a = ctx.dist(kind=kind, diag_pad=(op != "summa"))
+    mtl, ntl = a.tiles.shape[0] // ctx.p, a.tiles.shape[1] // ctx.q
+    fn = step_traceable(op, ctx.mesh, ctx.p, ctx.q, a.nt, mtl, ntl, a.nb)
+    k = jnp.asarray(1)  # default int dtype (x64-aware): matches the literal
+    # indices inside bcast_diag_tile's dynamic_slice
+    if op == "summa":
+        b = ctx.dist()
+        return fn, (a.tiles, b.tiles, k)
+    return fn, (a.tiles, k)
+
+
+@register("gemm_summa_flight", tags=("flight",))
+def _gemm_flight(ctx):
+    return _flight_build(ctx, "summa", "general")
+
+
+@register("potrf_dist_flight", tags=("flight",))
+def _potrf_flight(ctx):
+    return _flight_build(ctx, "potrf", "spd")
+
+
+@register("getrf_nopiv_dist_flight", tags=("flight",))
+def _getrf_nopiv_flight(ctx):
+    return _flight_build(ctx, "getrf_nopiv", "tril")

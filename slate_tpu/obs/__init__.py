@@ -58,4 +58,25 @@ __all__ = [
     "instrument",
     "measure",
     "reset",
+    # lazily forwarded from obs.flight (see __getattr__)
+    "flight_scope",
+    "no_flight",
+    "step_dispatch_active",
+    "FlightRecorder",
 ]
+
+_FLIGHT_NAMES = frozenset(
+    {"flight_scope", "no_flight", "step_dispatch_active", "FlightRecorder"}
+)
+
+
+def __getattr__(name):
+    # obs.flight_scope() et al. without an eager submodule import, so
+    # ``python -m slate_tpu.obs.flight`` still runs without runpy's
+    # found-in-sys.modules warning (same reason report/perfetto are not
+    # imported here)
+    if name in _FLIGHT_NAMES:
+        from . import flight
+
+        return getattr(flight, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,1166 @@
+"""Step-level flight recorder: per-k-step, per-phase, per-device timelines
+of the mesh k-loops (ISSUE 7 tentpole).
+
+The obs layer (PR 2) sees a driver as ONE span — one wall number per
+factorization.  This module is the layer below: the analogue of the
+reference's ``trace`` facility (per-task Gantt traces of
+panel/bcast/update, Trace.hh) for the shard_map kernels, whose k-loops
+normally live inside a single ``lax.fori_loop`` dispatch where no host
+clock can see them.
+
+Step-dispatch mode (``SLATE_TPU_OBS_DEEP=1`` or ``obs.flight_scope()``)
+re-runs an opted-in mesh kernel (summa / dist_chol potrf / dist_lu
+nopiv / dist_trsm TrsmB) as PER-STEP jitted dispatches: the same
+panel / bcast / bulk phase split ``comm.pipelined_factor_loop`` and
+``comm.prefetch_bcast`` schedule, with each phase a separate
+AOT-compiled program fenced by ``block_until_ready`` and bracketed by
+host timestamps.  Each fenced dispatch records one
+``StepEvent(op, k, phase, device_coord, t0, t1, bytes, flops)`` per mesh
+coordinate; phase wire bytes come from the comm-byte audit captured at
+the phase program's trace, flops from XLA's own cost analysis of the
+compiled phase.  Results are bitwise-identical to the fused kernels
+(same per-element arithmetic in the same order; the strict schedule is
+the depth-0 schedule the lookahead tests already pin).
+
+Honesty contract: the fences SERIALIZE the dispatches, so the recorder
+measures per-phase COSTS, not achieved concurrency — the overlap /
+critical-path numbers come from applying the lookahead issue schedule
+(which the recorder reproduces exactly: depth d issues step k+d's
+broadcast before step k's update, the DPLASMA-style critical-path lens)
+to the measured phase durations via ``obs.schedule``.  Step-dispatch
+also pays one host round-trip per phase, so its absolute wall time is an
+upper bound — use the normal instrumented path for end-to-end numbers.
+
+Off by default: with the env unset and no scope open,
+``step_dispatch_active()`` is False and the kernels take their fused
+path, trace-identical to before this module existed (asserted by
+tests/test_flight.py).
+
+CLI::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python -m slate_tpu.obs.flight potrf [--n 96] [--nb 8] \\
+            [--depth 1] [--impl auto] [--hops] [--out FLIGHT.json] \\
+            [--trace TRACE.json]
+    python -m slate_tpu.obs.flight --smoke [--out artifacts/obs]
+
+The emitted FlightReport (schema ``slate_tpu.obs.flight_report`` v1)
+carries a ``values`` section with the ``sched.*`` keys so
+``python -m slate_tpu.obs.report --check NEW OLD`` regression-gates it
+like any RunReport.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+DEEP_ENV = "SLATE_TPU_OBS_DEEP"
+FLIGHT_SCHEMA = "slate_tpu.obs.flight_report"
+FLIGHT_VERSION = 1
+PHASES = ("panel", "bcast", "bulk")
+FLIGHT_OPS = ("summa", "potrf", "getrf_nopiv", "trsm")
+
+# bound on recorded events / hop-event groups so a big flight cannot grow
+# without limit (nt steps x 3 phases x P devices stays far below this)
+_EVENT_CAP = 200_000
+
+
+class StepEvent(NamedTuple):
+    """One fenced phase dispatch as seen from one mesh coordinate.
+
+    ``t0``/``t1`` are host ``perf_counter`` stamps around the fenced
+    dispatch (identical across the coordinates of one dispatch — the
+    fence bounds every device).  ``bytes`` is this device's share of the
+    phase's audited wire bytes, ``flops`` its share of XLA's flop
+    estimate for the phase program."""
+
+    op: str
+    k: int
+    phase: str
+    device_coord: Tuple[int, int]
+    t0: float
+    t1: float
+    bytes: float
+    flops: float
+
+
+class FlightRecorder:
+    """Collects StepEvents plus the per-phase hop schedules (src→dst
+    ppermute pairs) the Perfetto exporter renders as flow arrows."""
+
+    def __init__(self) -> None:
+        self.events: List[StepEvent] = []
+        self.hop_events: List[dict] = []  # {op, k, phase, t_s, hops: [...]}
+        self.runs: List[dict] = []
+
+    def record_phase(self, op, k, phase, t0, t1, nbytes, flops, coords,
+                     hops=None, root_k=None) -> None:
+        share = max(1, len(coords))
+        if len(self.events) + share <= _EVENT_CAP:
+            for rc in coords:
+                self.events.append(StepEvent(
+                    op, int(k), phase, tuple(rc), float(t0), float(t1),
+                    float(nbytes) / share, float(flops) / share,
+                ))
+        if hops and len(self.hop_events) < _EVENT_CAP:
+            # root_k: the LOGICAL step that owns the broadcast, which
+            # rotates the audited root-0 hop pairs in the Perfetto
+            # export.  Differs from the dispatch index k only for
+            # backward solves (trsm upper/notrans: logical nt-1-k).
+            self.hop_events.append(
+                {"op": op, "k": int(k), "phase": phase,
+                 "root_k": int(k if root_k is None else root_k),
+                 "t0": float(t0), "t1": float(t1), "hops": hops}
+            )
+
+    def note_run(self, **meta) -> None:
+        self.runs.append(meta)
+
+    def clear(self) -> None:
+        self.events.clear()
+        self.hop_events.clear()
+        self.runs.clear()
+
+
+# ---------------------------------------------------------------------------
+# Activation: scope > env.  ``no_flight`` pins it off (the CLI uses it to
+# trace the fused kernels for the schedule model even when the env is set).
+# ---------------------------------------------------------------------------
+
+_OFF = object()
+_SCOPE: List[Any] = []
+_ENV_RECORDER: Optional[FlightRecorder] = None
+
+
+def _env_deep() -> bool:
+    return os.environ.get(DEEP_ENV, "") not in ("", "0")
+
+
+def active_recorder() -> Optional[FlightRecorder]:
+    """The recorder step dispatches should feed, or None when flight
+    recording is off (the common case: one list peek + one env read)."""
+    if _SCOPE:
+        top = _SCOPE[-1]
+        return None if top is _OFF else top
+    if _env_deep():
+        global _ENV_RECORDER
+        if _ENV_RECORDER is None:
+            _ENV_RECORDER = FlightRecorder()
+        return _ENV_RECORDER
+    return None
+
+
+def step_dispatch_active() -> bool:
+    """True when the opted-in mesh kernels should route their k-loops
+    through the per-step dispatch drivers below."""
+    return active_recorder() is not None
+
+
+@contextlib.contextmanager
+def flight_scope(recorder: Optional[FlightRecorder] = None):
+    """Activate step-dispatch recording for drivers called inside; yields
+    the FlightRecorder the dispatches fill."""
+    rec = recorder if recorder is not None else FlightRecorder()
+    _SCOPE.append(rec)
+    try:
+        yield rec
+    finally:
+        _SCOPE.pop()
+
+
+@contextlib.contextmanager
+def no_flight():
+    """Force the fused kernel path inside (overrides the env switch)."""
+    _SCOPE.append(_OFF)
+    try:
+        yield
+    finally:
+        _SCOPE.pop()
+
+
+@contextlib.contextmanager
+def _scopes(*cms):
+    with contextlib.ExitStack() as st:
+        for cm in cms:
+            st.enter_context(cm)
+        yield
+
+
+# ---------------------------------------------------------------------------
+# Phase programs: one AOT-compiled jit per loop phase.  The trace runs
+# under the comm-byte audit (the traced operand sizes ARE the per-step
+# wire bytes) and the schedule channel (per-hop src→dst pairs); the
+# compiled object yields XLA's flop estimate.  Dispatches are fenced.
+# ---------------------------------------------------------------------------
+
+
+class _Phase:
+    def __init__(self, op: str, phase: str, fn, trace_ctx=None,
+                 label: Optional[str] = None):
+        self.op = op
+        self.phase = phase
+        self.label = label or phase
+        self.fn = fn
+        self.trace_ctx = trace_ctx
+        self.compiled = None
+        self.bytes = 0.0
+        self.flops = 0.0
+        self.hops: List[dict] = []
+
+    def _compile(self, *args) -> None:
+        import jax
+
+        from ..parallel import comm
+        from .span import _cost_from_compiled
+
+        ctx = self.trace_ctx() if self.trace_ctx is not None else (
+            contextlib.nullcontext())
+        with comm.comm_audit() as recs, comm.sched_audit() as sched:
+            with ctx:
+                self.compiled = jax.jit(self.fn).lower(*args).compile()
+        self.bytes = float(sum(nb * m for _, nb, m in recs))
+        self.hops = [
+            {"op": op_, "bytes": float(nb) * m, "pairs": pairs}
+            for op_, nb, m, _, _, pairs in sched if pairs
+        ]
+        cost = _cost_from_compiled(self.compiled)
+        self.flops = float(cost.get("flops", 0.0))
+
+    def __call__(self, rec: Optional[FlightRecorder], k: int, coords, *args,
+                 root_k: Optional[int] = None):
+        import jax
+
+        if self.compiled is None:
+            self._compile(*args)
+        t0 = time.perf_counter()
+        out = self.compiled(*args)
+        jax.block_until_ready(out)
+        t1 = time.perf_counter()
+        if rec is not None:
+            rec.record_phase(self.op, k, self.phase, t0, t1, self.bytes,
+                             self.flops, coords, hops=self.hops,
+                             root_k=root_k)
+        return out
+
+
+def _sm(kernel, mesh, in_specs, out_specs):
+    from ..parallel.comm import shard_map_compat
+
+    def fn(*args):
+        return shard_map_compat(
+            kernel, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )(*args)
+
+    return fn
+
+
+def _coords(p: int, q: int) -> List[Tuple[int, int]]:
+    return [(r, c) for r in range(p) for c in range(q)]
+
+
+def _ik(k: int):
+    """Step index as a DEFAULT-int scalar (int32, int64 under x64): the
+    kernels mix it with literal indices in dynamic_slice tuples, whose
+    dtypes must match."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(int(k))
+
+
+def _specs():
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import COL_AXIS, ROW_AXIS
+
+    return P(ROW_AXIS, COL_AXIS), P()
+
+
+# ---------------------------------------------------------------------------
+# Step-dispatch drivers.  Each mirrors its fused kernel's math exactly —
+# strict schedule arithmetic (the depth-0 order every lookahead depth is
+# bitwise-equal to), with the lookahead depth reproduced as the ISSUE
+# order of the dispatches: depth d issues step k+d's broadcast before
+# step k's update, exactly as comm.prefetch_bcast / pipelined_factor_loop
+# order the work inside the fused loop body.
+# ---------------------------------------------------------------------------
+
+
+def _summa_phase_kernels(p, q):
+    """Raw per-device phase kernels of one SUMMA k-step (inside
+    shard_map), shared by the step-dispatch driver and the lint-registry
+    traceable.  ``k`` is a replicated traced scalar: the rooted
+    broadcasts dispatch through the engine's lax.switch path, exactly as
+    inside the fused loop body."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..parallel.comm import PRECISE, bcast_from_col, bcast_from_row
+
+    def fetch_k(a_loc, b_loc, k):
+        acol_own = lax.dynamic_slice_in_dim(a_loc, k // q, 1, axis=1)[:, 0]
+        acol = bcast_from_col(acol_own, k % q)
+        brow_own = lax.dynamic_slice_in_dim(b_loc, k // p, 1, axis=0)[0]
+        brow = bcast_from_row(brow_own, k % p)
+        return acol[None, None], brow[None, None]
+
+    def bulk_k(acc, acol, brow):
+        upd = jnp.einsum(
+            "iab,jbc->ijac", acol[0, 0], brow[0, 0], precision=PRECISE
+        )
+        return acc + upd.astype(acc.dtype)
+
+    return {"fetch": fetch_k, "bulk": bulk_k}
+
+
+def summa_steps(at, bt, ct, alpha, beta, mesh, p, q, kt, la, bi):
+    """Per-step stationary-C SUMMA (the _summa_jit schedule, fenced)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from ..parallel.comm import bcast_impl_scope
+
+    rec = active_recorder()
+    spec, rep = _specs()
+    ks = _summa_phase_kernels(p, q)
+    fetch = _Phase("summa", "bcast",
+                   _sm(ks["fetch"], mesh, (spec, spec, rep), (spec, spec)),
+                   trace_ctx=lambda: bcast_impl_scope(bi))
+    bulk = _Phase("summa", "bulk",
+                  _sm(ks["bulk"], mesh, (spec, spec, spec), spec))
+
+    nb = at.shape[2]
+    acc = jax.device_put(
+        jnp.zeros((at.shape[0], bt.shape[1], nb, nb), at.dtype),
+        NamedSharding(mesh, spec),
+    )
+    coords = _coords(p, q)
+    d = max(0, min(int(la), int(kt)))
+    if rec is not None:
+        rec.note_run(op="summa", nt=int(kt), depth=d, impl=bi, grid=(p, q),
+                     phases=("bcast", "bulk"))
+    fifo: List[Any] = []
+    for j in range(d):
+        fifo.append(fetch(rec, j, coords, at, bt, _ik(j)))
+    for k in range(kt):
+        if d and k + d < kt:
+            fifo.append(fetch(rec, k + d, coords, at, bt, _ik(k + d)))
+        pk = fifo.pop(0) if d else fetch(rec, k, coords, at, bt, _ik(k))
+        acc = bulk(rec, k, coords, acc, pk[0], pk[1])
+    if ct is None:
+        return (alpha * acc).astype(at.dtype)
+    return (alpha * acc + beta * ct).astype(at.dtype)
+
+
+def _potrf_phase_kernels(p, q, mtl, ntl, nt, nb, cplx):
+    """Raw per-device phase kernels of one mesh-Cholesky k-step (the
+    module-level dist_chol._chol_* helpers, unbucketed), shared by the
+    step-dispatch driver and the lint-registry traceable."""
+    from ..parallel.comm import local_indices
+    from ..parallel.dist_chol import (
+        _chol_bulk, _chol_info_dist, _chol_narrow, _chol_panel_bcast,
+        _chol_panel_compute,
+    )
+
+    def _logs():
+        return local_indices(p, q, mtl, ntl)
+
+    def _lower():
+        _, _, i_log, j_log = _logs()
+        return (i_log[:, None] >= j_log[None, :])[:, :, None, None]
+
+    def panel_k(t_loc, k):
+        _, c, i_log, _ = _logs()
+        view, pan_own = _chol_panel_compute(t_loc, k, p, q, i_log, c, cplx)
+        return view, pan_own[None, None]
+
+    def bcast_k(pan_own, k):
+        _, _, _, j_log = _logs()
+        pan, panT = _chol_panel_bcast(pan_own[0, 0], k, p, q, j_log)
+        return pan[None, None], panT[None, None]
+
+    def narrow_k(t_loc, pan, panT, k):
+        return _chol_narrow(t_loc, (pan[0, 0], panT[0, 0]), k, q, _lower(),
+                            cplx)
+
+    def bulk_excl_k(t_loc, pan, panT, k):
+        return _chol_bulk(t_loc, (pan[0, 0], panT[0, 0]), _lower(), cplx,
+                          excl_kc=k // q)
+
+    def bulk_full_k(t_loc, pan, panT):
+        return _chol_bulk(t_loc, (pan[0, 0], panT[0, 0]), _lower(), cplx)
+
+    def info_k(t_loc):
+        _, _, i_log, j_log = _logs()
+        return _chol_info_dist(t_loc, i_log, j_log, nt, nb)[None, None]
+
+    return {"panel": panel_k, "bcast": bcast_k, "narrow": narrow_k,
+            "bulk_excl": bulk_excl_k, "bulk_full": bulk_full_k,
+            "info": info_k}
+
+
+def potrf_steps(at, mesh, p, q, nt, la, bi, pi):
+    """Per-step mesh Cholesky: the _potrf_jit phases (module-level
+    _chol_* helpers), unbucketed, fenced per phase."""
+    import jax.numpy as jnp
+
+    from ..ops.pallas_ops import panel_impl_scope
+    from ..parallel.comm import bcast_impl_scope
+
+    rec = active_recorder()
+    spec, rep = _specs()
+    mtl, ntl = at.shape[0] // p, at.shape[1] // q
+    nb = at.shape[2]
+    cplx = jnp.issubdtype(at.dtype, jnp.complexfloating)
+    ctx = lambda: _scopes(bcast_impl_scope(bi), panel_impl_scope(pi))
+    ks = _potrf_phase_kernels(p, q, mtl, ntl, nt, nb, cplx)
+
+    panel = _Phase("potrf", "panel",
+                   _sm(ks["panel"], mesh, (spec, rep), (spec, spec)),
+                   trace_ctx=ctx)
+    bcast = _Phase("potrf", "bcast",
+                   _sm(ks["bcast"], mesh, (spec, rep), (spec, spec)),
+                   trace_ctx=lambda: bcast_impl_scope(bi))
+    narrow = _Phase("potrf", "bulk",
+                    _sm(ks["narrow"], mesh, (spec, spec, spec, rep), spec),
+                    label="narrow")
+    bulk_excl = _Phase("potrf", "bulk",
+                       _sm(ks["bulk_excl"], mesh,
+                           (spec, spec, spec, rep), spec),
+                       label="bulk_excl")
+    bulk_full = _Phase("potrf", "bulk",
+                       _sm(ks["bulk_full"], mesh, (spec, spec, spec), spec),
+                       label="bulk_full")
+    info_p = _Phase("potrf", "info", _sm(ks["info"], mesh, (spec,), spec))
+
+    coords = _coords(p, q)
+    d = min(max(0, int(la)), 1)  # factor-loop pipelining caps at depth 1
+    if rec is not None:
+        rec.note_run(op="potrf", nt=int(nt), depth=d, impl=bi, panel=pi,
+                     grid=(p, q), phases=PHASES)
+    t = at
+    if d == 0:
+        for k in range(nt):
+            t, pan_own = panel(rec, k, coords, t, _ik(k))
+            pl = bcast(rec, k, coords, pan_own, _ik(k))
+            t = bulk_full(rec, k, coords, t, pl[0], pl[1])
+    else:
+        pl_prev = None
+        for k in range(nt):
+            if pl_prev is not None:
+                t = narrow(rec, k - 1, coords, t, pl_prev[0], pl_prev[1],
+                           _ik(k))
+            t, pan_own = panel(rec, k, coords, t, _ik(k))
+            pl = bcast(rec, k, coords, pan_own, _ik(k))
+            if pl_prev is not None:
+                t = bulk_excl(rec, k - 1, coords, t, pl_prev[0], pl_prev[1],
+                              _ik(k))
+            pl_prev = pl
+        t = bulk_full(rec, nt - 1, coords, t, pl_prev[0], pl_prev[1])
+    info = info_p(None, 0, coords, t)
+    return t, jnp.max(info)
+
+
+def _lu_phase_kernels(p, q, mtl, ntl, nt, nb):
+    """Raw per-device phase kernels of one no-pivot LU k-step (the
+    module-level dist_lu._nopiv_* helpers, unbucketed)."""
+    from ..parallel.comm import local_indices
+    from ..parallel.dist_lu import (
+        _lu_info_dist, _nopiv_bulk, _nopiv_narrow, _nopiv_panel_bcast,
+        _nopiv_panel_compute,
+    )
+
+    def _logs():
+        return local_indices(p, q, mtl, ntl)
+
+    def panel_k(t_loc, k):
+        r, c, i_log, j_log = _logs()
+        t_loc, (pan_own, urow_own) = _nopiv_panel_compute(
+            t_loc, k, p, q, i_log, j_log, r, c
+        )
+        return t_loc, pan_own[None, None], urow_own[None, None]
+
+    def bcast_k(pan_own, urow_own, k):
+        pan, urow = _nopiv_panel_bcast((pan_own[0, 0], urow_own[0, 0]),
+                                       k, p, q)
+        return pan[None, None], urow[None, None]
+
+    def narrow_k(t_loc, pan, urow, k):
+        return _nopiv_narrow(t_loc, (pan[0, 0], urow[0, 0]), k, p, q)
+
+    def bulk_excl_k(t_loc, pan, urow, k):
+        return _nopiv_bulk(t_loc, (pan[0, 0], urow[0, 0]), k // p, k // q)
+
+    def bulk_full_k(t_loc, pan, urow):
+        return _nopiv_bulk(t_loc, (pan[0, 0], urow[0, 0]))
+
+    def info_k(t_loc):
+        _, _, i_log, j_log = _logs()
+        return _lu_info_dist(t_loc, i_log, j_log, nt, nb)[None, None]
+
+    return {"panel": panel_k, "bcast": bcast_k, "narrow": narrow_k,
+            "bulk_excl": bulk_excl_k, "bulk_full": bulk_full_k,
+            "info": info_k}
+
+
+def lu_steps(at, mesh, p, q, nt, la, bi, pi):
+    """Per-step no-pivot mesh LU: the _lu_jit phases (_nopiv_* helpers),
+    unbucketed, fenced per phase."""
+    import jax.numpy as jnp
+
+    from ..ops.pallas_ops import panel_impl_scope
+    from ..parallel.comm import bcast_impl_scope
+
+    rec = active_recorder()
+    spec, rep = _specs()
+    mtl, ntl = at.shape[0] // p, at.shape[1] // q
+    nb = at.shape[2]
+    ctx = lambda: _scopes(bcast_impl_scope(bi), panel_impl_scope(pi))
+    ks = _lu_phase_kernels(p, q, mtl, ntl, nt, nb)
+
+    panel = _Phase("getrf_nopiv", "panel",
+                   _sm(ks["panel"], mesh, (spec, rep), (spec, spec, spec)),
+                   trace_ctx=ctx)
+    bcast = _Phase("getrf_nopiv", "bcast",
+                   _sm(ks["bcast"], mesh, (spec, spec, rep), (spec, spec)),
+                   trace_ctx=lambda: bcast_impl_scope(bi))
+    narrow = _Phase("getrf_nopiv", "bulk",
+                    _sm(ks["narrow"], mesh, (spec, spec, spec, rep), spec),
+                    label="narrow")
+    bulk_excl = _Phase("getrf_nopiv", "bulk",
+                       _sm(ks["bulk_excl"], mesh,
+                           (spec, spec, spec, rep), spec),
+                       label="bulk_excl")
+    bulk_full = _Phase("getrf_nopiv", "bulk",
+                       _sm(ks["bulk_full"], mesh, (spec, spec, spec), spec),
+                       label="bulk_full")
+    info_p = _Phase("getrf_nopiv", "info",
+                    _sm(ks["info"], mesh, (spec,), spec))
+
+    coords = _coords(p, q)
+    d = min(max(0, int(la)), 1)
+    if rec is not None:
+        rec.note_run(op="getrf_nopiv", nt=int(nt), depth=d, impl=bi,
+                     panel=pi, grid=(p, q), phases=PHASES)
+    t = at
+    if d == 0:
+        for k in range(nt):
+            t, po, uo = panel(rec, k, coords, t, _ik(k))
+            pl = bcast(rec, k, coords, po, uo, _ik(k))
+            t = bulk_full(rec, k, coords, t, pl[0], pl[1])
+    else:
+        pl_prev = None
+        for k in range(nt):
+            if pl_prev is not None:
+                t = narrow(rec, k - 1, coords, t, pl_prev[0], pl_prev[1],
+                           _ik(k))
+            t, po, uo = panel(rec, k, coords, t, _ik(k))
+            pl = bcast(rec, k, coords, po, uo, _ik(k))
+            if pl_prev is not None:
+                t = bulk_excl(rec, k - 1, coords, t, pl_prev[0], pl_prev[1],
+                              _ik(k))
+            pl_prev = pl
+        t = bulk_full(rec, nt - 1, coords, t, pl_prev[0], pl_prev[1])
+    info = info_p(None, 0, coords, t)
+    return t, jnp.max(info)
+
+
+def trsm_steps(at, bt, mesh, p, q, nt, uplo, op_, diag, la, bi):
+    """Per-step left triangular solve (the _trsm_jit TrsmB schedule):
+    bcast = the prefetchable A panels, panel = the serial diag solve +
+    solved-row broadcast, bulk = the trailing update."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..parallel.comm import (
+        PRECISE, all_gather_a, bcast_diag_tile, bcast_from_col,
+        bcast_from_row, bcast_impl_scope, local_indices,
+    )
+    from ..parallel.mesh import COL_AXIS
+    from ..types import Diag, Op, Uplo
+
+    rec = active_recorder()
+    spec, rep = _specs()
+    trans = op_ != Op.NoTrans
+    conj = op_ == Op.ConjTrans
+    eff_lower = (uplo == Uplo.Lower) != trans
+    forward = eff_lower
+    unit = diag == Diag.Unit
+    mtl, ntl = at.shape[0] // p, at.shape[1] // q
+    nb = at.shape[2]
+
+    def opt(t):
+        t = jnp.swapaxes(t, -1, -2)
+        return jnp.conj(t) if conj else t
+
+    def fetch_s(a_loc, s):
+        k = s if forward else nt - 1 - s
+        kr, kc = k // p, k // q
+        r, c, i_log, _ = local_indices(p, q, mtl, ntl)
+        dtile = bcast_diag_tile(a_loc, k, p, q, nb)
+        if trans:
+            dtile = opt(dtile)
+        remaining = (i_log > k) if forward else (i_log < k)
+        if not trans:
+            acol = lax.dynamic_slice_in_dim(a_loc, kc, 1, axis=1)[:, 0]
+            mine_c = (c == k % q)
+            pan = bcast_from_col(
+                jnp.where(remaining[:, None, None] & mine_c, acol, 0), k % q
+            )
+        else:
+            arow = lax.dynamic_slice_in_dim(a_loc, kr, 1, axis=0)[0]
+            mine_r2 = (r == k % p)
+            arow = bcast_from_row(jnp.where(mine_r2, arow, 0), k % p)
+            allrow = all_gather_a(arow, COL_AXIS, axis=0)
+            pan = opt(allrow[i_log % q, i_log // q])
+            pan = jnp.where(remaining[:, None, None], pan, 0)
+        return dtile[None, None], pan[None, None]
+
+    def panel_s(b_loc, dtile, s):
+        k = s if forward else nt - 1 - s
+        kr = k // p
+        r = local_indices(p, q, mtl, ntl)[0]
+        brow = lax.dynamic_slice_in_dim(b_loc, kr, 1, axis=0)[0]
+        xrow = lax.linalg.triangular_solve(
+            jnp.broadcast_to(dtile[0, 0], brow.shape), brow,
+            left_side=True, lower=eff_lower, transpose_a=False,
+            unit_diagonal=unit,
+        )
+        mine_r = (r == k % p)
+        b_loc = lax.dynamic_update_slice_in_dim(
+            b_loc, jnp.where(mine_r, xrow, brow)[None], kr, axis=0
+        )
+        xrow = bcast_from_row(jnp.where(mine_r, xrow, 0), k % p)
+        return b_loc, xrow[None, None]
+
+    def bulk_s(b_loc, pan, xrow):
+        upd = jnp.einsum(
+            "iab,jbc->ijac", pan[0, 0], xrow[0, 0], precision=PRECISE
+        )
+        return b_loc - upd.astype(b_loc.dtype)
+
+    fetch = _Phase("trsm", "bcast",
+                   _sm(fetch_s, mesh, (spec, rep), (spec, spec)),
+                   trace_ctx=lambda: bcast_impl_scope(bi))
+    panel = _Phase("trsm", "panel",
+                   _sm(panel_s, mesh, (spec, spec, rep), (spec, spec)),
+                   trace_ctx=lambda: bcast_impl_scope(bi))
+    bulk = _Phase("trsm", "bulk", _sm(bulk_s, mesh, (spec, spec, spec), spec))
+
+    coords = _coords(p, q)
+    d = max(0, min(int(la), int(nt)))
+    if rec is not None:
+        rec.note_run(op="trsm", nt=int(nt), depth=d, impl=bi, grid=(p, q),
+                     phases=PHASES, forward=bool(forward))
+    b = bt
+
+    def lk(s):
+        # the logical step (broadcast root) of dispatch index s — the
+        # backward solves walk the panels last-to-first
+        return s if forward else nt - 1 - s
+
+    fifo: List[Any] = []
+    for j in range(d):
+        fifo.append(fetch(rec, j, coords, at, _ik(j), root_k=lk(j)))
+    for s in range(nt):
+        if d and s + d < nt:
+            fifo.append(
+                fetch(rec, s + d, coords, at, _ik(s + d), root_k=lk(s + d))
+            )
+        dtile, pan = fifo.pop(0) if d else fetch(rec, s, coords, at,
+                                                 _ik(s), root_k=lk(s))
+        b, xrow = panel(rec, s, coords, b, dtile, _ik(s), root_k=lk(s))
+        b = bulk(rec, s, coords, b, pan, xrow)
+    return b
+
+
+def step_traceable(op: str, mesh, p: int, q: int, nt: int, mtl: int,
+                   ntl: int, nb: int, cplx: bool = False,
+                   bi: str = "auto", pi: str = "xla"):
+    """One full flight k-step as a single traceable function over the
+    global tile stacks — the slate_lint registry surface for the
+    step-dispatch phase programs.  ``k`` is a runtime argument, so the
+    rooted broadcasts trace the engine's lax.switch dispatch exactly as
+    the per-step jits do.  Returns the composed fn (summa: (at, bt, k);
+    potrf/getrf_nopiv: (at, k))."""
+    from ..ops.pallas_ops import panel_impl_scope
+    from ..parallel.comm import bcast_impl_scope
+
+    spec, rep = _specs()
+
+    if op == "summa":
+        ks = _summa_phase_kernels(p, q)
+        fetch = _sm(ks["fetch"], mesh, (spec, spec, rep), (spec, spec))
+        bulk = _sm(ks["bulk"], mesh, (spec, spec, spec), spec)
+
+        def fn(at, bt, k):
+            import jax.numpy as jnp
+
+            with bcast_impl_scope(bi):
+                acol, brow = fetch(at, bt, k)
+                acc = jnp.zeros((at.shape[0], bt.shape[1], nb, nb), at.dtype)
+                return bulk(acc, acol, brow)
+
+        return fn
+
+    if op == "potrf":
+        ks = _potrf_phase_kernels(p, q, mtl, ntl, nt, nb, cplx)
+    elif op == "getrf_nopiv":
+        ks = _lu_phase_kernels(p, q, mtl, ntl, nt, nb)
+    else:
+        raise ValueError(f"no traceable for flight op {op!r}")
+
+    panel = _sm(ks["panel"], mesh, (spec, rep),
+                (spec, spec) if op == "potrf" else (spec, spec, spec))
+    bcast = _sm(ks["bcast"], mesh,
+                (spec, rep) if op == "potrf" else (spec, spec, rep),
+                (spec, spec))
+    narrow = _sm(ks["narrow"], mesh, (spec, spec, spec, rep), spec)
+    bulk_excl = _sm(ks["bulk_excl"], mesh, (spec, spec, spec, rep), spec)
+    bulk_full = _sm(ks["bulk_full"], mesh, (spec, spec, spec), spec)
+    info = _sm(ks["info"], mesh, (spec,), spec)
+
+    def fn(at, k):
+        with _scopes(bcast_impl_scope(bi), panel_impl_scope(pi)):
+            if op == "potrf":
+                t, po = panel(at, k)
+                pl = bcast(po, k)
+            else:
+                t, po, uo = panel(at, k)
+                pl = bcast(po, uo, k)
+            t = narrow(t, pl[0], pl[1], k)
+            t = bulk_excl(t, pl[0], pl[1], k)
+            t = bulk_full(t, pl[0], pl[1])
+            return t, info(t)
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# End-to-end flight runs (CLI / smoke / bench hooks)
+# ---------------------------------------------------------------------------
+
+
+def _build_case(op: str, n: int, nb: int, mesh, rng):
+    """Operands + closures for one flight op on the shared mesh: returns
+    (flight_fn(depth, impl) -> result-to-verify, fused_fn(depth, impl),
+    verify(result) -> residual float, nt)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..parallel import from_dense, to_dense
+    from ..parallel.dist_chol import potrf_dist
+    from ..parallel.dist_lu import getrf_nopiv_dist
+    from ..parallel.dist_trsm import trsm_dist
+    from ..parallel.summa import gemm_summa
+    from ..types import MethodGemm, MethodTrsm, Op, Uplo
+
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    if op == "summa":
+        b = rng.standard_normal((n, n)).astype(np.float32)
+        ad = from_dense(jnp.asarray(a), mesh, nb)
+        bd = from_dense(jnp.asarray(b), mesh, nb)
+
+        def run(depth, impl):
+            return gemm_summa(1.0, ad, bd, method=MethodGemm.GemmC,
+                              lookahead=depth, bcast_impl=impl)
+
+        def verify(res):
+            got = np.asarray(to_dense(res))
+            ref = a @ b
+            return float(np.abs(got - ref).max() / (np.abs(ref).max() + 1e-30))
+
+        return run, verify, ad.nt
+    if op == "potrf":
+        spd = (a @ a.T / n + 2 * np.eye(n)).astype(np.float32)
+        sd = from_dense(jnp.asarray(spd), mesh, nb, diag_pad_one=True)
+
+        def run(depth, impl):
+            return potrf_dist(sd, lookahead=depth, bcast_impl=impl)
+
+        def verify(res):
+            l, info = res
+            if int(info) != 0:
+                return float("inf")
+            lt = np.tril(np.asarray(to_dense(l)))
+            return float(np.abs(lt @ lt.T - spd).max() / np.abs(spd).max())
+
+        return run, verify, sd.nt
+    if op == "getrf_nopiv":
+        dd = (np.tril(a) + n * np.eye(n)
+              + np.triu(rng.standard_normal((n, n)), 1)).astype(np.float32)
+        gd = from_dense(jnp.asarray(dd), mesh, nb, diag_pad_one=True)
+
+        def run(depth, impl):
+            return getrf_nopiv_dist(gd, lookahead=depth, bcast_impl=impl)
+
+        def verify(res):
+            lu, info = res
+            if int(info) != 0:
+                return float("inf")
+            lun = np.asarray(to_dense(lu))
+            rec_ = (np.tril(lun, -1) + np.eye(n)) @ np.triu(lun)
+            return float(np.abs(rec_ - dd).max() / np.abs(dd).max())
+
+        return run, verify, gd.nt
+    if op == "trsm":
+        tl = (np.tril(a) + n * np.eye(n)).astype(np.float32)
+        td = from_dense(jnp.asarray(tl), mesh, nb, diag_pad_one=True)
+        b = rng.standard_normal((n, n)).astype(np.float32)
+        bd = from_dense(jnp.asarray(b), mesh, nb)
+
+        def run(depth, impl):
+            return trsm_dist(td, bd, Uplo.Lower, Op.NoTrans,
+                             method=MethodTrsm.TrsmB, lookahead=depth,
+                             bcast_impl=impl)
+
+        def verify(res):
+            x = np.asarray(to_dense(res))
+            return float(np.abs(tl @ x - b).max()
+                         / (np.abs(tl).max() * max(np.abs(x).max(), 1e-30) * n))
+
+        return run, verify, td.nt
+    raise ValueError(f"unknown flight op {op!r}; expected one of {FLIGHT_OPS}")
+
+
+def run_flight(op: str, n: int = 96, nb: int = 8, depth: Optional[int] = None,
+               bcast_impl: Optional[str] = None, hops: bool = False,
+               mesh=None, seed: int = 0) -> dict:
+    """One complete flight: capture the static schedule model from the
+    fused kernel, run the op under step dispatch at the requested depth
+    (plus depth 0 for the overlap contrast, plus the psum lowering for
+    the ring-vs-psum hop-latency delta when ``hops``), analyze, and
+    return the FlightReport dict."""
+    import jax
+    import numpy as np
+
+    from ..parallel import make_mesh
+    from ..parallel.comm import la_depth, resolve_bcast_impl, sched_audit
+    from . import schedule
+    from .report import _env_info
+
+    if mesh is None:
+        devs = jax.devices("cpu")
+        if len(devs) < 8:
+            raise RuntimeError(
+                f"flight needs 8 CPU devices, have {len(devs)} — set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+        mesh = make_mesh(2, 4, devices=devs[:8])
+    from ..parallel.mesh import mesh_shape
+
+    p, q = mesh_shape(mesh)
+    rng = np.random.default_rng(seed)
+    run, verify, nt = _build_case(op, n, nb, mesh, rng)
+    d = la_depth(depth, nt)
+    if op in ("potrf", "lu"):
+        # the factor-loop pipelining (and its step driver) caps at depth
+        # 1 — record the depth that actually dispatched, not the request
+        d = min(d, 1)
+    impl = resolve_bcast_impl(bcast_impl)
+
+    # (b) static ScheduleModel: one trace of the FUSED kernel under the
+    # phase-tagged schedule audit (comm-audit machinery) — per-step wire
+    # bytes with phase attribution and per-hop src→dst pairs
+    with no_flight():
+        jax.clear_caches()
+        with sched_audit() as sched_recs:
+            run(d, impl)
+        model = schedule.ScheduleModel(op, nt, p, q, impl, list(sched_recs))
+
+    # (a) measured timeline: the step-dispatch run at the requested depth
+    with flight_scope() as rec:
+        res = run(d, impl)
+    resid = verify(res)
+    rows = schedule.rows_from_events(rec.events)
+    sched = schedule.analyze(rows, d)
+
+    # the overlap contrast: the strict depth-0 issue order
+    with flight_scope() as rec0:
+        run(0, impl)
+    sched0 = schedule.analyze(schedule.rows_from_events(rec0.events), 0)
+
+    if hops and impl != "psum":
+        with no_flight():
+            jax.clear_caches()
+            with sched_audit() as psum_recs:
+                run(d, "psum")
+        model_psum = schedule.ScheduleModel(op, nt, p, q, "psum",
+                                            list(psum_recs))
+        with flight_scope() as rec_psum:
+            run(d, "psum")
+        hop_lat = schedule.hop_latency(
+            rows, schedule.rows_from_events(rec_psum.events), model,
+            model_psum)
+        if hop_lat is not None:
+            sched["hop_latency_s"] = hop_lat
+
+    sched["overlap_eff_la0"] = sched0["overlap_eff"]
+    sched["exposed_comm_s_la0"] = sched0["exposed_comm_s"]
+    cal = schedule.calibrate(rows)
+    model_steps = model.steps(cal, flops_by_phase=schedule.phase_flops(rows))
+
+    base = min((e.t0 for e in rec.events), default=0.0)
+    events = [
+        {"op": e.op, "k": e.k, "phase": e.phase,
+         "device": list(e.device_coord), "t0_s": e.t0 - base,
+         "t1_s": e.t1 - base, "bytes": e.bytes, "flops": e.flops}
+        for e in rec.events
+    ]
+    hop_events = [
+        {"op": h["op"], "k": h["k"], "phase": h["phase"],
+         "root_k": h.get("root_k", h["k"]),
+         "t0_s": h["t0"] - base, "t1_s": h["t1"] - base, "hops": h["hops"]}
+        for h in rec.hop_events
+    ]
+
+    values = {
+        "sched.critical_path_s": sched["critical_path_s"],
+        "sched.overlap_eff": sched["overlap_eff"],
+        "sched.exposed_comm_s": sched["exposed_comm_s"],
+        "sched.total_comm_s": sched["total_comm_s"],
+        "sched.total_compute_s": sched["total_compute_s"],
+        "sched.model_bytes": model.total_bytes,
+        "sched.measured_bytes": sched["measured_bytes"],
+        "resid": resid,
+    }
+    for ph, nbytes in model.phase_bytes.items():
+        values[f"sched.model_{ph}_bytes"] = nbytes
+
+    return {
+        "schema": FLIGHT_SCHEMA,
+        "version": FLIGHT_VERSION,
+        "name": f"flight_{op}",
+        "created_unix": time.time(),
+        "env": _env_info(),
+        "config": {"op": op, "n": n, "nb": nb, "grid": f"{p}x{q}",
+                   "lookahead": d, "bcast_impl": impl, "nt": nt},
+        "events": events,
+        "hop_events": hop_events,
+        "model": {
+            "calibration": cal,
+            "phase_bytes": dict(model.phase_bytes),
+            "total_bytes": model.total_bytes,
+            "steps": model_steps,
+            # the model traces the FUSED kernel; potrf/lu bucket their
+            # trailing views there, while the step driver broadcasts
+            # full-height panels every step — so for the factor ops
+            # measured_bytes >= model bytes by the bucketing savings
+            # (structural, not a measurement anomaly; SUMMA is exact)
+            "note": ("fused-kernel schedule; step dispatch is unbucketed"
+                     if op in ("potrf", "lu") else "exact"),
+        },
+        "sched": sched,
+        "values": values,
+    }
+
+
+def validate_flight_report(rep) -> List[str]:
+    """Schema check for a FlightReport; returns problems (empty == valid)."""
+    errs: List[str] = []
+    if not isinstance(rep, dict):
+        return ["flight report must be an object"]
+    if rep.get("schema") != FLIGHT_SCHEMA:
+        errs.append(f"schema must be {FLIGHT_SCHEMA!r}, got {rep.get('schema')!r}")
+    if not isinstance(rep.get("version"), int):
+        errs.append("version must be an int")
+    if not isinstance(rep.get("name"), str) or not rep.get("name"):
+        errs.append("name must be a non-empty string")
+    cfg = rep.get("config")
+    if not isinstance(cfg, dict) or cfg.get("op") not in FLIGHT_OPS:
+        errs.append(f"config.op must be one of {FLIGHT_OPS}")
+    evs = rep.get("events")
+    if not isinstance(evs, list) or not evs:
+        errs.append("events must be a non-empty list")
+    else:
+        for i, e in enumerate(evs):
+            if not isinstance(e, dict):
+                errs.append(f"events[{i}]: not an object")
+                continue
+            if e.get("phase") not in PHASES:
+                errs.append(f"events[{i}]: bad phase {e.get('phase')!r}")
+            if not isinstance(e.get("k"), int) or e["k"] < 0:
+                errs.append(f"events[{i}]: bad k {e.get('k')!r}")
+            if not (isinstance(e.get("t0_s"), (int, float))
+                    and isinstance(e.get("t1_s"), (int, float))
+                    and e["t1_s"] >= e["t0_s"] >= 0):
+                errs.append(f"events[{i}]: bad t0_s/t1_s")
+            dev = e.get("device")
+            if not (isinstance(dev, (list, tuple)) and len(dev) == 2):
+                errs.append(f"events[{i}]: bad device {dev!r}")
+            if errs and len(errs) > 16:
+                break
+    sched = rep.get("sched")
+    if not isinstance(sched, dict):
+        errs.append("sched must be an object")
+    else:
+        for key in ("critical_path_s", "overlap_eff", "exposed_comm_s",
+                    "total_comm_s"):
+            if not isinstance(sched.get(key), (int, float)):
+                errs.append(f"sched.{key} must be a number")
+        ov = sched.get("overlap_eff")
+        if isinstance(ov, (int, float)) and not 0.0 <= ov <= 1.0:
+            errs.append(f"sched.overlap_eff out of [0, 1]: {ov}")
+    vals = rep.get("values")
+    if not isinstance(vals, dict) or any(
+        not isinstance(v, (int, float)) for v in vals.values()
+    ):
+        errs.append("values must map metric name -> number")
+    return errs
+
+
+def write_flight_report(path: str, rep: dict) -> str:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(rep, f, indent=1)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# CLI + CI smoke
+# ---------------------------------------------------------------------------
+
+
+def _smoke(out_dir: str) -> int:
+    """CI acceptance: tiny summa + potrf flights under psum and ring —
+    schema-valid FlightReports whose modeled bytes match a fresh
+    comm-audit capture, Perfetto export validates with per-device tracks
+    and hop flow events, and overlap_eff separates depth 1 from depth 0."""
+    from . import perfetto
+
+    os.makedirs(out_dir, exist_ok=True)
+    failures: List[str] = []
+    n, nb = 64, 8
+    for op in ("summa", "potrf"):
+        reports = {}
+        for impl in ("psum", "ring"):
+            rep = run_flight(op, n=n, nb=nb, depth=1, bcast_impl=impl,
+                             hops=(impl == "ring"))
+            errs = validate_flight_report(rep)
+            if errs:
+                failures.append(f"{op}/{impl} schema: {errs[:4]}")
+            if rep["sched"]["overlap_eff"] <= rep["sched"]["overlap_eff_la0"]:
+                failures.append(
+                    f"{op}/{impl}: overlap_eff {rep['sched']['overlap_eff']:.3f} "
+                    f"does not exceed the depth-0 value "
+                    f"{rep['sched']['overlap_eff_la0']:.3f}")
+            if rep["sched"]["overlap_eff_la0"] != 0.0:
+                failures.append(f"{op}/{impl}: depth-0 overlap_eff nonzero")
+            if rep["values"]["resid"] > 1e-3:
+                failures.append(f"{op}/{impl}: resid {rep['values']['resid']}")
+            reports[impl] = rep
+        # the engine's modeled bytes must be half psum's wire bytes is
+        # asserted analytically in tests/test_flight.py; here gate the
+        # cheap invariant: both lowerings modeled > 0 and ring != psum
+        if not (reports["psum"]["model"]["total_bytes"] > 0
+                and reports["ring"]["model"]["total_bytes"] > 0):
+            failures.append(f"{op}: modeled bytes not positive")
+        rep = reports["ring"]
+        path = os.path.join(out_dir, f"flight_{op}.flight.json")
+        write_flight_report(path, rep)
+        trace_path = os.path.join(out_dir, f"flight_{op}.trace.json")
+        tr = perfetto.flight_chrome_trace(rep["events"], rep["hop_events"],
+                                          grid=(2, 4))
+        with open(trace_path, "w") as f:
+            json.dump(tr, f, indent=1)
+        errs = perfetto.validate_chrome_trace(tr)
+        if errs:
+            failures.append(f"{op} trace schema: {errs[:4]}")
+        tids = {e.get("tid") for e in tr["traceEvents"] if e.get("ph") == "X"}
+        if len(tids) < 8:
+            failures.append(f"{op} trace has {len(tids)} device tracks (< 8)")
+        if not any(e.get("ph") == "s" for e in tr["traceEvents"]):
+            failures.append(f"{op} trace has no hop flow events")
+        print(f"obs.flight smoke: {op} ok — overlap_eff(la1)="
+              f"{rep['sched']['overlap_eff']:.3f} vs la0="
+              f"{rep['sched']['overlap_eff_la0']:.3f}, "
+              f"model {rep['model']['total_bytes']:,.0f} B -> {path}")
+    if failures:
+        print(f"obs.flight smoke: FAILED with {len(failures)} problem(s):")
+        for msg in failures:
+            print(f"  FAIL {msg}")
+        return 1
+    print(f"obs.flight smoke: OK — reports + traces in {out_dir}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m slate_tpu.obs.flight", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("op", nargs="?", choices=FLIGHT_OPS,
+                    help="mesh kernel to fly")
+    ap.add_argument("--n", type=int, default=96)
+    ap.add_argument("--nb", type=int, default=8)
+    ap.add_argument("--depth", type=int, default=None,
+                    help="lookahead depth (default: Option.Lookahead)")
+    ap.add_argument("--impl", default=None,
+                    help="bcast impl (psum|ring|doubling|auto)")
+    ap.add_argument("--hops", action="store_true",
+                    help="also run the psum lowering for per-hop ICI "
+                         "latency estimates")
+    ap.add_argument("--out", default=None, help="FlightReport path "
+                    "(default artifacts/obs/flight_<op>.flight.json; for "
+                    "--smoke: the artifact directory)")
+    ap.add_argument("--trace", default=None,
+                    help="also write a Perfetto Gantt (per-device tracks + "
+                         "hop flows)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI acceptance run (tiny summa + potrf under psum "
+                         "and ring)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        return _smoke(args.out or os.path.join("artifacts", "obs"))
+    if not args.op:
+        ap.error("give an op to fly or --smoke")
+
+    rep = run_flight(args.op, n=args.n, nb=args.nb, depth=args.depth,
+                     bcast_impl=args.impl, hops=args.hops)
+    errs = validate_flight_report(rep)
+    out = args.out or os.path.join("artifacts", "obs",
+                                   f"flight_{args.op}.flight.json")
+    write_flight_report(out, rep)
+    sched = rep["sched"]
+    print(f"flight {args.op}: {sched['steps']} steps, depth "
+          f"{rep['config']['lookahead']}, impl {rep['config']['bcast_impl']}")
+    print(f"  critical_path_s {sched['critical_path_s']:.4f}  overlap_eff "
+          f"{sched['overlap_eff']:.3f} (la0 {sched['overlap_eff_la0']:.3f})  "
+          f"exposed_comm_s {sched['exposed_comm_s']:.4f}")
+    print(f"  model bytes {rep['model']['total_bytes']:,.0f} "
+          f"({', '.join(f'{k}={v:,.0f}' for k, v in rep['model']['phase_bytes'].items())})")
+    if "hop_latency_s" in sched:
+        print(f"  est. per-hop latency {sched['hop_latency_s'] * 1e6:.1f} us")
+    print(f"  wrote {out}")
+    if args.trace:
+        from . import perfetto
+
+        tr = perfetto.flight_chrome_trace(
+            rep["events"], rep["hop_events"],
+            grid=tuple(int(x) for x in rep["config"]["grid"].split("x")))
+        with open(args.trace, "w") as f:
+            json.dump(tr, f, indent=1)
+        print(f"  wrote {args.trace}")
+    if errs:
+        print("validation problems:")
+        for e in errs:
+            print(f"  {e}")
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    # runpy loads this file as __main__, a SECOND module instance whose
+    # scope stack the kernels (which import slate_tpu.obs.flight) never
+    # see — delegate to the canonical instance so flight_scope activates
+    # the routing for real
+    from slate_tpu.obs import flight as _canonical
+
+    sys.exit(_canonical.main())

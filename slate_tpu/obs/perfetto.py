@@ -43,6 +43,7 @@ def chrome_trace_events(
     base = min((s["t0"] for s in spans), default=0.0)
     if legacy_events:
         legacy_events = list(legacy_events)
+    link_total = 0.0
     for s in spans:
         args = dict(s.get("tags", {}))
         args.update({k: v for k, v in s.get("metrics", {}).items()})
@@ -60,6 +61,47 @@ def chrome_trace_events(
                 "args": args,
             }
         )
+        # per-hop LINK byte records absorbed by the span (the comm-audit
+        # ppermute hop schedule, PR 5): one instant per pair with src→dst
+        # device args plus a running link-byte counter — instead of
+        # silently dropping them from traces.  bytes is the PAIR's share
+        # of the hop-set's LINK bytes; pairs_root0 flags in-loop
+        # broadcasts (traced owner) whose pairs are the root-0 schedule
+        # shape, not owner-resolved devices (the flight exporter rotates
+        # them; a span trace has no per-step owner to rotate by).
+        for hop in s.get("hops", ()):
+            pairs = hop.get("pairs", ())
+            per_pair = float(hop.get("bytes", 0)) / max(1, len(pairs))
+            root0 = hop.get("step") is None
+            for src, dst in pairs:
+                evs.append(
+                    {
+                        "name": hop.get("op", "ppermute"),
+                        "cat": "comm",
+                        "ph": "i",
+                        "s": "t",
+                        "pid": PID,
+                        "tid": 0,
+                        "ts": (s["t0"] - base) * _US,
+                        "args": {"src": src, "dst": dst,
+                                 "bytes": per_pair,
+                                 "mult": hop.get("mult", 1),
+                                 "pairs_root0": root0,
+                                 "span": s["name"]},
+                    }
+                )
+            link_total += float(hop.get("bytes", 0)) * hop.get("mult", 1)
+            evs.append(
+                {
+                    "name": "ppermute_link_bytes",
+                    "cat": "comm",
+                    "ph": "C",
+                    "pid": PID,
+                    "tid": 0,
+                    "ts": (s["t1"] - base) * _US,
+                    "args": {"bytes": link_total},
+                }
+            )
     # shift legacy events into the span timebase when their clock origin
     # is known (and spans exist to define that base)
     shift = (legacy_t0 - base) if (legacy_t0 is not None and spans) else 0.0
@@ -102,6 +144,95 @@ def write_chrome_trace(
     return path
 
 
+def flight_trace_events(events: Iterable[dict],
+                        hop_events: Optional[Iterable[dict]] = None,
+                        grid: Optional[tuple] = None) -> List[dict]:
+    """Per-device Gantt of a flight timeline (obs.flight): one track per
+    mesh coordinate, one complete event per fenced phase dispatch, and
+    flow arrows (``ph: s``/``f``) from the broadcast owner to each hop
+    destination for every recorded hop schedule.
+
+    ``events`` are FlightReport event rows ({op, k, phase, device,
+    t0_s, t1_s, bytes, flops}); ``hop_events`` the report's hop_events
+    ({op, k, root_k, phase, t0_s, t1_s, hops: [{op, bytes, pairs}]}).
+    Axis hop pairs are mesh-axis indices of the root-0 schedule; they are
+    rotated by the step's logical broadcast owner (root_k mod axis size —
+    root_k == k except for backward solves) and fanned across the OTHER
+    axis, so the arrows show the true source→destination devices."""
+    events = list(events)
+    p, q = grid if grid is not None else (
+        1 + max((e["device"][0] for e in events), default=0),
+        1 + max((e["device"][1] for e in events), default=0),
+    )
+
+    def tid(r, c):
+        return 200 + r * q + c
+
+    evs: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": PID, "tid": 0,
+         "args": {"name": "slate_tpu.flight"}},
+    ]
+    for r in range(p):
+        for c in range(q):
+            evs.append(
+                {"name": "thread_name", "ph": "M", "pid": PID,
+                 "tid": tid(r, c), "args": {"name": f"mesh({r},{c})"}}
+            )
+    for e in events:
+        r, c = e["device"]
+        evs.append(
+            {
+                "name": f"{e['phase']} k={e['k']}",
+                "cat": "flight",
+                "ph": "X",
+                "pid": PID,
+                "tid": tid(int(r), int(c)),
+                "ts": e["t0_s"] * _US,
+                "dur": max(0.0, (e["t1_s"] - e["t0_s"]) * _US),
+                "args": {"op": e["op"], "k": e["k"], "phase": e["phase"],
+                         "bytes": e.get("bytes", 0),
+                         "flops": e.get("flops", 0)},
+            }
+        )
+    flow_id = 0
+    for he in hop_events or ():
+        ts = he["t0_s"] * _US
+        te = max(ts, he["t1_s"] * _US)
+        for hop in he.get("hops", ()):
+            axis = "p" if "[p]" in hop.get("op", "") else "q"
+            size = p if axis == "p" else q
+            # rotate the root-0 hop schedule by the step's logical
+            # broadcast owner (root_k != k only for backward solves)
+            rot = he.get("root_k", he["k"]) % size
+            for src, dst in hop.get("pairs", ()):
+                s_ax, d_ax = (src + rot) % size, (dst + rot) % size
+                # fan the axis hop across the other mesh axis (every
+                # row/col runs the same rooted schedule)
+                other = range(q) if axis == "p" else range(p)
+                for o in other:
+                    s_rc = (s_ax, o) if axis == "p" else (o, s_ax)
+                    d_rc = (d_ax, o) if axis == "p" else (o, d_ax)
+                    flow_id += 1
+                    common = {"cat": "comm", "name": hop.get("op", "hop"),
+                              "pid": PID, "id": flow_id}
+                    evs.append(dict(common, ph="s", tid=tid(*s_rc), ts=ts,
+                                    args={"src": list(s_rc),
+                                          "dst": list(d_rc),
+                                          "bytes": hop.get("bytes", 0),
+                                          "k": he["k"]}))
+                    evs.append(dict(common, ph="f", bp="e", tid=tid(*d_rc),
+                                    ts=te, args={}))
+    return evs
+
+
+def flight_chrome_trace(events, hop_events=None, grid=None) -> dict:
+    return {
+        "traceEvents": flight_trace_events(events, hop_events, grid),
+        "displayTimeUnit": "ms",
+        "otherData": {"producer": "slate_tpu.obs.flight"},
+    }
+
+
 def validate_chrome_trace(obj) -> List[str]:
     """Schema check for the subset of the trace-event format we emit
     (and that Perfetto requires to load).  Returns a list of problems —
@@ -120,12 +251,14 @@ def validate_chrome_trace(obj) -> List[str]:
         if not isinstance(e.get("name"), str) or not e.get("name"):
             errs.append(f"{where}: missing name")
         ph = e.get("ph")
-        if ph not in ("X", "B", "E", "M", "i", "C"):
+        if ph not in ("X", "B", "E", "M", "i", "C", "s", "f", "t"):
             errs.append(f"{where}: bad ph {ph!r}")
-        if ph in ("X", "B", "E"):
+        if ph in ("X", "B", "E", "s", "f", "t"):
             ts = e.get("ts")
             if not isinstance(ts, (int, float)) or ts < 0:
                 errs.append(f"{where}: bad ts {ts!r}")
+        if ph in ("s", "f", "t") and not isinstance(e.get("id"), (int, str)):
+            errs.append(f"{where}: flow event missing id")
         if ph == "X":
             dur = e.get("dur")
             if not isinstance(dur, (int, float)) or dur < 0:

@@ -31,9 +31,19 @@ SCHEMA = "slate_tpu.obs.run_report"
 VERSION = 1
 
 # substrings marking a metric as lower-is-better; everything else
-# (gflops, gops, value, mfu, ...) is treated as higher-is-better
+# (gflops, gops, value, mfu, overlap_eff, ...) is treated as
+# higher-is-better.  "critical_path" / "exposed" / "comm_s" / "wall_s"
+# cover the flight recorder's sched.* timing keys (ISSUE 7).
 _LOWER_BETTER = ("second", "time", "byte", "error", "err", "resid", "latency",
-                 "uncorrectable")
+                 "uncorrectable", "critical_path", "exposed", "comm_s",
+                 "wall_s", "compute_s")
+
+# metric-name prefixes that form versioned report SECTIONS: when the new
+# report carries them and the old artifact predates the section entirely
+# (e.g. sched.* against a pre-flight report, ft_* against a pre-PR-4
+# BENCH_*.json), --check reports each key as inconclusive instead of
+# silently ignoring it or failing the whole check
+_SECTION_PREFIXES = ("sched.", "ft_")
 
 # pure cost-model estimates with no better/worse direction: halving the
 # XLA flop estimate is usually an optimization, doubling may be a bigger
@@ -157,6 +167,11 @@ def load_values(doc: dict, include_series: bool = False) -> Dict[str, float]:
     comparison on request (``include_series=True`` / ``--all-metrics``),
     for same-config run pairs."""
     vals: Dict[str, float] = {}
+    if doc.get("schema") == "slate_tpu.obs.flight_report":
+        # FlightReports (obs.flight) carry a ready-made flat values
+        # section (sched.* + modeled bytes); gate it directly
+        return {k: float(v) for k, v in (doc.get("values") or {}).items()
+                if isinstance(v, (int, float))}
     if doc.get("schema") == SCHEMA:
         vals.update(doc.get("values", {}))
         # ft.* outcome totals gate like any metric: under a fixed fault
@@ -210,6 +225,20 @@ def load_values(doc: dict, include_series: bool = False) -> Dict[str, float]:
 def lower_is_better(name: str) -> bool:
     low = name.lower()
     return any(tok in low for tok in _LOWER_BETTER)
+
+
+def inconclusive_keys(
+    new_vals: Dict[str, float], old_vals: Dict[str, float]
+) -> List[str]:
+    """Sectioned metrics (``sched.*`` / ``ft_*``) present only in the NEW
+    report: the old artifact predates that metrics section, so the keys
+    are per-key INCONCLUSIVE — neither passed nor regressed (the
+    mixed-schema case: a flight report against a pre-flight RunReport, an
+    ft-carrying report against a pre-PR-4 BENCH_*.json)."""
+    return sorted(
+        k for k in new_vals
+        if k not in old_vals and k.startswith(_SECTION_PREFIXES)
+    )
 
 
 def check_regression(
@@ -302,6 +331,13 @@ def main(argv=None) -> int:
                     help="gate the flattened counter/histogram series too "
                          "(only meaningful for same-config run pairs; the "
                          "default gates the headline values only)")
+    ap.add_argument("--ignore", action="append", default=[],
+                    metavar="GLOB",
+                    help="metric-name glob to exclude from --check "
+                         "(repeatable); e.g. 'sched.*_s' keeps a flight "
+                         "gate on the deterministic byte/count keys while "
+                         "skipping millisecond wall-clock keys a slower "
+                         "CI machine would flake")
     args = ap.parse_args(argv)
 
     if args.check:
@@ -321,21 +357,45 @@ def main(argv=None) -> int:
                 for e in errs:
                     print(f"  {e}")
                 return 2
+        elif new_doc.get("schema") == "slate_tpu.obs.flight_report":
+            from .flight import validate_flight_report
+
+            errs = validate_flight_report(new_doc)
+            if errs:
+                print(f"obs.report: {new_path} is not a valid FlightReport:")
+                for e in errs:
+                    print(f"  {e}")
+                return 2
         if (new_doc.get("schema") == SCHEMA == old_doc.get("schema")
                 and new_doc.get("config") != old_doc.get("config")):
             print(f"obs.report: note — configs differ "
                   f"({new_doc.get('config')} vs {old_doc.get('config')}); "
                   "only matching metric names are compared")
         try:
+            new_vals = load_values(new_doc, args.all_metrics)
+            old_vals = load_values(old_doc, args.all_metrics)
+            if args.ignore:
+                import fnmatch
+
+                def _keep(vals):
+                    return {k: v for k, v in vals.items()
+                            if not any(fnmatch.fnmatch(k, g)
+                                       for g in args.ignore)}
+
+                new_vals, old_vals = _keep(new_vals), _keep(old_vals)
             failures, compared = check_regression(
-                load_values(new_doc, args.all_metrics),
-                load_values(old_doc, args.all_metrics), args.threshold
+                new_vals, old_vals, args.threshold
             )
         except ValueError as e:
             # an unrecognized/timed-out artifact is INCONCLUSIVE (2), not
             # a regression (1)
             print(f"obs.report: {e}")
             return 2
+        # sectioned metrics the old artifact predates: per-key
+        # inconclusive, never a failure of the whole check
+        for key in inconclusive_keys(new_vals, old_vals):
+            print(f"  INCONCLUSIVE {key} = {new_vals[key]:.6g} — section "
+                  "absent from the old artifact")
         if compared == 0:
             print("obs.report: no shared metrics to compare")
             return 2

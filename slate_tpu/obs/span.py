@@ -178,15 +178,20 @@ def driver_span(name: str, **tags):
     # capture audited collectives traced inside this span; propagate=True
     # re-appends the records outward on exit so enclosing audits
     # (slate_lint's, the comm-volume tool's, an outer span's) still see
-    # every byte
+    # every byte.  The schedule channel rides along for the per-hop
+    # ppermute LINK records (src→dst pairs) the Perfetto exporter turns
+    # into hop events instead of dropping.
     audit_cm = comm.comm_audit(propagate=True)
     records = audit_cm.__enter__()
+    sched_cm = comm.sched_audit(propagate=True)
+    sched_records = sched_cm.__enter__()
 
     span.t0 = time.perf_counter()
     try:
         yield span
     finally:
         span.t1 = time.perf_counter()
+        sched_cm.__exit__(None, None, None)
         audit_cm.__exit__(None, None, None)
         if ann is not None:
             try:
@@ -204,6 +209,17 @@ def driver_span(name: str, **tags):
             REGISTRY.counter_add("comm_bytes", nbytes, span=name, op=op)
             total_comm += nbytes
         span.metrics["comm_bytes"] = total_comm
+        # per-hop LINK records (ppermute pairs) for the Perfetto
+        # exporter's hop events; bounded per span
+        # step None marks an in-loop broadcast whose owner was a tracer:
+        # its pairs are the root-0 hop schedule, not owner-resolved
+        # devices (concrete prologue steps carry the true rotated pairs)
+        hops = [
+            {"op": op, "bytes": float(nbytes), "mult": mult, "step": st,
+             "pairs": pairs}
+            for op, nbytes, mult, _ph, st, pairs in sched_records
+            if pairs
+        ][:64]
         with _finished_lock:
             if len(FINISHED) < _EVENT_CAP:
                 FINISHED.append(
@@ -215,6 +231,7 @@ def driver_span(name: str, **tags):
                         "depth": span.depth,
                         "parent": span.parent,
                         "metrics": dict(span.metrics),
+                        "hops": hops,
                     }
                 )
 

@@ -100,6 +100,63 @@ BUCKETS = 4
 _AUDIT: Optional[list] = None
 _AUDIT_MULT = [1]
 
+# Schedule-capture channel (obs.flight / obs.schedule): a SECOND audit
+# stream whose records additionally carry the issuing loop phase, the
+# issue step (a Python int for unrolled prologue/drain code, None inside
+# a fori_loop body where k is a tracer), and — for ppermute hops — the
+# (src, dst) pair list of the hop.  Kept separate from ``_AUDIT`` so the
+# (op, nbytes, mult) tuple every existing consumer parses never changes
+# shape.  Like the primary audit it records at TRACE time only.
+_SCHED: Optional[list] = None
+_PHASE_CTX = [(None, None)]  # (phase, step) during kernel tracing
+
+
+@contextlib.contextmanager
+def sched_audit(propagate: bool = False):
+    """Yield a list that fills with (op, payload_bytes, multiplicity,
+    phase, step, pairs) records for every audited collective traced while
+    active — the phase/step tags come from the ``phase_scope`` markers
+    the pipelined loop helpers place around their fetch/panel/update
+    callbacks, so one trace of a mesh kernel yields a per-phase
+    communication schedule (the obs.schedule.ScheduleModel substrate).
+    Same re-trace contract as ``comm_audit``: a jit cache hit records
+    nothing.  ``propagate=True`` re-appends the captured records to the
+    enclosing schedule audit on exit (obs.driver_span's hop absorption
+    observes without stealing)."""
+    global _SCHED
+    old, _SCHED = _SCHED, []
+    try:
+        yield _SCHED
+    finally:
+        records, _SCHED = _SCHED, old
+        if propagate and old is not None:
+            old.extend(records)
+
+
+@contextlib.contextmanager
+def phase_scope(phase: str, step=None):
+    """Tag collectives traced inside as belonging to loop phase ``phase``
+    of step ``step`` (``panel`` / ``bcast`` / ``bulk``).  Pure trace-time
+    bookkeeping: no jaxpr change, ever — kernels stay trace-identical
+    whether or not a schedule capture is listening."""
+    _PHASE_CTX.append((phase, _step_id(step)))
+    try:
+        yield
+    finally:
+        _PHASE_CTX.pop()
+
+
+def _step_id(k):
+    """``k`` as a Python int when concrete (prologue/drain unrolled
+    steps), None when it is a loop tracer."""
+    if k is None:
+        return None
+    try:
+        return int(k)
+    except (TypeError, jax.errors.TracerIntegerConversionError,
+            jax.errors.ConcretizationTypeError):
+        return None
+
 
 @contextlib.contextmanager
 def comm_audit(propagate: bool = False):
@@ -136,6 +193,11 @@ def audit_scope(mult):
 def _rec(op: str, x: jax.Array) -> None:
     if _AUDIT is not None:
         _AUDIT.append((op, int(x.size) * x.dtype.itemsize, _AUDIT_MULT[-1]))
+    if _SCHED is not None:
+        ph, st = _PHASE_CTX[-1]
+        _SCHED.append(
+            (op, int(x.size) * x.dtype.itemsize, _AUDIT_MULT[-1], ph, st, None)
+        )
 
 
 def psum_a(x: jax.Array, axis: str) -> jax.Array:
@@ -163,14 +225,22 @@ def ppermute_a(x: jax.Array, axis_name: str, perm) -> jax.Array:
     sends from the listed sources, so per-hop link bytes (not payload
     shape) is the honest wire unit.  ``obs.comm_audit.summarize`` divides
     by the axis size to recover per-device received bytes."""
-    _rec_hop(f"ppermute[{axis_name}]", x, len(perm))
+    _rec_hop(f"ppermute[{axis_name}]", x, len(perm), perm)
     return lax.ppermute(x, axis_name, perm)
 
 
-def _rec_hop(op: str, x: jax.Array, npairs: int) -> None:
-    if _AUDIT is not None and npairs > 0:
+def _rec_hop(op: str, x: jax.Array, npairs: int, perm=None) -> None:
+    if npairs <= 0:
+        return
+    if _AUDIT is not None:
         _AUDIT.append(
             (op, int(x.size) * x.dtype.itemsize * npairs, _AUDIT_MULT[-1])
+        )
+    if _SCHED is not None:
+        ph, st = _PHASE_CTX[-1]
+        _SCHED.append(
+            (op, int(x.size) * x.dtype.itemsize * npairs, _AUDIT_MULT[-1],
+             ph, st, list(perm) if perm is not None else None)
         )
 
 
@@ -294,10 +364,13 @@ def _rooted_dispatch(x, owner, axis, size, impl, branch):
     """Shared tail of the rooted verbs: audit one hop-set for the whole
     schedule (recording inside every switch branch would overcount by the
     branch count), then dispatch — directly for a concrete owner, through
-    one lax.switch over the static roots for a traced one."""
-    for perm in _bcast_hops(impl, size, 0):
-        _rec_hop(f"ppermute[{axis}]", x, len(perm))
+    one lax.switch over the static roots for a traced one.  The audited
+    hop pairs are the concrete owner's schedule when known, the root-0
+    schedule otherwise (the hop structure is root-independent; a traced
+    owner rotates the same pairs)."""
     root = _concrete_root(owner, size)
+    for perm in _bcast_hops(impl, size, root if root is not None else 0):
+        _rec_hop(f"ppermute[{axis}]", x, len(perm), perm)
     if root is not None:
         return branch(root)(x)
     return lax.switch(owner, [branch(o) for o in range(size)], x)
@@ -527,22 +600,31 @@ def prefetch_bcast(nt: int, depth: int, fetch, consume, state):
     d = max(0, min(int(depth), int(nt)))
     if d == 0:
         def body(k, st):
-            return consume(k, fetch(k), st)
+            with phase_scope("bcast", k):
+                panel = fetch(k)
+            with phase_scope("bulk", k):
+                return consume(k, panel, st)
 
         with audit_scope(nt):
             return lax.fori_loop(0, nt, body, state)
 
     # prologue: fill the FIFO with panels 0..d-1 (each audited once)
-    buf = jax.tree.map(lambda *xs: jnp.stack(xs), *[fetch(k) for k in range(d)])
+    def _pro(k):
+        with phase_scope("bcast", k):
+            return fetch(k)
+
+    buf = jax.tree.map(lambda *xs: jnp.stack(xs), *[_pro(k) for k in range(d)])
 
     def body(k, carry):
         st, fifo = carry
         head = jax.tree.map(lambda b: b[0], fifo)
-        nxt = fetch(k + d)  # issued before the update consumes the head
+        with phase_scope("bcast", k):
+            nxt = fetch(k + d)  # issued before the update consumes the head
         fifo = jax.tree.map(
             lambda b, nx: jnp.concatenate([b[1:], nx[None]]), fifo, nxt
         )
-        st = consume(k, head, st)
+        with phase_scope("bulk", k):
+            st = consume(k, head, st)
         return st, fifo
 
     with audit_scope(nt - d):
@@ -577,22 +659,28 @@ def pipelined_factor_loop(k0, k1, depth, panel, narrow, bulk, state, zero_payloa
         return state
     if int(depth) <= 0:
         def body(k, st):
-            st, pl = panel(k, st)
-            return bulk(None, st, pl)
+            with phase_scope("panel", k):
+                st, pl = panel(k, st)
+            with phase_scope("bulk", k):
+                return bulk(None, st, pl)
 
         with audit_scope(n):
             return lax.fori_loop(k0, k1, body, state)
 
     def body(k, carry):
         st, pl = carry
-        st = narrow(k, st, pl)
-        st, pl_new = panel(k, st)
-        st = bulk(k, st, pl)
+        with phase_scope("bulk", k):
+            st = narrow(k, st, pl)
+        with phase_scope("panel", k):
+            st, pl_new = panel(k, st)
+        with phase_scope("bulk", k):
+            st = bulk(k, st, pl)
         return st, pl_new
 
     with audit_scope(n):
         state, pl_last = lax.fori_loop(k0, k1, body, (state, zero_payload))
-    return bulk(None, state, pl_last)
+    with phase_scope("bulk", k1 - 1):
+        return bulk(None, state, pl_last)
 
 
 def bucket_plan(nt: int, p: int, q: int, nbuckets: int = BUCKETS):

@@ -60,6 +60,7 @@ from .comm import (
     bucket_plan,
     la_depth,
     local_indices,
+    phase_scope,
     pipelined_factor_loop,
     resolve_bcast_impl,
     shard_map_compat,
@@ -89,10 +90,19 @@ def potrf_dist(
     if a.mt != a.nt:
         raise ValueError("potrf_dist needs a square tile grid")
     a.require_diag_pad("potrf_dist")
-    lt, info = _potrf_jit(
-        a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
-        resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
-    )
+    from ..obs import flight as _flight
+
+    if _flight.step_dispatch_active():
+        # flight-recorder step dispatch: same arithmetic, fenced per phase
+        lt, info = _flight.potrf_steps(
+            a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
+            resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
+        )
+    else:
+        lt, info = _potrf_jit(
+            a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
+            resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
+        )
     return DistMatrix(
         tiles=lt, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True
     ), info
@@ -124,6 +134,95 @@ def _chol_panel_factor_solve(dtile, pcol, cplx):
     return lkk, solved
 
 
+def _chol_panel_compute(view, k, p, q, i_log, c, cplx, roff=0, coff=0):
+    """Compute half of the right-looking step-k panel phase: diag-tile
+    broadcast + factor + panel-column tile solves + write-back.  Reads
+    only column slot k // q - coff (refreshed by ``_chol_narrow`` when
+    the update is deferred).  The factor + solve pair dispatches by
+    Option.PanelImpl (_chol_panel_factor_solve).  Returns (view,
+    pan_own): the owner-masked solved panel column (zeros off the owning
+    mesh column), ready for the broadcast half."""
+    nb = view.shape[2]
+    kc = k // q - coff
+    dtile = bcast_diag_tile(view, k, p, q, nb, roff, coff)
+    pcol = lax.dynamic_slice_in_dim(view, kc, 1, axis=1)[:, 0]
+    lkk, solved = _chol_panel_factor_solve(dtile, pcol, cplx)
+    below = (i_log > k)[:, None, None]
+    on_diag = (i_log == k)[:, None, None]
+    newcol = jnp.where(below, solved, jnp.where(on_diag, lkk, pcol))
+    mine = (c == k % q)
+    view = lax.dynamic_update_slice_in_dim(
+        view, jnp.where(mine, newcol, pcol)[:, None], kc, axis=1
+    )
+    return view, jnp.where(below & mine, newcol, 0)
+
+
+def _chol_panel_bcast(pan_own, k, p, q, j_log, roff=0):
+    """Broadcast half of the panel phase: one rooted broadcast along the
+    column axis plus the transposed gather the herk needs (all_gather
+    over 'p' + cyclic index map — the reference's transposed bcast list,
+    potrf.cc:129-133).  Returns the (pan, panT) update payload."""
+    pan = bcast_from_col(pan_own, k % q)
+    allpan = all_gather_a(pan, ROW_AXIS, axis=0)
+    # logical row j sits at local slot j // p - roff of its owner mesh
+    # row j % p; columns below the view's row cut (slot < 0 would wrap)
+    # are finished (j <= k) and zero
+    slot = j_log // p - roff
+    panT = allpan[j_log % p, jnp.maximum(slot, 0)]
+    panT = jnp.where((slot >= 0)[:, None, None], panT, 0)
+    return pan, panT
+
+
+def _chol_narrow(view, payload, k, q, lower, cplx, coff=0):
+    """Apply the deferred step-(k-1) herk to the one local column slot
+    the step-k panel phase reads — same per-element products as the full
+    einsum, sliced to a single j.  ``lower`` is the trailing-view lower-
+    triangle tile mask (i_log >= j_log)."""
+    pan_p, panT_p = payload
+    kc = k // q - coff
+    pT = lax.dynamic_slice_in_dim(panT_p, kc, 1, axis=0)
+    upd = jnp.einsum(
+        "iab,jcb->ijac", pan_p, jnp.conj(pT) if cplx else pT,
+        precision=PRECISE,
+    ).astype(view.dtype)
+    lcol = lax.dynamic_slice_in_dim(lower, kc, 1, axis=1)
+    colv = lax.dynamic_slice_in_dim(view, kc, 1, axis=1)
+    return lax.dynamic_update_slice_in_dim(
+        view, colv - jnp.where(lcol, upd, 0), kc, axis=1
+    )
+
+
+def _chol_info_dist(t_loc, i_log, j_log, nt, nb):
+    """info: 1 + global index of first bad pivot (potrf.cc:253-256), 0 if
+    ok.  Granularity caveat: XLA's cholesky NaN-fills the whole failing
+    tile, so on failure info points at the failing *tile*'s first bad
+    diagonal entry (a lower bound within nb of the exact LAPACK index)."""
+    diag_tiles = (i_log[:, None] == j_log[None, :])[:, :, None]
+    dvals = jnp.einsum("ijaa->ija", jnp.real(t_loc))
+    bad = (~jnp.isfinite(dvals) | (dvals <= 0)) & diag_tiles
+    gidx = i_log[:, None, None] * nb + jnp.arange(nb)[None, None, :] + 1
+    big = nt * nb + 1
+    local_info = jnp.min(jnp.where(bad, gidx, big))
+    info = lax.pmin(lax.pmin(local_info, ROW_AXIS), COL_AXIS)
+    return jnp.where(info >= big, 0, info).astype(jnp.int32)
+
+
+def _chol_bulk(view, payload, lower, cplx, excl_kc=None):
+    """The trailing herk.  ``excl_kc`` None: the strict/drain full
+    update; otherwise exclude the column slot ``_chol_narrow`` already
+    refreshed."""
+    pan_p, panT_p = payload
+    upd = jnp.einsum(
+        "iab,jcb->ijac", pan_p, jnp.conj(panT_p) if cplx else panT_p,
+        precision=PRECISE,
+    ).astype(view.dtype)
+    mask = lower
+    if excl_kc is not None:
+        ntl_v = lower.shape[1]
+        mask = mask & (jnp.arange(ntl_v) != excl_kc)[None, :, None, None]
+    return view - jnp.where(mask, upd, 0)
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
 def _potrf_jit(at, mesh, p, q, nt, la, bi, pi):
     spec = P(ROW_AXIS, COL_AXIS)
@@ -135,73 +234,32 @@ def _potrf_jit(at, mesh, p, q, nt, la, bi, pi):
         r, c, _, _ = local_indices(p, q, mtl, ntl)
 
         def phases_on(i_log, j_log, roff, coff):
-            """Panel / narrow / bulk phases of one right-looking step,
-            restricted to a trailing view whose local tile (0, 0) is
-            logical tile (i_log[0], j_log[0]) — the carry triple
+            """Panel / narrow / bulk phases of one right-looking step
+            (the module-level ``_chol_*`` helpers, shared with the
+            obs.flight step-dispatch drivers), restricted to a trailing
+            view whose local tile (0, 0) is logical tile
+            (i_log[0], j_log[0]) — the carry triple
             ``comm.pipelined_factor_loop`` schedules."""
             lower = (i_log[:, None] >= j_log[None, :])[:, :, None, None]
-            ntl_v = j_log.shape[0]
 
             def panel(k, view):
-                """Diag factor + panel trsm + panel broadcasts of step k.
-                Reads only column slot k // q - coff (refreshed by
-                ``narrow`` when the update is deferred).  The factor +
-                solve pair dispatches by Option.PanelImpl
-                (_chol_panel_factor_solve)."""
-                kc = k // q - coff
-                dtile = bcast_diag_tile(view, k, p, q, nb, roff, coff)
-                pcol = lax.dynamic_slice_in_dim(view, kc, 1, axis=1)[:, 0]
-                lkk, solved = _chol_panel_factor_solve(dtile, pcol, cplx)
-                below = (i_log > k)[:, None, None]
-                on_diag = (i_log == k)[:, None, None]
-                newcol = jnp.where(below, solved, jnp.where(on_diag, lkk, pcol))
-                mine = (c == k % q)
-                view = lax.dynamic_update_slice_in_dim(
-                    view, jnp.where(mine, newcol, pcol)[:, None], kc, axis=1
+                view, pan_own = _chol_panel_compute(
+                    view, k, p, q, i_log, c, cplx, roff, coff
                 )
-                pan = bcast_from_col(jnp.where(below & mine, newcol, 0), k % q)
-
-                allpan = all_gather_a(pan, ROW_AXIS, axis=0)
-                # logical row j sits at local slot j // p - roff of its
-                # owner mesh row j % p; columns below the view's row cut
-                # (slot < 0 would wrap) are finished (j <= k) and zero
-                slot = j_log // p - roff
-                panT = allpan[j_log % p, jnp.maximum(slot, 0)]
-                panT = jnp.where((slot >= 0)[:, None, None], panT, 0)
-                return view, (pan, panT)
+                # tag the broadcast half for the obs.schedule capture
+                # (trace-time bookkeeping only; no jaxpr change)
+                with phase_scope("bcast", k):
+                    return view, _chol_panel_bcast(
+                        pan_own, k, p, q, j_log, roff
+                    )
 
             def narrow(k, view, payload):
-                """Apply the deferred step-(k-1) herk to the one local
-                column slot panel(k) reads — same per-element products
-                as the full einsum, sliced to a single j."""
-                pan_p, panT_p = payload
-                kc = k // q - coff
-                pT = lax.dynamic_slice_in_dim(panT_p, kc, 1, axis=0)
-                upd = jnp.einsum(
-                    "iab,jcb->ijac", pan_p, jnp.conj(pT) if cplx else pT,
-                    precision=PRECISE,
-                ).astype(dtype)
-                lcol = lax.dynamic_slice_in_dim(lower, kc, 1, axis=1)
-                colv = lax.dynamic_slice_in_dim(view, kc, 1, axis=1)
-                return lax.dynamic_update_slice_in_dim(
-                    view, colv - jnp.where(lcol, upd, 0), kc, axis=1
-                )
+                return _chol_narrow(view, payload, k, q, lower, cplx, coff)
 
             def bulk(k, view, payload):
-                """The trailing herk.  k = None: the strict/drain full
-                update; otherwise exclude the column slot narrow(k)
-                already refreshed."""
-                pan_p, panT_p = payload
-                upd = jnp.einsum(
-                    "iab,jcb->ijac", pan_p,
-                    jnp.conj(panT_p) if cplx else panT_p,
-                    precision=PRECISE,
-                ).astype(dtype)
-                mask = lower
-                if k is not None:
-                    kc = k // q - coff
-                    mask = mask & (jnp.arange(ntl_v) != kc)[None, :, None, None]
-                return view - jnp.where(mask, upd, 0)
+                if k is None:
+                    return _chol_bulk(view, payload, lower, cplx)
+                return _chol_bulk(view, payload, lower, cplx, k // q - coff)
 
             return panel, narrow, bulk
 
@@ -230,18 +288,7 @@ def _potrf_jit(at, mesh, p, q, nt, la, bi, pi):
             t_loc = t_loc.at[s0r:, s0c:].set(view)
 
         _, _, i_log, j_log = local_indices(p, q, mtl, ntl)
-        # info: 1 + global index of first bad pivot (potrf.cc:253-256), 0 if
-        # ok.  Granularity caveat: XLA's cholesky NaN-fills the whole failing
-        # tile, so on failure info points at the failing *tile*'s first bad
-        # diagonal entry (a lower bound within nb of the exact LAPACK index).
-        diag_tiles = (i_log[:, None] == j_log[None, :])[:, :, None]
-        dvals = jnp.einsum("ijaa->ija", jnp.real(t_loc))
-        bad = (~jnp.isfinite(dvals) | (dvals <= 0)) & diag_tiles
-        gidx = i_log[:, None, None] * nb + jnp.arange(nb)[None, None, :] + 1
-        big = nt * nb + 1
-        local_info = jnp.min(jnp.where(bad, gidx, big))
-        info = lax.pmin(lax.pmin(local_info, ROW_AXIS), COL_AXIS)
-        info = jnp.where(info >= big, 0, info).astype(jnp.int32)
+        info = _chol_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, info[None, None]
 
     with bcast_impl_scope(bi), panel_impl_scope(pi):

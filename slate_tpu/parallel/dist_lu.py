@@ -62,6 +62,7 @@ from .comm import (
     bucket_plan,
     la_depth,
     local_indices,
+    phase_scope,
     pipelined_factor_loop,
     psum_a,
     resolve_bcast_impl,
@@ -90,10 +91,19 @@ def getrf_nopiv_dist(
     if a.mt != a.nt:
         raise ValueError("getrf_nopiv_dist needs a square tile grid")
     a.require_diag_pad("getrf_nopiv_dist")
-    lut, info = _lu_jit(
-        a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
-        resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
-    )
+    from ..obs import flight as _flight
+
+    if _flight.step_dispatch_active():
+        # flight-recorder step dispatch: same arithmetic, fenced per phase
+        lut, info = _flight.lu_steps(
+            a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
+            resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
+        )
+    else:
+        lut, info = _lu_jit(
+            a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
+            resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
+        )
     return DistMatrix(
         tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True
     ), info
@@ -136,18 +146,16 @@ def _lu_panel_rowsolve(luk, prow, eye):
     )
 
 
-def _nopiv_panel(t_loc, k, p, q, i_log, j_log, r, c, roff=0, coff=0,
-                 panel_done=False):
-    """Panel phase of one right-looking LU tile step (diag factor + panel
-    solves + bcasts), shared by the no-pivot / tournament / partial-pivot
-    kernels; the trailing gemm is NOT applied — the (pan, urow) payload is
-    returned for the caller to schedule (immediately for the strict
-    schedule, deferred one step under lookahead).  ``roff``/``coff`` shift
-    tile indexing when ``t_loc`` is a trailing view (bucketed caller).
-    ``panel_done`` skips the diag-tile factor + column solve: the
-    partial-pivot kernel factors the whole panel column itself
-    (internal_getrf.cc's role), leaving only the row solve here.  Reads
-    only the logical row/column k tile slots."""
+def _nopiv_panel_compute(t_loc, k, p, q, i_log, j_log, r, c, roff=0,
+                         coff=0, panel_done=False):
+    """Compute half of the step-k LU panel phase: diag factor + panel
+    column/row tile solves + write-back, NO broadcasts.  Returns (t_loc,
+    (pan_own, urow_own)) — the owner-masked solved panel column and row
+    (zeros off the owning mesh column/row), ready for
+    ``_nopiv_panel_bcast``.  ``panel_done`` skips the diag-tile factor +
+    column solve: the partial-pivot kernel factors the whole panel
+    column itself (internal_getrf.cc's role), leaving only the row solve
+    here.  Reads only the logical row/column k tile slots."""
     nb = t_loc.shape[2]
     dtype = t_loc.dtype
     eye = jnp.eye(nb, dtype=dtype)
@@ -180,11 +188,40 @@ def _nopiv_panel(t_loc, k, p, q, i_log, j_log, r, c, roff=0, coff=0,
     t_loc = lax.dynamic_update_slice_in_dim(
         t_loc, jnp.where(mine_r, newrow, prow)[None], kr, axis=0
     )
+    return t_loc, (
+        jnp.where(below & mine_c, newcol, 0),
+        jnp.where(right & mine_r, newrow, 0),
+    )
 
-    # panel broadcasts (trailing masking rides the zeros in pan/urow)
-    pan = bcast_from_col(jnp.where(below & mine_c, newcol, 0), k % q)
-    urow = bcast_from_row(jnp.where(right & mine_r, newrow, 0), k % p)
-    return t_loc, (pan, urow)
+
+def _nopiv_panel_bcast(payload_own, k, p, q):
+    """Broadcast half of the LU panel phase: the two rooted panel
+    broadcasts (listBcast right + down, getrf_nopiv.cc).  Trailing
+    masking rides the zeros already in pan_own/urow_own."""
+    pan_own, urow_own = payload_own
+    pan = bcast_from_col(pan_own, k % q)
+    urow = bcast_from_row(urow_own, k % p)
+    return pan, urow
+
+
+def _nopiv_panel(t_loc, k, p, q, i_log, j_log, r, c, roff=0, coff=0,
+                 panel_done=False):
+    """Panel phase of one right-looking LU tile step (diag factor + panel
+    solves + bcasts), shared by the no-pivot / tournament / partial-pivot
+    kernels; the trailing gemm is NOT applied — the (pan, urow) payload is
+    returned for the caller to schedule (immediately for the strict
+    schedule, deferred one step under lookahead).  ``roff``/``coff`` shift
+    tile indexing when ``t_loc`` is a trailing view (bucketed caller).
+    Composition of the compute + broadcast halves (split so the
+    obs.flight step-dispatch drivers can fence them as separate
+    phases)."""
+    t_loc, own = _nopiv_panel_compute(
+        t_loc, k, p, q, i_log, j_log, r, c, roff, coff, panel_done
+    )
+    # tag the broadcast half for the obs.schedule capture (trace-time
+    # bookkeeping only; no jaxpr change)
+    with phase_scope("bcast", k):
+        return t_loc, _nopiv_panel_bcast(own, k, p, q)
 
 
 def _nopiv_narrow(t_loc, payload, k, p, q, roff=0, coff=0, with_row=True):

@@ -85,8 +85,16 @@ def trsm_dist(
         method = select_trsm_method(Side.Left, b.mt, b.nt)
     la = la_depth(lookahead, a.nt)
     bi = resolve_bcast_impl(bcast_impl)
+    from ..obs import flight as _flight
+
     if method == MethodTrsm.TrsmA:
+        # stationary-A's psum-scatter delivery has no per-step broadcast
+        # phase to fence — flight step dispatch covers TrsmB only
         xt = _trsm_a_jit(
+            a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag, la, bi
+        )
+    elif _flight.step_dispatch_active():
+        xt = _flight.trsm_steps(
             a.tiles, b.tiles, a.mesh, p, q, a.nt, uplo, op, diag, la, bi
         )
     else:

@@ -90,12 +90,22 @@ def gemm_summa(
     if method == MethodGemm.GemmA:
         return _gemm_summa_a(alpha, a, b, beta, c)
     ctiles = None if c is None else c.tiles
+    from ..obs import flight as _flight
     from .comm import la_depth, resolve_bcast_impl
 
-    out_t = _summa_jit(
-        a.tiles, b.tiles, ctiles, alpha, beta, a.mesh, p, q, kt,
-        la_depth(lookahead, kt), resolve_bcast_impl(bcast_impl),
-    )
+    if _flight.step_dispatch_active():
+        # SLATE_TPU_OBS_DEEP / obs.flight_scope(): run the k-loop as
+        # per-step fenced dispatches (same schedule, same bits) so the
+        # flight recorder sees every panel broadcast and MXU update
+        out_t = _flight.summa_steps(
+            a.tiles, b.tiles, ctiles, alpha, beta, a.mesh, p, q, kt,
+            la_depth(lookahead, kt), resolve_bcast_impl(bcast_impl),
+        )
+    else:
+        out_t = _summa_jit(
+            a.tiles, b.tiles, ctiles, alpha, beta, a.mesh, p, q, kt,
+            la_depth(lookahead, kt), resolve_bcast_impl(bcast_impl),
+        )
     return DistMatrix(tiles=out_t, m=a.m, n=b.n, nb=a.nb, mesh=a.mesh)
 
 

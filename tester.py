@@ -350,6 +350,11 @@ def main(argv=None):
                     help="write a slate_tpu.obs RunReport JSON of the sweep "
                          "(also enables observability: driver spans + comm "
                          "bytes ride along)")
+    ap.add_argument("--flight", default="",
+                    help="also write a step-level FlightReport JSON "
+                         "(slate_tpu.obs.flight) for the first requested "
+                         "routine that has a flight driver (gemm / potrf / "
+                         "getrf / trsm); needs the 8-device CPU mesh")
     args = ap.parse_args(argv)
 
     import jax
@@ -441,6 +446,26 @@ def main(argv=None):
             values=report_values,
         )
         print(f"report written to {args.report}")
+    if args.flight:
+        from slate_tpu.obs import flight as _flight
+
+        fl_ops = {"gemm": "summa", "potrf": "potrf",
+                  "getrf": "getrf_nopiv", "trsm": "trsm"}
+        op = next((fl_ops[r] for r in args.routines if r in fl_ops), None)
+        if op is None:
+            print(f"flight: none of {args.routines} has a flight driver "
+                  f"({sorted(fl_ops)})")
+        else:
+            try:
+                n_fl = max(_parse_dims(args.dim))
+                rep = _flight.run_flight(op, n=n_fl, nb=max(8, n_fl // 12))
+                _flight.write_flight_report(args.flight, rep)
+                print(f"flight report written to {args.flight} (overlap_eff "
+                      f"{rep['sched']['overlap_eff']:.3f})")
+            except Exception as e:
+                # obs must never flip a passed sweep's exit code (e.g.
+                # <8 CPU devices without the forced-device XLA_FLAGS)
+                print(f"flight report failed: {e!r}")
     return 1 if failures else 0
 
 

@@ -1,0 +1,417 @@
+"""Flight recorder (ISSUE 7): disabled-mode trace identity, StepEvent
+completeness + lookahead issue-order shifts, ScheduleModel bytes against
+the analytic comm-audit volumes, FlightReport schema, and the overlap
+metric's depth-0 / depth-1 contract on the 8-device CPU mesh."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from slate_tpu.obs import flight, schedule
+from slate_tpu.parallel import from_dense, make_mesh, to_dense
+from slate_tpu.parallel.comm import comm_audit, sched_audit
+from slate_tpu.parallel.dist_chol import potrf_dist
+from slate_tpu.parallel.dist_lu import getrf_nopiv_dist
+from slate_tpu.parallel.dist_trsm import trsm_dist
+from slate_tpu.parallel.summa import gemm_summa
+from slate_tpu.types import MethodGemm, MethodTrsm, Op, Uplo
+
+P_, Q_, N_, NB_ = 2, 4, 64, 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(P_, Q_, devices=jax.devices("cpu")[:8])
+
+
+@pytest.fixture(scope="module")
+def ops(mesh):
+    rng = np.random.default_rng(7)
+    a = rng.standard_normal((N_, N_)).astype(np.float32)
+    b = rng.standard_normal((N_, N_)).astype(np.float32)
+    spd = (a @ a.T / N_ + 2 * np.eye(N_)).astype(np.float32)
+    dd = (np.tril(a) + N_ * np.eye(N_)
+          + np.triu(rng.standard_normal((N_, N_)), 1)).astype(np.float32)
+    tl = (np.tril(a) + N_ * np.eye(N_)).astype(np.float32)
+    return {
+        "a": from_dense(jnp.asarray(a), mesh, NB_),
+        "b": from_dense(jnp.asarray(b), mesh, NB_),
+        "spd": from_dense(jnp.asarray(spd), mesh, NB_, diag_pad_one=True),
+        "lu": from_dense(jnp.asarray(dd), mesh, NB_, diag_pad_one=True),
+        "tril": from_dense(jnp.asarray(tl), mesh, NB_, diag_pad_one=True),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Disabled mode: trace identity + activation contract
+# ---------------------------------------------------------------------------
+
+
+def _kernel_jaxprs(ops):
+    """Jaxprs of every opted-in fused kernel, traced fresh."""
+    jax.clear_caches()
+    out = {}
+    out["summa"] = str(jax.make_jaxpr(
+        lambda x, y: gemm_summa(1.0, x, y, method=MethodGemm.GemmC).tiles
+    )(ops["a"], ops["b"]))
+    out["potrf"] = str(jax.make_jaxpr(
+        lambda x: potrf_dist(x)[0].tiles)(ops["spd"]))
+    out["lu"] = str(jax.make_jaxpr(
+        lambda x: getrf_nopiv_dist(x)[0].tiles)(ops["lu"]))
+    out["trsm"] = str(jax.make_jaxpr(
+        lambda x, y: trsm_dist(x, y, Uplo.Lower, Op.NoTrans,
+                               method=MethodTrsm.TrsmB).tiles
+    )(ops["tril"], ops["b"]))
+    return out
+
+
+def test_disabled_mode_is_trace_identical(ops):
+    """With SLATE_TPU_OBS_DEEP unset and no scope open, the mesh kernels
+    trace exactly as before: the routing branch and the phase_scope
+    markers in comm.py must not change a single jaxpr — asserted by
+    re-tracing after a full flight run exercised the whole machinery."""
+    assert not flight.step_dispatch_active()
+    before = _kernel_jaxprs(ops)
+    with flight.flight_scope():
+        potrf_dist(ops["spd"])  # exercise step dispatch end to end
+    assert not flight.step_dispatch_active()
+    after = _kernel_jaxprs(ops)
+    assert before == after
+
+
+def test_env_and_scope_activation(monkeypatch):
+    monkeypatch.delenv(flight.DEEP_ENV, raising=False)
+    assert not flight.step_dispatch_active()
+    monkeypatch.setenv(flight.DEEP_ENV, "1")
+    assert flight.step_dispatch_active()
+    with flight.no_flight():
+        assert not flight.step_dispatch_active()
+    monkeypatch.setenv(flight.DEEP_ENV, "0")
+    assert not flight.step_dispatch_active()
+    with flight.flight_scope() as rec:
+        assert flight.active_recorder() is rec
+
+
+# ---------------------------------------------------------------------------
+# Step-dispatch results are bitwise-identical to the fused kernels
+# ---------------------------------------------------------------------------
+
+
+def test_flight_results_bitwise(ops):
+    ref_g = to_dense(gemm_summa(1.0, ops["a"], ops["b"],
+                                method=MethodGemm.GemmC, lookahead=0))
+    ref_p = to_dense(potrf_dist(ops["spd"], lookahead=0)[0])
+    ref_l = to_dense(getrf_nopiv_dist(ops["lu"], lookahead=0)[0])
+    ref_t = to_dense(trsm_dist(ops["tril"], ops["b"], Uplo.Lower,
+                               Op.NoTrans, method=MethodTrsm.TrsmB,
+                               lookahead=0))
+    with flight.flight_scope():
+        fl_g = to_dense(gemm_summa(1.0, ops["a"], ops["b"],
+                                   method=MethodGemm.GemmC, lookahead=1))
+        fl_p = to_dense(potrf_dist(ops["spd"], lookahead=1)[0])
+        fl_l = to_dense(getrf_nopiv_dist(ops["lu"], lookahead=1)[0])
+        fl_t = to_dense(trsm_dist(ops["tril"], ops["b"], Uplo.Lower,
+                                  Op.NoTrans, method=MethodTrsm.TrsmB,
+                                  lookahead=1))
+    np.testing.assert_array_equal(np.asarray(fl_g), np.asarray(ref_g))
+    np.testing.assert_array_equal(np.asarray(fl_p), np.asarray(ref_p))
+    np.testing.assert_array_equal(np.asarray(fl_l), np.asarray(ref_l))
+    np.testing.assert_array_equal(np.asarray(fl_t), np.asarray(ref_t))
+
+
+# ---------------------------------------------------------------------------
+# StepEvent completeness + the lookahead issue-order shift
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("depth", [0, 1, 2])
+def test_summa_events_complete_and_issue_shifted(ops, depth):
+    """Every k records its bcast and bulk phase, and depth d issues the
+    step-(k+d) broadcast immediately before step k's update — the exact
+    prefetch_bcast order, reproduced by the dispatch loop."""
+    kt = ops["a"].nt
+    with flight.flight_scope() as rec:
+        gemm_summa(1.0, ops["a"], ops["b"], method=MethodGemm.GemmC,
+                   lookahead=depth, bcast_impl="ring")
+    rows = schedule.rows_from_events(rec.events)
+    order = [(r["phase"], r["k"]) for r in rows]
+    d = min(depth, kt)
+    expected = [("bcast", j) for j in range(d)]
+    for k in range(kt):
+        if d and k + d < kt:
+            expected.append(("bcast", k + d))
+        if not d:
+            expected.append(("bcast", k))
+        expected.append(("bulk", k))
+    assert order == expected
+    # per-device events: one StepEvent per mesh coordinate per dispatch
+    coords = {e.device_coord for e in rec.events}
+    assert coords == {(r, c) for r in range(P_) for c in range(Q_)}
+
+
+def test_potrf_events_every_k_has_all_three_phases(ops):
+    nt = ops["spd"].nt
+    with flight.flight_scope() as rec:
+        potrf_dist(ops["spd"], lookahead=1)
+    rows = schedule.rows_from_events(rec.events)
+    by_phase = {}
+    for r in rows:
+        by_phase.setdefault(r["phase"], set()).add(r["k"])
+    assert by_phase["panel"] == set(range(nt))
+    assert by_phase["bcast"] == set(range(nt))
+    assert by_phase["bulk"] == set(range(nt))
+    # depth 1 issues step k's broadcast BEFORE step k-1's deferred bulk
+    # (the LAST bulk event of step k-1: its narrow half legitimately runs
+    # first, refreshing the column panel k reads)
+    order = [(r["phase"], r["k"]) for r in rows]
+    for k in range(1, nt):
+        last_bulk = len(order) - 1 - order[::-1].index(("bulk", k - 1))
+        assert order.index(("bcast", k)) < last_bulk
+
+
+# ---------------------------------------------------------------------------
+# ScheduleModel bytes == the analytic comm-audit volumes, per impl
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("impl", ["psum", "ring", "doubling"])
+def test_schedule_model_summa_bytes_analytic(ops, impl):
+    """The model's totals are the closed-form SUMMA broadcast volumes of
+    tests/test_comm_audit.py — psum: kt*(mtl+ntl)*nb^2*itemsize; engine:
+    kt*((q-1)*mtl + (p-1)*ntl)*nb^2*itemsize — and every byte lands in
+    the bcast phase (SUMMA's only collectives are the panel fetches)."""
+    a, b = ops["a"], ops["b"]
+    kt, mtl, ntl = a.nt, a.mt // P_, b.nt // Q_
+    itemsize = 4
+    a_bytes, b_bytes = mtl * NB_ * NB_ * itemsize, ntl * NB_ * NB_ * itemsize
+    if impl == "psum":
+        expect = kt * (a_bytes + b_bytes)
+    else:
+        expect = kt * ((Q_ - 1) * a_bytes + (P_ - 1) * b_bytes)
+    jax.clear_caches()
+    with sched_audit() as recs:
+        gemm_summa(1.0, a, b, method=MethodGemm.GemmC, lookahead=1,
+                   bcast_impl=impl)
+    model = schedule.ScheduleModel("summa", kt, P_, Q_, impl, list(recs))
+    assert model.total_bytes == expect
+    assert model.phase_bytes == {"bcast": expect}
+    if impl != "psum":
+        assert model.hop_records, "engine lowering must carry hop pairs"
+        for _op, _nb, _m, _ph, _st, pairs in model.hop_records:
+            assert all(isinstance(s, int) and isinstance(d, int)
+                       for s, d in pairs)
+
+
+@pytest.mark.parametrize("op", ["potrf", "lu"])
+def test_schedule_model_matches_comm_audit_exactly(ops, op):
+    """For the factor loops the model's grand total must equal the
+    comm-audit channel's byte-for-byte (same trace, two channels), with
+    the phase split covering every record."""
+    mat = ops["spd"] if op == "potrf" else ops["lu"]
+    run = potrf_dist if op == "potrf" else getrf_nopiv_dist
+    jax.clear_caches()
+    with comm_audit() as plain, sched_audit() as tagged:
+        run(mat, lookahead=1, bcast_impl="ring")
+    model = schedule.ScheduleModel(op, mat.nt, P_, Q_, "ring", list(tagged))
+    audit_total = sum(nb * m for _, nb, m in plain)
+    assert model.total_bytes == audit_total
+    assert sum(model.phase_bytes.values()) == audit_total
+    assert set(model.phase_bytes) <= {"panel", "bcast", "bulk"}
+    # the broadcast half of the panel phase is tagged "bcast" (the
+    # phase_scope marker inside _chol_panel / _nopiv_panel)
+    assert model.phase_bytes.get("bcast", 0) > 0
+    assert model.phase_bytes.get("panel", 0) > 0  # the diag-tile hops
+
+
+def test_flight_measured_bytes_match_phase_audit(ops):
+    """The recorder's per-event byte shares sum back to the per-phase
+    audited totals: kt * per-step phase bytes (the unbucketed per-step
+    programs repeat the same shapes every step)."""
+    a, b = ops["a"], ops["b"]
+    kt, mtl, ntl = a.nt, a.mt // P_, b.nt // Q_
+    with flight.flight_scope() as rec:
+        gemm_summa(1.0, a, b, method=MethodGemm.GemmC, lookahead=1,
+                   bcast_impl="ring")
+    rows = schedule.rows_from_events(rec.events)
+    got = sum(r["bytes"] for r in rows if r["phase"] == "bcast")
+    expect = kt * ((Q_ - 1) * mtl + (P_ - 1) * ntl) * NB_ * NB_ * 4
+    assert got == expect
+
+
+# ---------------------------------------------------------------------------
+# FlightReport schema + the overlap metric contract
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def potrf_report(mesh):
+    return flight.run_flight("potrf", n=N_, nb=NB_, depth=1,
+                             bcast_impl="ring", mesh=mesh)
+
+
+def test_flight_report_schema(potrf_report, tmp_path):
+    rep = potrf_report
+    assert flight.validate_flight_report(rep) == []
+    # round-trips through JSON
+    path = str(tmp_path / "f.flight.json")
+    flight.write_flight_report(path, rep)
+    with open(path) as f:
+        assert flight.validate_flight_report(json.load(f)) == []
+    # mutations are caught
+    bad = dict(rep, events=[])
+    assert flight.validate_flight_report(bad)
+    bad = json.loads(json.dumps(rep))
+    bad["sched"]["overlap_eff"] = 1.5
+    assert any("overlap_eff" in e for e in flight.validate_flight_report(bad))
+    bad2 = json.loads(json.dumps(rep))
+    bad2["events"][0]["phase"] = "mystery"
+    assert flight.validate_flight_report(bad2)
+
+
+def test_overlap_eff_bounds_and_depth_contrast(potrf_report):
+    sched = potrf_report["sched"]
+    assert 0.0 <= sched["overlap_eff"] <= 1.0
+    assert sched["overlap_eff"] > 0.0  # depth 1 hides some broadcast
+    # strict schedule: overlap 0, every comm second exposed
+    assert sched["overlap_eff_la0"] == 0.0
+    assert sched["exposed_comm_s"] <= sched["total_comm_s"]
+    assert sched["critical_path_s"] == pytest.approx(
+        sched["total_compute_s"] + sched["exposed_comm_s"])
+
+
+def test_depth0_exposes_all_comm(ops):
+    with flight.flight_scope() as rec:
+        potrf_dist(ops["spd"], lookahead=0)
+    sched = schedule.analyze(schedule.rows_from_events(rec.events), 0)
+    assert sched["overlap_eff"] == 0.0
+    assert sched["exposed_comm_s"] == pytest.approx(sched["total_comm_s"])
+
+
+def test_report_check_gates_flight_reports(potrf_report, tmp_path):
+    """obs.report --check reads FlightReports: identical pair passes; a
+    halved overlap_eff (higher-is-better) fails."""
+    from slate_tpu.obs import report
+
+    new = str(tmp_path / "new.flight.json")
+    old = str(tmp_path / "old.flight.json")
+    flight.write_flight_report(new, potrf_report)
+    worse = json.loads(json.dumps(potrf_report))
+    worse["values"]["sched.overlap_eff"] = (
+        potrf_report["values"]["sched.overlap_eff"] / 4)
+    flight.write_flight_report(old, potrf_report)
+    assert report.main(["--check", new, old, "--threshold", "3"]) == 0
+    flight.write_flight_report(new, worse)
+    # worse as NEW against good OLD: overlap_eff fell 4x beyond 3x
+    assert report.main(["--check", new, old, "--threshold", "3"]) == 1
+
+
+def test_flight_perfetto_gantt(potrf_report):
+    """Per-device tracks + broadcast hop flow arrows validate."""
+    from slate_tpu.obs import perfetto
+
+    tr = perfetto.flight_chrome_trace(potrf_report["events"],
+                                      potrf_report["hop_events"],
+                                      grid=(P_, Q_))
+    assert perfetto.validate_chrome_trace(tr) == []
+    evs = tr["traceEvents"]
+    tids = {e["tid"] for e in evs if e.get("ph") == "X"}
+    assert len(tids) == P_ * Q_
+    names = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    assert f"mesh(0,0)" in names and f"mesh({P_-1},{Q_-1})" in names
+    starts = [e for e in evs if e.get("ph") == "s"]
+    ends = [e for e in evs if e.get("ph") == "f"]
+    assert starts and len(starts) == len(ends)
+    # flow arrows join distinct device tracks
+    assert any(s["tid"] != t["tid"] for s, t in zip(starts, ends))
+
+
+def test_analyze_depth2_never_double_counts_hiding():
+    """Each second of bulk work hides at most one second of broadcast:
+    at depth 2 the hide windows of bcast k and k+1 overlap on bulk k-1,
+    and the shared capacity must be consumed, not credited twice."""
+    # two broadcasts of 1.0 s each, one eligible bulk of 1.0 s issued
+    # after both — naive per-broadcast summing would hide 2.0 s
+    rows = [
+        {"op": "x", "k": 2, "phase": "bcast", "t0": 0.0, "t1": 1.0,
+         "dur": 1.0, "bytes": 0.0, "flops": 0.0},
+        {"op": "x", "k": 3, "phase": "bcast", "t0": 1.0, "t1": 2.0,
+         "dur": 1.0, "bytes": 0.0, "flops": 0.0},
+        {"op": "x", "k": 1, "phase": "bulk", "t0": 2.0, "t1": 3.0,
+         "dur": 1.0, "bytes": 0.0, "flops": 0.0},
+    ]
+    out = schedule.analyze(rows, 2)
+    # bulk k=1 lies in both windows ([0,2) and [1,3)) but its 1.0 s can
+    # only cover one of the 2.0 comm seconds
+    assert out["exposed_comm_s"] == pytest.approx(1.0)
+    assert out["overlap_eff"] == pytest.approx(0.5)
+    assert out["critical_path_s"] == pytest.approx(2.0)
+
+
+def test_backward_trsm_hop_rotation_uses_logical_root(ops):
+    """Backward solves (Upper/NoTrans) walk panels last-to-first: hop
+    events must carry root_k = nt-1-s so the Perfetto arrows rotate by
+    the true broadcast owner, not the dispatch index."""
+    up = ops["tril"]
+    upper = from_dense(to_dense(up).T, up.mesh, NB_, diag_pad_one=True)
+    with flight.flight_scope() as rec:
+        trsm_dist(upper, ops["b"], uplo=Uplo.Upper, op=Op.NoTrans,
+                  method=MethodTrsm.TrsmB, lookahead=1, bcast_impl="ring")
+    nt = up.nt
+    hops = [h for h in rec.hop_events if h["op"] == "trsm"]
+    assert hops, "ring trsm flight must record hop events"
+    assert all(h["root_k"] == nt - 1 - h["k"] for h in hops), hops[:4]
+    # forward solve: logical root == dispatch index
+    with flight.flight_scope() as rec_f:
+        trsm_dist(ops["tril"], ops["b"], uplo=Uplo.Lower, op=Op.NoTrans,
+                  method=MethodTrsm.TrsmB, lookahead=1, bcast_impl="ring")
+    assert all(h["root_k"] == h["k"] for h in rec_f.hop_events
+               if h["op"] == "trsm")
+
+
+def test_report_check_ignore_glob(potrf_report, tmp_path):
+    """--ignore GLOB excludes machine-dependent wall-clock keys from the
+    gate while the byte/eff keys still compare (the CI flight gate)."""
+    from slate_tpu.obs import report
+
+    new = str(tmp_path / "new.flight.json")
+    old = str(tmp_path / "old.flight.json")
+    slow = json.loads(json.dumps(potrf_report))
+    for key in list(slow["values"]):
+        if key.endswith("_s"):
+            slow["values"][key] *= 100.0  # a 100x slower runner
+    flight.write_flight_report(new, slow)
+    flight.write_flight_report(old, potrf_report)
+    # gated bare: the timing keys fail
+    assert report.main(["--check", new, old, "--threshold", "4"]) == 1
+    # gated as CI does: timings ignored, deterministic keys still pass
+    assert report.main(["--check", new, old, "--threshold", "4",
+                        "--ignore", "sched.*_s"]) == 0
+    # but a byte regression is NOT maskable by the timing ignore
+    slow["values"]["sched.model_bytes"] *= 100.0
+    flight.write_flight_report(new, slow)
+    assert report.main(["--check", new, old, "--threshold", "4",
+                        "--ignore", "sched.*_s"]) == 1
+
+
+@pytest.mark.parametrize("trans_op", [Op.Trans, Op.ConjTrans])
+def test_flight_trsm_trans_path_bitwise(ops, trans_op):
+    """The flight trsm driver re-implements _trsm_jit's transpose-gather
+    fetch (op != NoTrans reads a ROW of A and transposes); pin it
+    bitwise against the fused kernel so a future dist_trsm fix can't
+    silently drift the step-dispatch twin."""
+    ref = to_dense(trsm_dist(ops["tril"], ops["b"], Uplo.Lower, trans_op,
+                             method=MethodTrsm.TrsmB, lookahead=0))
+    with flight.flight_scope() as rec:
+        fl = to_dense(trsm_dist(ops["tril"], ops["b"], Uplo.Lower, trans_op,
+                                method=MethodTrsm.TrsmB, lookahead=1))
+    np.testing.assert_array_equal(np.asarray(fl), np.asarray(ref))
+    # Lower/Trans is an effective-upper BACKWARD solve: logical roots
+    # must run last-to-first
+    trsm_hops = [h for h in rec.hop_events if h["op"] == "trsm"]
+    nt = ops["tril"].nt
+    assert all(h["root_k"] == nt - 1 - h["k"] for h in trsm_hops)

@@ -383,3 +383,35 @@ def test_check_regression_flags_zero_collapse():
     # lower-is-better hitting zero is an improvement, not a failure
     fails, n = check_regression({"wall_seconds": 0.0}, {"wall_seconds": 5.0})
     assert fails == []
+
+
+def test_check_mixed_schema_sections_inconclusive(tmp_path, capsys):
+    """ISSUE 7 satellite: when the NEW report carries a metrics section
+    the OLD artifact predates (sched.* from a flight report, ft_*
+    against a pre-ft report), --check reports those keys as
+    per-key INCONCLUSIVE instead of failing the whole check — the shared
+    metrics still gate normally."""
+    # unit surface: the section filter
+    assert report.inconclusive_keys(
+        {"wall_seconds": 1.0, "sched.overlap_eff": 0.5, "ft_detected": 2.0,
+         "new_gflops": 9.0},
+        {"wall_seconds": 1.0},
+    ) == ["ft_detected", "sched.overlap_eff"]  # new_gflops: not a section
+    # shared key present in both: never inconclusive
+    assert report.inconclusive_keys(
+        {"sched.overlap_eff": 0.5}, {"sched.overlap_eff": 0.4}) == []
+
+    # CLI surface: mixed-schema pair passes (rc 0) with INCONCLUSIVE lines
+    old = str(tmp_path / "old.json")
+    new = str(tmp_path / "new.json")
+    obs.reset()
+    report.write_report(old, name="mixed", values={"x_gflops": 100.0})
+    report.write_report(new, name="mixed",
+                        values={"x_gflops": 101.0,
+                                "sched.overlap_eff": 0.6,
+                                "sched.critical_path_s": 0.02})
+    assert report.main(["--check", new, old]) == 0
+    out = capsys.readouterr().out
+    assert out.count("INCONCLUSIVE") == 2
+    assert "sched.overlap_eff" in out and "sched.critical_path_s" in out
+    obs.reset()
