@@ -323,6 +323,51 @@ def bench_getrf_f64():
     return 2.0 * n**3 / 3.0 / t / 1e9
 
 
+# Mixed-precision mesh solve (ISSUE 8): the DEFAULT f64 gesv/posv now
+# routes through the f32-factor + fused-refinement ladder
+# (Option.MixedPrecision=auto, parallel/dist_refine.py).  These extras
+# time the shipped driver against the same driver pinned to the direct
+# f64 path — the mixed/f64 ratio IS the headline the routing change buys
+# (f32 getrf runs ~40x the emulated-f64 rate, so the solve should
+# approach factor-bound f32 time + a few refinement sweeps).
+N_SOLVE = 4096
+
+
+def _bench_mesh_solve(kind: str, mode: str):
+    from slate_tpu.parallel import make_mesh
+    from slate_tpu.parallel.drivers import gesv_mesh, posv_mesh
+    from slate_tpu.types import Option
+
+    n = N_SOLVE
+    g = jax.random.normal(jax.random.PRNGKey(2), (n, n), jnp.float64)
+    if kind == "posv":
+        a = (g + g.T) / (2.0 * jnp.sqrt(float(n))) + 3 * jnp.eye(n, dtype=jnp.float64)
+        drv, flops = posv_mesh, n**3 / 3.0
+    else:
+        # diagonally shifted so the f32 factor's condition stays well
+        # inside the IR tier (no GMRES/fallback escalation in the timing)
+        a = g + jnp.sqrt(float(n)) * jnp.eye(n, dtype=jnp.float64)
+        drv, flops = gesv_mesh, 2.0 * n**3 / 3.0
+    b = jax.random.normal(jax.random.PRNGKey(3), (n, 8), jnp.float64)
+    mesh = make_mesh()  # near-square grid over every local device
+    opts = {Option.MixedPrecision: mode}
+
+    def run(a_):
+        x, info = drv(a_, b, mesh, 256, opts=opts)
+        jax.block_until_ready(x)
+        return x
+
+    run(a)  # compile + warm (the drivers are host-driven multi-program)
+    best = float("inf")
+    for i in range(2):
+        ai = a + (i + 1) * 1e-9 * jnp.eye(n, dtype=jnp.float64)
+        jax.block_until_ready(ai)
+        t0 = time.perf_counter()
+        run(ai)
+        best = min(best, time.perf_counter() - t0)
+    return flops / best / 1e9
+
+
 def _timeit_perturbed(fn, a, *rest, reps=2):
     """Best wall time with a PERTURBED first input per rep (identical
     dispatches are cached by the tunnel) and a queue drain per timing."""
@@ -453,6 +498,13 @@ def main():
         ("potrf_f32_gflops", bench_potrf),
         ("getrf_f32_gflops", bench_getrf),
         ("gemm_f64_emulated_gflops", bench_gemm_f64_emulated),
+        # mixed-precision mesh solve (ISSUE 8): the shipped auto ladder
+        # vs the same driver pinned to the direct f64 path — mixed first
+        # (cheap), the f64 baselines just before the n=8192 heavyweights
+        ("gesv_mixed_gflops", lambda: _bench_mesh_solve("gesv", "auto")),
+        ("posv_mixed_gflops", lambda: _bench_mesh_solve("posv", "auto")),
+        ("gesv_f64_direct_gflops", lambda: _bench_mesh_solve("gesv", "off")),
+        ("posv_f64_direct_gflops", lambda: _bench_mesh_solve("posv", "off")),
         (f"potrf_f64_gflops_n{N_F64}", bench_potrf_f64),
         (f"getrf_f64_gflops_n{N_F64}", bench_getrf_f64),
     ]:
@@ -475,6 +527,11 @@ def main():
         pp = extras.get(f"panel_{kind}_pallas_gflops")
         if isinstance(px, float) and isinstance(pp, float) and px > 0:
             extras[f"panel_{kind}_pallas_speedup"] = round(pp / px, 2)
+    for kind in ("gesv", "posv"):
+        mx = extras.get(f"{kind}_mixed_gflops")
+        fx = extras.get(f"{kind}_f64_direct_gflops")
+        if isinstance(mx, float) and isinstance(fx, float) and fx > 0:
+            extras[f"{kind}_mixed_vs_f64_speedup"] = round(mx / fx, 2)
     if isinstance(extras.get("gemm_bf16_gflops"), float):
         extras["bf16_mfu_vs_peak"] = round(extras["gemm_bf16_gflops"] / V5E_BF16_PEAK, 3)
     ge = extras.get("gemm_f64_emulated_gflops")

@@ -123,6 +123,30 @@ python -m slate_tpu.obs.report --check \
     artifacts/obs/panel_pallas.report.json artifacts/obs/panel_xla.report.json \
     --threshold "$PANEL_PARITY_THRESHOLD"
 
+# mixed-precision solve smoke (ISSUE 8): the default f64 gesv/posv route
+# through the Option.MixedPrecision=auto ladder (f32 mesh factor + fused
+# on-device refinement, GMRES-IR escalation, full-f64 fallback).  The
+# smoke asserts the acceptance surface — off is jaxpr-identical to the
+# direct path, auto and the Ozaki int8 residual meet the refine.py gate,
+# the GMRES tier converges, the ir.* counters land in a schema-valid
+# RunReport — then re-runs under the ring broadcast and Pallas panel
+# lowerings to prove opts thread end-to-end into the f32 factor AND the
+# refinement loop's residual SUMMA.
+python -m slate_tpu.parallel.mixed_smoke --out artifacts/mixed
+SLATE_TPU_BCAST_IMPL=ring python -m slate_tpu.parallel.mixed_smoke \
+    --out artifacts/mixed_ring
+SLATE_TPU_PANEL_IMPL=pallas python -m slate_tpu.parallel.mixed_smoke \
+    --out artifacts/mixed_panel
+
+# mixed accuracy artifact: regenerate the off-vs-auto RunReports and gate
+# the residual-gate parity (the mixed ladder may not be numerically worse
+# than the direct f64 solve); the obs.report --check pass re-validates
+# the COMMITTED artifact pair through the standard CLI.
+python tools/mixed_report.py --out artifacts/obs --threshold 3
+python -m slate_tpu.obs.report --check \
+    artifacts/obs/mixed_auto.report.json artifacts/obs/mixed_off.report.json \
+    --threshold 3
+
 # ruff / mypy: configured in pyproject.toml; the container image may not
 # ship them, so gate on availability rather than skipping silently
 if command -v ruff > /dev/null 2>&1; then

@@ -27,6 +27,52 @@ from ..types import Norm, Option, Options, Uplo, get_option
 Array = jax.Array
 
 
+def gate_cte(anorm, n: int, dtype, tol_factor: float = 1.0):
+    """The refinement convergence constant: the loop stops when
+    ``||r|| <= ||x|| * cte`` with ``cte = ||A|| * eps * sqrt(n)`` — the
+    reference's gesv_mixed.cc gate.  The single definition shared by the
+    single-chip loop below and the fused mesh refinement
+    (parallel/dist_refine.py), so the accuracy contract cannot drift."""
+    eps = jnp.finfo(dtype).eps
+    return anorm * eps * jnp.sqrt(jnp.asarray(float(n), dtype)) * tol_factor
+
+
+# -- ir.* observability counters (the ft.policy pattern: always-on, cheap,
+#    landed in every RunReport as the ``ir`` section) ------------------------
+
+_IR_COUNTERS = (
+    "ir.solves", "ir.converged", "ir.iters_total", "ir.gmres_solves",
+    "ir.escalated_gmres", "ir.fallback", "ir.residual_gemm_bytes",
+)
+
+
+def _registry():
+    from ..obs import REGISTRY
+
+    return REGISTRY
+
+
+def ir_count(name: str, op: str, n: float = 1.0) -> None:
+    """Bump one ``ir.*`` counter, tagged by op (gesv/posv)."""
+    _registry().counter_add(name, n, op=op)
+
+
+def ir_gauge(name: str, value: float, op: str) -> None:
+    _registry().gauge_set(name, float(value), op=op)
+
+
+def ir_counter_values() -> dict:
+    """Totals of every ``ir.*`` counter across op tags — the RunReport
+    ``ir`` section (obs.report.make_report reads this), gated by
+    ``obs.report --check`` like the ft.* outcome totals."""
+    snap = _registry().snapshot()
+    out = {name.split("ir.", 1)[1]: 0.0 for name in _IR_COUNTERS}
+    for entry in snap.get("counters", []):
+        if entry["name"] in _IR_COUNTERS:
+            out[entry["name"].split("ir.", 1)[1]] += float(entry["value"])
+    return out
+
+
 class RefineResult(NamedTuple):
     """Result of a mixed-precision refined solve (ADVICE r4: the public
     return grew from 3 to 4 fields in round 4; the NamedTuple documents the
@@ -50,9 +96,8 @@ def _refine_loop(
 ) -> Tuple[Array, Array, Array]:
     """Classic iterative refinement. Returns (x, iters, converged)."""
     n = a_hi.shape[0]
-    eps = jnp.finfo(a_hi.dtype).eps
     anorm = genorm(Norm.Inf, a_hi)
-    cte = anorm * eps * jnp.sqrt(jnp.asarray(float(n), a_hi.dtype)) * tol_factor
+    cte = gate_cte(anorm, n, a_hi.dtype, tol_factor)
 
     x = lo_solve(b).astype(a_hi.dtype)
 
@@ -194,10 +239,25 @@ def _gmres(
         rnorm = jnp.linalg.norm(precond(b - matvec(x)))
         return x, rnorm
 
-    x, rnorm = x0, jnp.asarray(jnp.inf, jnp.real(b).dtype)
-    x, rnorm = jax.lax.fori_loop(
-        0, max_restarts, lambda i, c: jax.lax.cond(c[1] > tol, lambda cc: restart_body(i, cc), lambda cc: cc, c),
-        (x, rnorm),
+    # while_loop, not fori_loop + cond: under the multi-RHS vmap a
+    # batched-predicate cond lowers to both-branches-execute + select,
+    # so converged columns would keep paying full Arnoldi cycles for all
+    # max_restarts trips.  A while_loop's batched cond is ANY-lane: the
+    # batch stops at the SLOWEST column's cycle count, and unbatched
+    # semantics are unchanged (loop while unconverged, at most
+    # max_restarts cycles).
+    def cont(c):
+        i, _x, rn = c
+        return (i < max_restarts) & (rn > tol)
+
+    def step(c):
+        i, x, rn = c
+        x, rn = restart_body(i, (x, rn))
+        return i + 1, x, rn
+
+    _, x, rnorm = jax.lax.while_loop(
+        cont, step,
+        (jnp.int32(0), x0, jnp.asarray(jnp.inf, jnp.real(b).dtype)),
     )
     return x, rnorm
 
@@ -236,7 +296,13 @@ def posv_mixed_gmres_array(
 
 
 def _gmres_multi_rhs(a, b, matvec, precond, restart, max_restarts):
-    """Solve each RHS column with _gmres; returns (x like b, worst resid)."""
+    """Solve each RHS column with _gmres; returns (x like b, worst resid).
+
+    The columns are independent Krylov solves with identical static
+    shapes, so the single-RHS solver is ``vmap``ped over them — ONE
+    compiled program for any B width (the predecessor re-traced ``_gmres``
+    per column in a Python loop: B with 30 columns compiled 30 copies of
+    the whole Arnoldi program)."""
     eps = jnp.finfo(a.dtype).eps
     rdtype = jnp.real(a).dtype
     scale = jnp.sqrt(jnp.asarray(float(a.shape[0]), rdtype)) * eps
@@ -247,7 +313,5 @@ def _gmres_multi_rhs(a, b, matvec, precond, restart, max_restarts):
 
     if b.ndim == 1:
         return one(b)
-    cols = [one(b[:, j]) for j in range(b.shape[1])]
-    x = jnp.stack([c[0] for c in cols], axis=1)
-    rnorm = jnp.max(jnp.stack([c[1] for c in cols]))
-    return x, rnorm
+    x, rnorms = jax.vmap(one, in_axes=1, out_axes=(1, 0))(b)
+    return x, jnp.max(rnorms)

@@ -36,14 +36,18 @@ VERSION = 1
 # cover the flight recorder's sched.* timing keys (ISSUE 7).
 _LOWER_BETTER = ("second", "time", "byte", "error", "err", "resid", "latency",
                  "uncorrectable", "critical_path", "exposed", "comm_s",
-                 "wall_s", "compute_s")
+                 "wall_s", "compute_s",
+                 # mixed-precision refinement outcomes: more iterations /
+                 # escalations / full-f64 fallbacks per solve = worse
+                 "iters_total", "escalated", "fallback")
 
 # metric-name prefixes that form versioned report SECTIONS: when the new
 # report carries them and the old artifact predates the section entirely
 # (e.g. sched.* against a pre-flight report, ft_* against a pre-PR-4
-# BENCH_*.json), --check reports each key as inconclusive instead of
-# silently ignoring it or failing the whole check
-_SECTION_PREFIXES = ("sched.", "ft_")
+# BENCH_*.json, ir_* against a pre-mixed-precision report), --check
+# reports each key as inconclusive instead of silently ignoring it or
+# failing the whole check
+_SECTION_PREFIXES = ("sched.", "ft_", "ir_")
 
 # pure cost-model estimates with no better/worse direction: halving the
 # XLA flop estimate is usually an optimization, doubling may be a bigger
@@ -79,6 +83,7 @@ def make_report(
     spans = list(_span.FINISHED) if include_spans else []
     base = min((s["t0"] for s in spans), default=0.0)
     from ..ft.policy import ft_counter_values
+    from ..linalg.refine import ir_counter_values
 
     return {
         "schema": SCHEMA,
@@ -91,6 +96,10 @@ def make_report(
         # fault-tolerance outcome totals (ft.* counters): detections /
         # corrections / recomputes / uncorrectables accumulated this run
         "ft": ft_counter_values(),
+        # mixed-precision refinement totals (ir.* counters): solves /
+        # converged / iteration count / GMRES escalations / f64 fallbacks
+        # / residual-gemm comm bytes accumulated this run
+        "ir": ir_counter_values(),
         "metrics": REGISTRY.snapshot(),
         "spans": [
             {
@@ -138,12 +147,13 @@ def validate_report(rep) -> List[str]:
         not isinstance(m.get(k), list) for k in ("counters", "gauges", "histograms")
     ):
         errs.append("metrics must hold counters/gauges/histograms lists")
-    ftv = rep.get("ft")  # optional (reports predate the ft section)
-    if ftv is not None and (
-        not isinstance(ftv, dict)
-        or any(not isinstance(v, (int, float)) for v in ftv.values())
-    ):
-        errs.append("ft must map outcome name -> number")
+    for sec in ("ft", "ir"):  # optional (reports predate these sections)
+        sv = rep.get(sec)
+        if sv is not None and (
+            not isinstance(sv, dict)
+            or any(not isinstance(v, (int, float)) for v in sv.values())
+        ):
+            errs.append(f"{sec} must map outcome name -> number")
     spans = rep.get("spans")
     if not isinstance(spans, list):
         errs.append("spans must be a list")
@@ -187,6 +197,14 @@ def load_values(doc: dict, include_series: bool = False) -> Dict[str, float]:
                   if isinstance(v, (int, float))}
         if any(ftvals.values()):
             vals.update({f"ft_{k}": float(v) for k, v in ftvals.items()})
+        # ir.* refinement totals gate the same way: under a fixed solve
+        # workload, converged dropping (or fallbacks rising) is a
+        # mixed-precision coverage regression; an all-zero section (no
+        # mixed solves this run) stays out of the comparison surface
+        irvals = {k: v for k, v in (doc.get("ir") or {}).items()
+                  if isinstance(v, (int, float))}
+        if any(irvals.values()):
+            vals.update({f"ir_{k}": float(v) for k, v in irvals.items()})
         if include_series:
             vals.update(flatten_snapshot(doc.get("metrics", {})))
         return {k: float(v) for k, v in vals.items()

@@ -208,6 +208,89 @@ def matmul_planes(qa: Array, ea: Array, qb: Array, eb: Array) -> Array:
     return out * sa * sb
 
 
+# ---------------------------------------------------------------------------
+# Block-cyclic tile-stack forms (ISSUE 8): the split/accumulate pieces the
+# distributed residual SUMMA (parallel/summa.gemm_summa_ozaki) composes
+# inside its shard_map kernel.  Everything here is pure elementwise/local
+# math — the mesh reductions (global row maxima) and the panel broadcasts
+# stay in parallel/, riding the exact gemm_summa schedule.  The splits and
+# the per-diagonal integer contractions reuse the single-chip construction
+# above, and the summation order is fixed by the logical k order regardless
+# of mesh shape: results are bitwise-reproducible across (p, q) grids
+# (padded tiles/steps contribute exact zeros, and x + 0.0 is the identity).
+# ---------------------------------------------------------------------------
+
+
+def row_exp_from_absmax(absmax32: Array) -> Array:
+    """Per-row digit-grid exponents from an f32 row-max array of any shape
+    (the ``_row_exp`` bit-twiddle, shape-polymorphic).  Distributed callers
+    pmax their local tile-row maxima over the mesh axis that shards the
+    contraction first, so every device slices on the same global grid."""
+    return _row_exp(absmax32)
+
+
+def split_tiles(x: Array, e: Array, n_slices: int = _DEFAULT_SLICES) -> Array:
+    """Digit planes (n_slices, *x.shape) int8 of an f64 tile stack.
+
+    ``e`` must broadcast against ``x`` and satisfy |x| < 2^e along each
+    scaled row (the ``split_rows`` bound contract — here the caller aligns
+    e to the tile-stack row axis, e.g. (mtl, 1, nb, 1) for a local
+    (mtl, ktl, nb, nb) stack of A or (1, ntl, 1, nb) for B's per-column
+    grid).  Exact for the same reasons as ``split_rows``: the hi/lo f32
+    decomposition is exact, and digit removal on a power-of-two grid only
+    shortens f32 significands."""
+    hi, lo = _split_f32(x)
+    return _slice_digits(hi, lo, e, n_slices)
+
+
+def plane_diag_term(qa: Array, qb: Array, s: int) -> Array:
+    """One t+u == s anti-diagonal of a batched tile product, as a single
+    int32 contraction: qa (S, I, nb, nb) digit planes of an A tile column,
+    qb (S, J, nb, nb) planes of a B tile row; returns (I, J, nb, nb) int32
+    = sum_{t+u=s} qa_t[i] @ qb_u[j].  EXACT: |q| <= 64 so an (s+1)*nb-term
+    dot stays far below 2^31 for nb <= 8192 (the _K_CHUNK bound)."""
+    return jnp.einsum(
+        "tiab,tjbc->ijac",
+        qa[: s + 1],
+        qb[s::-1],
+        preferred_element_type=jnp.int32,
+    )
+
+
+def accumulate_diag_planes(acc: Array, qa: Array, qb: Array,
+                           n_slices: int) -> Array:
+    """Fold every t+u == s diagonal of one (A tile column) x (B tile row)
+    panel product into the running f64 accumulator — the per-k-step
+    consume of the distributed Ozaki SUMMA.  Same weights and diagonal
+    order as ``matmul_planes``, but the cross-k-step accumulation is f64,
+    NOT the f32 pair: ``matmul_planes`` contracts the FULL k in int32
+    before it ever touches the pair (2 n_slices pair-adds of
+    geometrically decaying terms), while a SUMMA consume adds same-scale
+    partials every k-step — a pair cascade there compounds at the
+    double-single unit 2^-48 per step and the refinement loop's residual
+    stalls ~5 bits above the f64 gate.  Here the int32 -> f64 conversion
+    and the power-of-two weight multiply are both exact, so the ONLY
+    rounding is one f64 add per slice per step (2^-53, the same class as
+    the plain f64 SUMMA residual) — and adding an exact zero stays the
+    bitwise identity, which is what keeps padded tiles/steps free."""
+    for s in range(n_slices):
+        w = 2.0 ** (-_D * (s + 2))
+        t = plane_diag_term(qa, qb, s)
+        acc = acc + t.astype(jnp.float64) * w
+    return acc
+
+
+def scale_rows_cols_f64(acc: Array, sa: Array, sb: Array) -> Array:
+    """Final epilogue: the exact power-of-two row/column scales
+    (sa = 2^ea along rows, sb = 2^eb along columns, broadcastable)."""
+    return acc * sa * sb
+
+
+def exp2_scale_f64(e: Array) -> Array:
+    """2^e as exact f64 (f32 power of two widened), for the epilogue."""
+    return _exp2i(e).astype(jnp.float64)
+
+
 @functools.partial(jax.jit, static_argnames=("n_slices",))
 def matmul_c128(a: Array, b: Array, n_slices: int = _DEFAULT_SLICES) -> Array:
     """complex128 ``a @ b`` as three real Ozaki products (Karatsuba).

@@ -113,21 +113,43 @@ def potrf_mesh(
     )
 
 
-@instrument("posv_mesh")
-def posv_mesh(
+def _posv_mesh_plain(
     a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
     opts: Optional[Options] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Distributed SPD solve: potrf + two trsm sweeps (src/posv.cc).
-    Option.FaultTolerance protects the O(n^3) factorization (rerouted
-    via potrf_mesh); the O(n^2 nrhs) trsm sweeps run unprotected —
-    the factor dominates both flops and fault exposure."""
+    """The direct factor-at-data-dtype SPD solve: potrf + two trsm
+    sweeps.  This is the whole solve under Option.MixedPrecision=off
+    (trace-identical to the pre-mixed driver) and the fallback tier of
+    the mixed ladder."""
     la, bi = _la(opts), _bi(opts)
     l, info = potrf_mesh(a, mesh, nb, opts)
     bd = from_dense(b, mesh, nb)
     y = trsm_dist(l, bd, Uplo.Lower, Op.NoTrans, lookahead=la, bcast_impl=bi)
     x = trsm_dist(l, y, Uplo.Lower, Op.ConjTrans, lookahead=la, bcast_impl=bi)
     return to_dense(x), info
+
+
+@instrument("posv_mesh")
+def posv_mesh(
+    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
+    opts: Optional[Options] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Distributed SPD solve (src/posv.cc).  f64 inputs route through the
+    mixed-precision ladder by default (Option.MixedPrecision, default
+    auto: f32 mesh factor + fused f64 refinement, GMRES-IR escalation,
+    full-f64 fallback — dist_refine.py; the f32 factor consumes every
+    opt the direct path would: Lookahead, BcastImpl, PanelImpl,
+    FaultTolerance).  ``off`` (or any non-f64 dtype) runs the direct
+    potrf + two-trsm path, trace-identical to the pre-mixed driver."""
+    from .dist_refine import mixed_mesh_route
+
+    routed = mixed_mesh_route(
+        "posv", a, b, mesh, nb, opts,
+        lambda: _posv_mesh_plain(a, b, mesh, nb, opts),
+    )
+    if routed is not None:
+        return routed
+    return _posv_mesh_plain(a, b, mesh, nb, opts)
 
 
 @instrument("getrf_nopiv_mesh")
@@ -326,103 +348,21 @@ def gesv_tntpiv_mesh(
 
 # ---------------------------------------------------------------------------
 # Mixed-precision mesh solvers (src/gesv_mixed.cc:16-44, posv_mixed.cc) and
-# distributed inverses (src/getri.cc, src/potri.cc) — VERDICT r2 items 4/8
+# distributed inverses (src/getri.cc, src/potri.cc).  The mixed engine —
+# the fused on-device refinement loop, the Ozaki residual SUMMA, the
+# distributed GMRES-IR escalation tier, and the Option.MixedPrecision
+# routing behind gesv_mesh/posv_mesh — lives in dist_refine.py; the
+# drivers are re-exported here so `parallel.gesv_mixed_mesh` keeps
+# working.
 # ---------------------------------------------------------------------------
 
-
-def _ir_loop_mesh(a_hi: DistMatrix, bd: DistMatrix, lo_solve, max_iter=30):
-    """Classic iterative refinement with every operand distributed: the
-    f32 factor/solve runs on the mesh, the f64 residual is one SUMMA gemm,
-    norms are mesh reductions (norm_dist) — nothing is gathered.  The
-    iteration control is a host loop on scalar norms, as the reference's
-    (gesv_mixed.cc's omp-master loop reading MPI-reduced norms)."""
-    from ..types import Norm
-    from .dist_aux import norm_dist
-
-    n = a_hi.m
-    eps = float(jnp.finfo(a_hi.tiles.dtype).eps)
-    anorm = float(norm_dist(Norm.Inf, a_hi))
-    cte = anorm * eps * float(n) ** 0.5
-
-    x = lo_solve(bd)  # f32 solve, tiles upcast below
-    x = DistMatrix(tiles=x.tiles.astype(a_hi.tiles.dtype), m=x.m, n=x.n,
-                   nb=x.nb, mesh=x.mesh, diag_pad=x.diag_pad)
-    iters, converged = 0, False
-    for it in range(max_iter):
-        r = gemm_summa(-1.0, a_hi, x, 1.0, bd)
-        rnorm = float(norm_dist(Norm.Inf, r))
-        xnorm = float(norm_dist(Norm.Inf, x))
-        if rnorm <= xnorm * cte:
-            converged = True
-            iters = it
-            break
-        d = lo_solve(r)
-        dt = DistMatrix(tiles=d.tiles.astype(a_hi.tiles.dtype), m=d.m, n=d.n,
-                        nb=d.nb, mesh=d.mesh, diag_pad=d.diag_pad)
-        x = DistMatrix(tiles=x.tiles + dt.tiles, m=x.m, n=x.n, nb=x.nb,
-                       mesh=x.mesh, diag_pad=x.diag_pad)
-        iters = it + 1
-    return x, iters, converged
-
-
-def _astype_dist(d: DistMatrix, dtype) -> DistMatrix:
-    return DistMatrix(tiles=d.tiles.astype(dtype), m=d.m, n=d.n, nb=d.nb,
-                      mesh=d.mesh, diag_pad=d.diag_pad)
-
-
-@instrument("posv_mixed_mesh")
-def posv_mixed_mesh(
-    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
-    max_iter: int = 30,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Distributed SPD solve, f32 mesh factor + f64 mesh refinement
-    (src/posv_mixed.cc).  Returns (x, iters, info); iters = -1 means the
-    refinement did not converge and the caller should fall back."""
-    ad = from_dense(a, mesh, nb, diag_pad_one=True)
-    a_lo = _astype_dist(ad, jnp.float32)
-    l, info = potrf_dist(a_lo)
-
-    def lo_solve(rd: DistMatrix) -> DistMatrix:
-        r32 = _astype_dist(rd, jnp.float32)
-        y = trsm_dist(l, r32, Uplo.Lower, Op.NoTrans)
-        return trsm_dist(l, y, Uplo.Lower, Op.ConjTrans)
-
-    bd = from_dense(b, mesh, nb)
-    if int(info) != 0:  # factor failed: x is NaN so misuse fails loudly
-        return _nan_like_solution(bd, ad), jnp.asarray(-1, jnp.int32), info
-    x, iters, conv = _ir_loop_mesh(ad, bd, lo_solve, max_iter)
-    return to_dense(x), jnp.asarray(iters if conv else -1, jnp.int32), info
-
-
-def _nan_like_solution(bd: DistMatrix, ad: DistMatrix) -> jax.Array:
-    """NaN-filled x for a failed factor: a caller that ignores info/iters
-    cannot mistake the RHS for a solution (the reference leaves X
-    undefined; NaN is the loud functional equivalent)."""
-    return jnp.full((bd.m, bd.n), jnp.nan, ad.tiles.dtype)
-
-
-@instrument("gesv_mixed_mesh")
-def gesv_mixed_mesh(
-    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
-    max_iter: int = 30,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Distributed general solve, f32 partial-pivot mesh factor + f64 mesh
-    refinement (src/gesv_mixed.cc:16-44)."""
-    ad = from_dense(a, mesh, nb, diag_pad_one=True)
-    a_lo = _astype_dist(ad, jnp.float32)
-    lu, perm, info = getrf_pp_dist(a_lo)
-
-    def lo_solve(rd: DistMatrix) -> DistMatrix:
-        r32 = _astype_dist(rd, jnp.float32)
-        pr = permute_rows_dist(r32, perm)
-        y = trsm_dist(lu, pr, Uplo.Lower, Op.NoTrans, Diag.Unit)
-        return trsm_dist(lu, y, Uplo.Upper, Op.NoTrans)
-
-    bd = from_dense(b, mesh, nb)
-    if int(info) != 0:  # singular factor: x is NaN so misuse fails loudly
-        return _nan_like_solution(bd, ad), jnp.asarray(-1, jnp.int32), info
-    x, iters, conv = _ir_loop_mesh(ad, bd, lo_solve, max_iter)
-    return to_dense(x), jnp.asarray(iters if conv else -1, jnp.int32), info
+from .dist_refine import (  # noqa: E402  (re-export; see module docstring)
+    gesv_mixed_gmres_mesh,
+    gesv_mixed_mesh,
+    mixed_mesh_route,
+    posv_mixed_gmres_mesh,
+    posv_mixed_mesh,
+)
 
 
 @instrument("getri_mesh")
@@ -578,13 +518,14 @@ def getrf_mesh(
     )
 
 
-@instrument("gesv_mesh")
-def gesv_mesh(
+def _gesv_mesh_plain(
     a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
     opts: Optional[Options] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Distributed general solve with partial pivoting (src/gesv.cc
-    default MethodLU::PartialPiv): factor, permute B, two trsm sweeps."""
+    """The direct factor-at-data-dtype general solve: partial-pivot
+    factor, permute B, two trsm sweeps.  The whole solve under
+    Option.MixedPrecision=off (trace-identical to the pre-mixed driver)
+    and the fallback tier of the mixed ladder."""
     la, bi = _la(opts), _bi(opts)
     lu, perm, info = getrf_mesh(a, mesh, nb, opts)
     bd = from_dense(b, mesh, nb)
@@ -593,3 +534,27 @@ def gesv_mesh(
                   bcast_impl=bi)
     x = trsm_dist(lu, y, Uplo.Upper, Op.NoTrans, lookahead=la, bcast_impl=bi)
     return to_dense(x), info
+
+
+@instrument("gesv_mesh")
+def gesv_mesh(
+    a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
+    opts: Optional[Options] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Distributed general solve with partial pivoting (src/gesv.cc
+    default MethodLU::PartialPiv).  f64 inputs route through the
+    mixed-precision ladder by default — f32 partial-pivot factor + fused
+    f64 refinement, GMRES-IR escalation, full-f64 fallback
+    (Option.MixedPrecision; dist_refine.py) — because on TPU the f32
+    factor runs ~40x the emulated-f64 rate (BENCH_r05).
+    Option.MixedPrecision=off (or non-f64 dtype) runs the direct path,
+    trace-identical to the pre-mixed driver."""
+    from .dist_refine import mixed_mesh_route
+
+    routed = mixed_mesh_route(
+        "gesv", a, b, mesh, nb, opts,
+        lambda: _gesv_mesh_plain(a, b, mesh, nb, opts),
+    )
+    if routed is not None:
+        return routed
+    return _gesv_mesh_plain(a, b, mesh, nb, opts)

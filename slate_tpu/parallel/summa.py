@@ -109,6 +109,114 @@ def gemm_summa(
     return DistMatrix(tiles=out_t, m=a.m, n=b.n, nb=a.nb, mesh=a.mesh)
 
 
+@instrument("gemm_summa_ozaki")
+def gemm_summa_ozaki(
+    alpha,
+    a: DistMatrix,
+    b: DistMatrix,
+    beta=0.0,
+    c: Optional[DistMatrix] = None,
+    lookahead: Optional[int] = None,
+    bcast_impl: Optional[str] = None,
+    n_slices: int = 9,
+) -> DistMatrix:
+    """C := alpha A B + beta C with the product computed by the Ozaki
+    split-integer scheme on block-cyclic tile stacks (ops/ozaki.py taken
+    to the mesh) — the ``Option.ResidualImpl=ozaki`` engine behind the
+    mixed-precision refinement loop.
+
+    Same stationary-C SUMMA k-loop as ``gemm_summa`` (prefetch_bcast
+    pipeline, Option.BcastImpl lowerings): only the payload changes —
+    instead of f64 tile panels, each step broadcasts the panels' int8
+    digit planes, so the per-step wire bytes are exactly
+    ``n_slices/8`` x the f64 panel bytes (proven analytically in
+    tests/test_mixed_mesh.py) and the local update is an exact int32
+    contraction feeding an f64 weighted accumulation (one rounding f64
+    add per slice per step — residual-grade; see
+    ozaki.accumulate_diag_planes).  The digit grids come from
+    GLOBAL per-row maxima (one pmax per operand, before the loop), and
+    the per-step summation order is fixed by the logical k order, so
+    results are BITWISE identical across mesh shapes — padded tiles and
+    padded k-steps contribute exact zeros (TwoSum identity).
+
+    f64 only (the Ozaki split is an f64 error-free transformation);
+    ``n_slices=9`` is full f64 accuracy, 6 the faster ~2^-33 tier."""
+    p, q = mesh_shape(a.mesh)
+    if a.dtype != jnp.float64 or b.dtype != jnp.float64:
+        raise TypeError(
+            f"gemm_summa_ozaki requires f64 operands, got {a.dtype}, {b.dtype}"
+        )
+    if b.grid != (p, q) or b.nb != a.nb:
+        raise ValueError("gemm_summa_ozaki operands must share mesh and nb")
+    if a.n != b.m or a.nt != b.mt:
+        raise ValueError(f"inner dims mismatch: A is {a.m}x{a.n}, B {b.m}x{b.n}")
+    if c is not None and (c.m != a.m or c.n != b.n or c.nb != a.nb or c.grid != (p, q)):
+        raise ValueError("C dims/layout must match alpha*A@B")
+    from .comm import la_depth, resolve_bcast_impl
+
+    ctiles = None if c is None else c.tiles
+    out_t = _summa_ozaki_jit(
+        a.tiles, b.tiles, ctiles, alpha, beta, a.mesh, p, q, a.nt,
+        la_depth(lookahead, a.nt), resolve_bcast_impl(bcast_impl), n_slices,
+    )
+    return DistMatrix(tiles=out_t, m=a.m, n=b.n, nb=a.nb, mesh=a.mesh)
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _summa_ozaki_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, la, bi, n_slices):
+    from ..ops import ozaki
+    from .comm import bcast_impl_scope, prefetch_bcast
+
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(a_loc, b_loc):
+        # a_loc: (mtl, ktl, nb, nb) f64; b_loc: (ktl2, ntl, nb, nb) f64
+        mtl, _, nb, _ = a_loc.shape
+        ntl = b_loc.shape[1]
+
+        # global digit grids: per-row (A) / per-column (B) f32 maxima of
+        # the hi components, reduced over the mesh axis that shards the
+        # contraction — every device then slices on the same grid, which
+        # is what makes the planes (and the product) mesh-shape-invariant
+        amax = lax.pmax(
+            jnp.max(jnp.abs(a_loc), axis=(1, 3)).astype(jnp.float32), COL_AXIS
+        )  # (mtl, nb): full-row max of my local rows
+        bmax = lax.pmax(
+            jnp.max(jnp.abs(b_loc), axis=(0, 2)).astype(jnp.float32), ROW_AXIS
+        )  # (ntl, nb): full-column max of my local columns
+        ea = ozaki.row_exp_from_absmax(amax)                   # (mtl, nb)
+        eb = ozaki.row_exp_from_absmax(bmax)                   # (ntl, nb)
+        qa = ozaki.split_tiles(a_loc, ea[:, None, :, None], n_slices)
+        qb = ozaki.split_tiles(b_loc, eb[None, :, None, :], n_slices)
+
+        def fetch(k):
+            # the gemm_summa panel broadcasts, payload = int8 digit planes
+            qa_pan = lax.dynamic_slice_in_dim(qa, k // q, 1, axis=2)[:, :, 0]
+            acol = _bcast_from_col(qa_pan, k % q)     # (S, mtl, nb, nb) i8
+            qb_pan = lax.dynamic_slice_in_dim(qb, k // p, 1, axis=1)[:, 0]
+            brow = _bcast_from_row(qb_pan, k % p)     # (S, ntl, nb, nb) i8
+            return acol, brow
+
+        def consume(k, panels, acc):
+            acol, brow = panels
+            return ozaki.accumulate_diag_planes(acc, acol, brow, n_slices)
+
+        acc0 = jnp.zeros((mtl, ntl, nb, nb), jnp.float64)
+        acc = prefetch_bcast(kt, la, fetch, consume, acc0)
+        sa = ozaki.exp2_scale_f64(ea)[:, None, :, None]   # (mtl, 1, nb, 1)
+        sb = ozaki.exp2_scale_f64(eb)[None, :, None, :]   # (1, ntl, 1, nb)
+        return ozaki.scale_rows_cols_f64(acc, sa, sb)
+
+    with bcast_impl_scope(bi):
+        prod = shard_map_compat(
+            kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec,
+            check_vma=False,
+        )(at, bt)
+    if ct is None:
+        return (alpha * prod).astype(at.dtype)
+    return (alpha * prod + beta * ct).astype(at.dtype)
+
+
 def _gemm_summa_a(alpha, a: DistMatrix, b: DistMatrix, beta, c) -> DistMatrix:
     """Stationary-A SUMMA (slate::gemmA, src/gemmA.cc:1-60 semantics):
     A's tiles never move; the (thin) B is replicated to every device with

@@ -255,6 +255,28 @@ class Option(enum.Enum):
     # (QR panels are bitwise); parity is gated by
     # tests/test_pallas_panels.py under interpret mode.
     PanelImpl = "panel_impl"
+    # Mixed-precision routing for the distributed f64 solves
+    # (parallel/dist_refine.py): "off" (factor at the data dtype — trace-
+    # identical to the direct gesv_mesh/posv_mesh path), "ir" (f32 mesh
+    # factor + fused on-device f64 iterative refinement, then the full-f64
+    # fallback on non-convergence), "gmres" (f32 factor preconditioning
+    # distributed restarted GMRES, then fallback), or "auto" (the default:
+    # the escalation ladder IR -> GMRES-IR -> full-f64 fallback for real
+    # f64 inputs — the reference's gesv_mixed/posv_mixed stance made the
+    # DEFAULT because on TPU the f32:f64 factor gap is ~40x, not ~2x).
+    # Resolution order: explicit option > dist_refine.use_mixed context >
+    # SLATE_TPU_MIXED environment > auto.
+    MixedPrecision = "mixed_precision"
+    # Residual lowering for the mixed-precision refinement loop: "f64"
+    # (plain SUMMA at the data dtype — XLA's emulated-f64 pairs on TPU),
+    # "ozaki" (the int8 split-integer SUMMA: digit planes of A and X ride
+    # the unchanged broadcast schedule at slice_count/8 x the f64 panel
+    # bytes and the MXU integer rate), or "auto" (ozaki on a real TPU
+    # backend, f64 elsewhere).  Both are f64-grade accurate; ozaki is
+    # bitwise-reproducible across mesh shapes (fixed split + summation
+    # order).  Resolution order: explicit option >
+    # SLATE_TPU_RESIDUAL_IMPL environment > auto.
+    ResidualImpl = "residual_impl"
 
 
 Options = Mapping[Union[Option, str], Any]
