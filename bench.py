@@ -13,8 +13,13 @@ Prints the driver-facing JSON line {"metric", "value", "unit",
 after the headline and after every finished extra (the last parsable line
 wins, which is what the driver's tail-parser and obs.report's legacy
 loader read), and the same line is atomically rewritten to
-``bench_partial.json`` next to this file — so a timeout kill (rc=124,
+``bench_partial.json`` next to this file (override:
+``SLATE_TPU_BENCH_PARTIAL``) — so a timeout kill (rc=124,
 BENCH_r05.json's failure mode) never loses already-measured numbers.
+An atexit hook re-emits the last complete line on EVERY exit path
+(SIGTERM handler, unhandled exception, SystemExit), so only an outright
+SIGKILL can end stdout without a parseable line — and the partial file
+covers that (unit-tested: tests/test_bench_kill.py).
 ``SLATE_TPU_BENCH_TIMEOUT`` (seconds; unset = 600, an explicit 0 = off)
 is a wall-clock budget: extras that would start past it are skipped with a
 reason, and a SIGALRM guard aborts a mid-flight extra at the deadline
@@ -382,13 +387,35 @@ def _timeit_perturbed(fn, a, *rest, reps=2):
     return best
 
 
+import atexit
 import contextlib
 import signal
 
 
-_PARTIAL_PATH = _os.path.join(
+_PARTIAL_PATH = _os.environ.get("SLATE_TPU_BENCH_PARTIAL") or _os.path.join(
     _os.path.dirname(_os.path.abspath(__file__)), "bench_partial.json"
 )
+
+# the last complete result line, re-emitted by the atexit hook so ANY
+# exit path after the headline (SIGTERM handler, an unhandled exception,
+# a SystemExit from a failed extra) still ends stdout with a parseable
+# line — BENCH_r05 died rc=124 with parsed=null because the kill landed
+# where no line had been flushed.  A SIGKILL (timeout -k's second shot)
+# skips atexit by definition; the atomically-rewritten partial file from
+# the last _emit is the survivor there.
+_LAST_LINE = [None]
+_ATEXIT_ARMED = [False]
+
+
+def _atexit_reemit():
+    if _LAST_LINE[0]:
+        print(_LAST_LINE[0], flush=True)
+
+
+def _arm_atexit():
+    if not _ATEXIT_ARMED[0]:
+        atexit.register(_atexit_reemit)
+        _ATEXIT_ARMED[0] = True
 
 
 def _bench_line(gflops, extras):
@@ -406,8 +433,11 @@ def _bench_line(gflops, extras):
 def _emit(gflops, extras):
     """Emit the CURRENT full result line: stdout (last line wins for the
     driver's tail parser) + an atomic rewrite of bench_partial.json, so
-    every completed metric survives a timeout kill."""
+    every completed metric survives a timeout kill.  Also arms the
+    atexit re-emit so any exit path flushes a final parseable line."""
     line = _bench_line(gflops, extras)
+    _LAST_LINE[0] = line
+    _arm_atexit()
     print(line, flush=True)
     try:
         tmp = _PARTIAL_PATH + ".tmp"
@@ -547,6 +577,7 @@ def main():
     _emit(gflops, extras)  # final line carries the derived ratios too
     _emit_obs_report(gflops, extras)
     _emit_flight_report()
+    _emit_mem_report()
 
 
 def _emit_obs_report(gflops, extras):
@@ -605,5 +636,62 @@ def _emit_flight_report():
         _progress(f"flight report failed: {e!r}")
 
 
+def _emit_mem_report():
+    """Memory-observability twin (ISSUE 9): when SLATE_TPU_OBS_MEM=<path>
+    is set, run the memwatch pass (AOT memory analysis + MemoryModel
+    comparison + donation-alias verification) for a small mesh potrf on
+    the available devices and write the mem.* RunReport there — the
+    compile-analysis keys are the machine-independent regression surface
+    next to the headline numbers."""
+    path = _os.environ.get("SLATE_TPU_OBS_MEM")
+    if not path:
+        return
+    try:
+        import jax as _jax
+
+        from slate_tpu.obs import memwatch as _memwatch
+        from slate_tpu.parallel import make_mesh as _make_mesh
+
+        devs = _jax.devices()
+        if len(devs) >= 8:
+            mesh = _make_mesh(2, 4, devices=devs[:8])
+        else:
+            mesh = _make_mesh(1, len(devs), devices=devs)
+        rep = _memwatch.run_memwatch("potrf", n=256, nb=32, mesh=mesh,
+                                     with_donations=False)
+        _memwatch.write_mem_report(path, rep)
+        v = rep["values"]
+        _progress(
+            f"mem report written to {path} (temp "
+            f"{v['mem.temp_bytes']:,.0f} B/dev, model err "
+            f"{v['mem.model_err_frac']:.1%})")
+    except Exception as e:  # the headline line must never die on obs
+        _progress(f"mem report failed: {e!r}")
+
+
+def _selftest_kill():
+    """Hidden harness for tests/test_bench_kill.py: emit a headline,
+    register the SIGTERM/atexit emission machinery exactly as main()
+    does, then block mid-'extra' until the test delivers SIGTERM — the
+    rc=124 kill path must still end stdout with a parseable line and a
+    parseable partial file."""
+    gflops = 1.0
+    extras = {"selftest": 1}
+    _emit(gflops, extras)
+
+    def _reemit_on_term(signum, frame):
+        _progress("SIGTERM: re-emitting final line and exiting")
+        _emit(gflops, extras)
+        raise SystemExit(124)
+
+    signal.signal(signal.SIGTERM, _reemit_on_term)
+    print("SELFTEST_READY", file=sys.stderr, flush=True)
+    while True:  # mid-extra: blocked until the kill arrives
+        time.sleep(0.05)
+
+
 if __name__ == "__main__":
-    main()
+    if "--selftest-kill" in sys.argv:
+        _selftest_kill()
+    else:
+        main()

@@ -57,6 +57,28 @@ python -m slate_tpu.obs.report --check \
     artifacts/obs/flight_potrf.flight.json --threshold 4 \
     --ignore 'sched.*_s' --ignore 'sched.overlap_eff'
 
+# memwatch smoke (ISSUE 9): the HBM memory observability layer — AOT
+# compile memory analysis of summa + potrf on the 8-device mesh must
+# match the analytic MemoryModel within 10%, every donation-registry
+# entry must MEASURABLY alias in its compiled executable, and the mem
+# gate must trip on a seeded donation loss.  The fresh reports then gate
+# against the committed references on the compile-analysis keys only
+# (arg/out/temp/alias bytes + model + donation fracs are
+# machine-independent at fixed shape); the runtime live/allocator keys
+# depend on what else the runner holds live, so they are --ignore'd —
+# as is model_err_frac, a near-zero ratio the smoke already bounds at
+# 10% absolute (ratio-gating 0.008 vs 0.015 would flake on benign XLA
+# buffer-assignment shifts while the byte keys catch any real change).
+python -m slate_tpu.obs.memwatch --smoke --out artifacts/obs_mem
+python -m slate_tpu.obs.report --check \
+    artifacts/obs_mem/mem_summa.report.json \
+    artifacts/obs/mem_summa.report.json \
+    --ignore 'mem.*_runtime_*' --ignore 'mem.model_err_frac'
+python -m slate_tpu.obs.report --check \
+    artifacts/obs_mem/mem_potrf.report.json \
+    artifacts/obs/mem_potrf.report.json \
+    --ignore 'mem.*_runtime_*' --ignore 'mem.model_err_frac'
+
 # scaling-curve artifact (ISSUE 7 satellite): fold the MULTICHIP round
 # artifacts into one RunReport-schema curve and schema-validate it
 # through the standard CLI (the committed twin lives at

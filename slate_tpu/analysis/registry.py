@@ -561,6 +561,50 @@ def _trsm_psum(ctx):
     ), (a, b)
 
 
+def _chase_operands(ctx):
+    import numpy as np
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    n, w = N, NB
+    nsweeps, hops = n - 2, -(-(n - 1) // w)
+    vs = jnp.asarray(rng.standard_normal((nsweeps, hops, w)))
+    taus = jnp.asarray(rng.standard_normal((nsweeps, hops)))
+    z = jnp.asarray(rng.standard_normal((n, n)))
+    return vs, taus, z, n, w
+
+
+@register("chase_apply_dist", tags=("bcast",))
+def _chase_apply(ctx):
+    """The stage-2 back-transform's block broadcast (ISSUE 9 satellite):
+    formerly the last waived tuple-axis masked psum, now a two-hop
+    rooted broadcast through the engine — under the gate at the default
+    lowering (auto → doubling on the 2x4 grid)."""
+    from ..parallel.dist_twostage import chase_apply_dist
+
+    vs, taus, z, n, w = _chase_operands(ctx)
+    return (lambda v, t, zz: chase_apply_dist(v, t, zz, n, w, ctx.mesh)), \
+        (vs, taus, z)
+
+
+@register("chase_apply_dist_psum", tags=("bcast",))
+def _chase_apply_psum(ctx):
+    from ..parallel.dist_twostage import chase_apply_dist
+
+    vs, taus, z, n, w = _chase_operands(ctx)
+    return (lambda v, t, zz: chase_apply_dist(
+        v, t, zz, n, w, ctx.mesh, bcast_impl="psum")), (vs, taus, z)
+
+
+@register("chase_apply_dist_ring", tags=("bcast",))
+def _chase_apply_ring(ctx):
+    from ..parallel.dist_twostage import chase_apply_dist
+
+    vs, taus, z, n, w = _chase_operands(ctx)
+    return (lambda v, t, zz: chase_apply_dist(
+        v, t, zz, n, w, ctx.mesh, bcast_impl="ring")), (vs, taus, z)
+
+
 # ---------------------------------------------------------------------------
 # observability wrappers (ISSUE 2): the same kernels traced WITH obs on
 # ---------------------------------------------------------------------------

@@ -318,13 +318,15 @@ def potrf_left_looking_staged(
 ) -> jax.Array:
     """Left-looking f64 Cholesky with ONE DONATED XLA PROGRAM PER PANEL.
 
-    The fused single-program form keeps ~5 live copies of the matrix
+    The fused single-program form keeps ~7 live copies of the matrix
     (XLA's buffer assignment across the unrolled panel chain: measured
-    14.4 GB peak for the 2 GB n = 16384 problem), which OOMs v5e at
-    n = 32768 (8 GB matrix).  Dispatching each panel as its own jit with
-    the matrix donated caps peak HBM at one matrix + one panel's
-    transients.  Call EAGERLY (under an outer jit the stages inline and
-    the fused-liveness problem returns) — cf. eig.heev_staged.
+    14.4 GB peak for the 2 GB n = 16384 problem — the calibration point
+    of ``obs.memmodel.FUSED_LL_COPIES``), which OOMs v5e at n = 32768
+    (8 GB matrix).  Dispatching each panel as its own jit with the
+    matrix donated caps peak HBM at one matrix + one panel's transients
+    (``memmodel.potrf_staged_peak``).  Call EAGERLY (under an outer jit
+    the stages inline and the fused-liveness problem returns) — cf.
+    eig.heev_staged.
 
     ``donate=True`` CONSUMES the caller's array (required at n = 32768 on
     v5e: a defensive copy next to the 8 GB input would itself OOM; the
@@ -368,9 +370,11 @@ def _potrf_ll_ozaki(a: jax.Array, nb: Optional[int] = None, n_slices: Optional[i
     panel solve, not the digit tail; test_chol.py gates both); above
     n = 8192 the default is S = 10 (+22% MXU work), which covers even the
     mass-spread worst case where the bound's slack exceeds one 6-bit plane.
-    Cache memory is S n^2 bytes (2.7 GB at n = 16384, S = 10) — the
-    dispatch in potrf_array gates this path to sizes where cache + matrix
-    fit HBM and falls back to the split-per-call form above.
+    Peak HBM is modeled by ``obs.memmodel.potrf_ozaki_cache_peak``: the
+    S n^2 int8 plane cache next to ~4 full f64 buffers — the dispatch in
+    potrf_array gates this path to sizes where
+    ``memmodel.potrf_f64_form`` says cache + matrix fit the HBM budget
+    and falls back to the split-per-call form above.
 
     Same math as the reference potrf task graph read column-wise
     (src/potrf.cc:91-196); the digit cache is the TPU-native analogue of
@@ -423,16 +427,23 @@ def _potrf_ll_ozaki(a: jax.Array, nb: Optional[int] = None, n_slices: Optional[i
 
 _POTRF_SCAN_MIN_N = 16384  # above this the recursive trace is too large
 _POTRF_LL_MIN_N = 4096  # f64/c128: left-looking beats recursion from here
-# Digit-cache ceiling: S n^2 int8 cache + ~4 f64 n^2 buffers must fit v5e's
-# 15.75G HBM.  At the S = 10 default (n > 8192) the 16384 case is
-# 2.7G + 8.6G = 11.3G (validated on chip); 20480 would be 4.2G + 13.4G,
-# over budget, so the ceiling is 16384 and larger sizes take the
-# split-per-call in-place form.
-_POTRF_OZCACHE_MAX_N = 16384
 
 
 def _is_f64(dtype) -> bool:
     return dtype in (jnp.dtype(jnp.float64), jnp.dtype(jnp.complex128))
+
+
+def _potrf_f64_form(n: int, concrete: bool, ozaki_dispatch: bool,
+                    itemsize: int = 8) -> str:
+    """ozaki | staged | fused for one big-f64/c128 factorization, by
+    MODELED peak HBM against the live budget — the hand-computed
+    digit-cache / staged ceilings this module used to hard-code.  The
+    routing rules and their on-chip calibration points are documented at
+    the single source, ``obs.memmodel.potrf_f64_form``."""
+    from ..obs import memmodel
+
+    return memmodel.potrf_f64_form(n, concrete, ozaki_dispatch,
+                                   itemsize=itemsize)
 
 
 @instrument("potrf_array")
@@ -444,28 +455,25 @@ def potrf_array(a: jax.Array, uplo: Uplo = Uplo.Lower) -> Tuple[jax.Array, jax.A
     if _is_f64(a.dtype) and a.shape[0] >= _POTRF_LL_MIN_N:
         # f64 rides the left-looking form: large-k updates hit the Ozaki
         # dispatch win region (measured 235 vs 211 GF/s at n=8192, 569
-        # GF/s at 16384 vs 82 for the right-looking scan, v5e round 4)
+        # GF/s at 16384 vs 82 for the right-looking scan, v5e round 4).
+        # Which left-looking variant is a MEMORY decision, made by the
+        # analytic model against the HBM budget (_potrf_f64_form).
         from ..ops.matmul import _F64_DISPATCH, _tpu_is_default
 
-        if (
+        ozaki_ok = (
             a.dtype == jnp.dtype(jnp.float64)
-            and a.shape[0] <= _POTRF_OZCACHE_MAX_N
             and _F64_DISPATCH["ozaki"]
             and _tpu_is_default()
-        ):
+        )
+        form = _potrf_f64_form(
+            a.shape[0], not isinstance(full, jax.core.Tracer), ozaki_ok,
+            itemsize=jnp.dtype(a.dtype).itemsize,  # c128 peaks 2x f64
+        )
+        if form == "ozaki":
             l = _potrf_ll_ozaki(full)
-        elif a.shape[0] > _POTRF_OZCACHE_MAX_N and not isinstance(
-            full, jax.core.Tracer
-        ):
-            # ADVICE r5: the fused left-looking form keeps ~5 live copies
-            # of the matrix (XLA buffer assignment across the unrolled
-            # panel chain) and OOMs v5e at n = 32768; the staged variant
-            # dispatches one donated program per panel, capping peak HBM
-            # at one matrix + panel transients.  Staged dispatch is eager
-            # only — under an outer jit the stages would inline and the
-            # fused-liveness problem returns, so tracers keep the fused
-            # form.  ``full`` is the symmetrize intermediate owned here,
-            # so donating it never touches the caller's array.
+        elif form == "staged":
+            # ``full`` is the symmetrize intermediate owned here, so
+            # donating it never touches the caller's array.
             l = potrf_left_looking_staged(full, donate=True)
         else:
             l = _potrf_left_looking(full)

@@ -467,7 +467,8 @@ def _chase_sweep_apply(
         vj = lax.dynamic_slice(vs, (jl, 0, 0), (1, max_hops, w))[0].astype(z.dtype)
         tj = lax.dynamic_slice(taus, (jl, 0), (1, max_hops))[0].astype(z.dtype)
         cj = tj if adjoint else jnp.conj(tj)
-        coef = jnp.einsum("hw,hwr->hr", jnp.conj(vj), slab)
+        coef = jnp.einsum("hw,hwr->hr", jnp.conj(vj), slab,
+                          precision=lax.Precision.HIGHEST)
         slab = slab - cj[:, None, None] * vj[:, :, None] * coef[:, None, :]
         return lax.dynamic_update_slice(zp, slab.reshape(span, nrhs), (j + 1, 0))
 

@@ -20,6 +20,13 @@ map) fused with xprof-style annotation:
   through, plus the ``python -m slate_tpu.obs.report`` CLI with
   ``--check`` regression gating against prior reports / BENCH_*.json.
 - ``python -m slate_tpu.obs.smoke`` is the CI acceptance run.
+- ``memory`` / ``memmodel`` / ``memwatch`` are the HBM observability
+  layer (ISSUE 9): AOT compile-time memory analysis + donation-alias
+  verification + live sampling at span boundaries + OOM forensics on
+  the measured side, a closed-form per-device peak model
+  (``MemoryModel``, ``predict_max_n``) on the analytic side, and
+  ``python -m slate_tpu.obs.memwatch`` emitting the committed ``mem.*``
+  regression artifacts.
 """
 
 # NOTE: perfetto/report are deliberately NOT imported here so that

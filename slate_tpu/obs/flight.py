@@ -98,6 +98,10 @@ class FlightRecorder:
         self.events: List[StepEvent] = []
         self.hop_events: List[dict] = []  # {op, k, phase, t_s, hops: [...]}
         self.runs: List[dict] = []
+        # obs.memory samples taken after each fenced dispatch while
+        # memory sampling is active (ISSUE 9): the per-device Perfetto
+        # memory counter track beside the flight Gantt
+        self.mem_samples: List[dict] = []
 
     def record_phase(self, op, k, phase, t0, t1, nbytes, flops, coords,
                      hops=None, root_k=None) -> None:
@@ -126,6 +130,7 @@ class FlightRecorder:
         self.events.clear()
         self.hop_events.clear()
         self.runs.clear()
+        self.mem_samples.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -246,6 +251,16 @@ class _Phase:
             rec.record_phase(self.op, k, self.phase, t0, t1, self.bytes,
                              self.flops, coords, hops=self.hops,
                              root_k=root_k)
+            from . import memory as _memory
+
+            if (_memory.sampling_active()
+                    and len(rec.mem_samples) < _memory._SAMPLE_CAP):
+                try:
+                    s = _memory.sample(f"flight:{self.op}:{self.phase}")
+                    rec.mem_samples.append(
+                        dict(s, k=int(k), phase=self.phase, op=self.op))
+                except Exception:
+                    pass
         return out
 
 
@@ -919,6 +934,13 @@ def run_flight(op: str, n: int = 96, nb: int = 8, depth: Optional[int] = None,
          "t0_s": h["t0"] - base, "t1_s": h["t1"] - base, "hops": h["hops"]}
         for h in rec.hop_events
     ]
+    mem_samples = [
+        {"t_s": s["t"] - base, "k": s.get("k", 0),
+         "phase": s.get("phase", ""), "live_bytes": s.get("live_bytes", 0.0),
+         "live_per_device": s.get("live_per_device") or {},
+         "bytes_in_use": s.get("bytes_in_use") or {}}
+        for s in rec.mem_samples
+    ]
 
     values = {
         "sched.critical_path_s": sched["critical_path_s"],
@@ -943,6 +965,9 @@ def run_flight(op: str, n: int = 96, nb: int = 8, depth: Optional[int] = None,
                    "lookahead": d, "bcast_impl": impl, "nt": nt},
         "events": events,
         "hop_events": hop_events,
+        # present (non-empty) when obs memory sampling was active during
+        # the flight: the Perfetto memory counter track's data
+        "mem_samples": mem_samples,
         "model": {
             "calibration": cal,
             "phase_bytes": dict(model.phase_bytes),
@@ -1032,7 +1057,7 @@ def _smoke(out_dir: str) -> int:
     schema-valid FlightReports whose modeled bytes match a fresh
     comm-audit capture, Perfetto export validates with per-device tracks
     and hop flow events, and overlap_eff separates depth 1 from depth 0."""
-    from . import perfetto
+    from . import memory, perfetto
 
     os.makedirs(out_dir, exist_ok=True)
     failures: List[str] = []
@@ -1040,8 +1065,12 @@ def _smoke(out_dir: str) -> int:
     for op in ("summa", "potrf"):
         reports = {}
         for impl in ("psum", "ring"):
-            rep = run_flight(op, n=n, nb=nb, depth=1, bcast_impl=impl,
-                             hops=(impl == "ring"))
+            # memory sampling forced on (ISSUE 9): every fenced dispatch
+            # also records a live-buffer sample, so the exported trace
+            # carries the per-device memory counter track
+            with memory.force_sampling():
+                rep = run_flight(op, n=n, nb=nb, depth=1, bcast_impl=impl,
+                                 hops=(impl == "ring"))
             errs = validate_flight_report(rep)
             if errs:
                 failures.append(f"{op}/{impl} schema: {errs[:4]}")
@@ -1066,7 +1095,8 @@ def _smoke(out_dir: str) -> int:
         write_flight_report(path, rep)
         trace_path = os.path.join(out_dir, f"flight_{op}.trace.json")
         tr = perfetto.flight_chrome_trace(rep["events"], rep["hop_events"],
-                                          grid=(2, 4))
+                                          grid=(2, 4),
+                                          mem_samples=rep.get("mem_samples"))
         with open(trace_path, "w") as f:
             json.dump(tr, f, indent=1)
         errs = perfetto.validate_chrome_trace(tr)
@@ -1077,6 +1107,9 @@ def _smoke(out_dir: str) -> int:
             failures.append(f"{op} trace has {len(tids)} device tracks (< 8)")
         if not any(e.get("ph") == "s" for e in tr["traceEvents"]):
             failures.append(f"{op} trace has no hop flow events")
+        if not any(e.get("ph") == "C" and e.get("name", "").startswith("mem.")
+                   for e in tr["traceEvents"]):
+            failures.append(f"{op} trace has no memory counter track")
         print(f"obs.flight smoke: {op} ok — overlap_eff(la1)="
               f"{rep['sched']['overlap_eff']:.3f} vs la0="
               f"{rep['sched']['overlap_eff_la0']:.3f}, "
@@ -1144,7 +1177,8 @@ def main(argv=None) -> int:
 
         tr = perfetto.flight_chrome_trace(
             rep["events"], rep["hop_events"],
-            grid=tuple(int(x) for x in rep["config"]["grid"].split("x")))
+            grid=tuple(int(x) for x in rep["config"]["grid"].split("x")),
+            mem_samples=rep.get("mem_samples"))
         with open(args.trace, "w") as f:
             json.dump(tr, f, indent=1)
         print(f"  wrote {args.trace}")

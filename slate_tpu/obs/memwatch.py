@@ -1,0 +1,336 @@
+"""memwatch: the mem.* artifact CLI — AOT memory analysis + MemoryModel
+validation + donation-alias verification for the mesh kernels.
+
+CLI::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+        python -m slate_tpu.obs.memwatch <op> [--n 96] [--nb 8] \\
+            [--depth 1] [--impl ring] [--out MEM.report.json]
+    python -m slate_tpu.obs.memwatch --smoke [--out artifacts/obs]
+
+``<op>`` is one of summa / potrf / getrf_nopiv.  The emitted artifact is
+an ordinary RunReport whose headline ``values`` carry the ``mem.*``
+keys:
+
+- ``mem.arg/out/temp/alias_bytes`` — XLA's compile-time buffer
+  assignment (machine-independent at fixed shape: the regression gate
+  for the lost-donation / extra-copy bug class),
+- ``mem.model_workspace/peak_bytes`` + ``mem.model_err_frac`` — the
+  analytic MemoryModel next to the measured numbers,
+- ``mem.donation_alias_frac`` (+ one key per donation-registry entry) —
+  measured aliasing of every donated operand; a silently-dropped
+  ``donate_argnums`` collapses the frac to 0 and fails
+  ``obs.report --check`` against the committed artifact,
+- ``mem.*_runtime_*`` — live-buffer / allocator peaks from one
+  instrumented run (machine-dependent; CI gates with
+  ``--ignore 'mem.*_runtime_*'``).
+
+``--smoke`` is the CI acceptance run: summa + potrf at the tier-1 shape,
+schema-valid reports, model within 10% of measured temps, every
+donation-registry entry fully aliased, and the ``--check`` gate proven
+to pass an unchanged report while flagging a seeded donation loss.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import sys
+from typing import Dict, Optional
+
+MEM_OPS = ("summa", "potrf", "getrf_nopiv")
+MODEL_TOL = 0.10  # acceptance: modeled workspace within 10% of measured
+
+
+def _mesh_default():
+    import jax
+
+    from ..parallel import make_mesh
+
+    devs = jax.devices("cpu")
+    if len(devs) < 8:
+        raise RuntimeError(
+            f"memwatch needs 8 CPU devices, have {len(devs)} — set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    return make_mesh(2, 4, devices=devs[:8])
+
+
+def _build_case(op: str, n: int, nb: int, mesh, depth: int, impl: str,
+                seed: int = 0):
+    """(fn over tile stacks, args) for one mesh kernel — the AOT surface
+    ``aot_memory_analysis`` lowers.  Mirrors obs.flight._build_case but
+    exposes the raw-jit-arg form memory_analysis needs."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..parallel.dist import DistMatrix, from_dense
+
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    if op == "summa":
+        from ..parallel.summa import gemm_summa
+        from ..types import MethodGemm
+
+        ad = from_dense(jnp.asarray(a), mesh, nb)
+        bd = from_dense(jnp.asarray(
+            rng.standard_normal((n, n)).astype(np.float32)), mesh, nb)
+
+        def fn(at, bt):
+            da = DistMatrix(tiles=at, m=n, n=n, nb=nb, mesh=mesh)
+            db = DistMatrix(tiles=bt, m=n, n=n, nb=nb, mesh=mesh)
+            return gemm_summa(1.0, da, db, method=MethodGemm.GemmC,
+                              lookahead=depth, bcast_impl=impl).tiles
+
+        return fn, (ad.tiles, bd.tiles), lambda: gemm_summa(
+            1.0, ad, bd, method=MethodGemm.GemmC, lookahead=depth,
+            bcast_impl=impl)
+    if op == "potrf":
+        from ..parallel.dist_chol import potrf_dist
+
+        spd = (a @ a.T / n + 2 * np.eye(n)).astype(np.float32)
+        ad = from_dense(jnp.asarray(spd), mesh, nb, diag_pad_one=True)
+
+        def fn(at):
+            da = DistMatrix(tiles=at, m=n, n=n, nb=nb, mesh=mesh,
+                            diag_pad=True)
+            l, info = potrf_dist(da, lookahead=depth, bcast_impl=impl)
+            return l.tiles, info
+
+        return fn, (ad.tiles,), lambda: potrf_dist(
+            ad, lookahead=depth, bcast_impl=impl)
+    if op == "getrf_nopiv":
+        from ..parallel.dist_lu import getrf_nopiv_dist
+
+        dd = (np.tril(a) + n * np.eye(n)
+              + np.triu(rng.standard_normal((n, n)), 1)).astype(np.float32)
+        ad = from_dense(jnp.asarray(dd), mesh, nb, diag_pad_one=True)
+
+        def fn(at):
+            da = DistMatrix(tiles=at, m=n, n=n, nb=nb, mesh=mesh,
+                            diag_pad=True)
+            l, info = getrf_nopiv_dist(da, lookahead=depth, bcast_impl=impl)
+            return l.tiles, info
+
+        return fn, (ad.tiles,), lambda: getrf_nopiv_dist(
+            ad, lookahead=depth, bcast_impl=impl)
+    raise ValueError(f"unknown memwatch op {op!r}; expected {MEM_OPS}")
+
+
+def donation_values(ctx=None) -> Dict[str, float]:
+    """Measured donation aliasing for every donation-registry entry:
+    ``mem.donation_<name>_alias_frac`` per entry plus the min as
+    ``mem.donation_alias_frac``.  1.0 means every donated byte aliases
+    into an output; a dropped donate_argnums collapses it to 0, which
+    ``obs.report --check`` fails as a higher-is-better zero collapse."""
+    from . import memory
+    from ..analysis import registry
+
+    if ctx is None:
+        ctx = registry.make_ctx()
+    vals: Dict[str, float] = {}
+    worst = 1.0
+    for name, spec in sorted(registry.DONATIONS.items()):
+        fn, args, donate = spec.build(ctx)
+        donated, aliased = memory.donation_alias_bytes(fn, args, donate)
+        frac = aliased / donated if donated > 0 else 0.0
+        vals[f"mem.donation_{name}_alias_frac"] = frac
+        worst = min(worst, frac)
+    vals["mem.donation_alias_frac"] = worst
+    return vals
+
+
+def run_memwatch(op: str, n: int = 96, nb: int = 8, depth: int = 1,
+                 bcast_impl: str = "ring", mesh=None,
+                 with_donations: bool = True,
+                 with_runtime: bool = True) -> dict:
+    """One memwatch pass: AOT memory analysis of the fused kernel,
+    MemoryModel comparison, donation-registry aliasing, and a sampled
+    instrumented run.  Returns the RunReport dict."""
+    import jax
+
+    from . import memory, memmodel, report
+    from ..parallel.mesh import mesh_shape
+
+    if mesh is None:
+        mesh = _mesh_default()
+    p, q = mesh_shape(mesh)
+    fn, args, run = _build_case(op, n, nb, mesh, depth, bcast_impl)
+    measured = memory.aot_memory_analysis(fn, *args)
+    if measured is None:
+        raise RuntimeError("backend offers no compile memory_analysis")
+    model = memmodel.MemoryModel(op, n, nb, (p, q), "float32",
+                                 lookahead=depth, bcast_impl=bcast_impl)
+    err = (abs(model.workspace_bytes - measured["temp_bytes"])
+           / max(measured["temp_bytes"], 1.0))
+    values: Dict[str, float] = {
+        "mem.arg_bytes": measured["arg_bytes"],
+        "mem.out_bytes": measured["out_bytes"],
+        "mem.temp_bytes": measured["temp_bytes"],
+        "mem.alias_bytes": measured["alias_bytes"],
+        "mem.peak_bytes": measured["peak_bytes"],
+        "mem.model_workspace_bytes": float(model.workspace_bytes),
+        "mem.model_peak_bytes": float(model.peak_bytes),
+        "mem.model_err_frac": err,
+    }
+    if with_donations:
+        values.update(donation_values())
+    if with_runtime:
+        # one instrumented execution with live sampling forced on: the
+        # machine-dependent runtime keys (CI --ignore 'mem.*_runtime_*')
+        from . import span as _span
+
+        with _span.force_enabled(), memory.force_sampling():
+            out = run()
+            jax.block_until_ready(jax.tree_util.tree_leaves(out))
+            s = memory.sample(f"memwatch_{op}")
+        # op-qualified so the CI glob --ignore 'mem.*_runtime_*' strips
+        # exactly these machine-dependent keys
+        values[f"mem.{op}_runtime_live_bytes"] = s["live_bytes"]
+        values[f"mem.{op}_runtime_peak_bytes_in_use"] = max(
+            s["peak_bytes_in_use"].values(), default=0.0)
+    rep = report.make_report(
+        f"memwatch_{op}",
+        config={"op": op, "n": n, "nb": nb, "grid": f"{p}x{q}",
+                "lookahead": depth, "bcast_impl": bcast_impl},
+        values=values,
+        include_spans=False,
+    )
+    # the machine-dependent runtime numbers live ONLY in the explicitly
+    # op-qualified mem.*_runtime_* headline keys (CI --ignore's them); the
+    # process-global mem section (live/allocator maxima accumulated by
+    # whatever ran in this process) would re-enter the gate as
+    # un-ignorable mem_* keys, so a memwatch artifact carries it empty
+    rep["mem"] = {}
+    return rep
+
+
+def write_mem_report(path: str, rep: dict) -> str:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(rep, f, indent=1)
+    return path
+
+
+def _smoke(out_dir: str) -> int:
+    from . import report
+
+    os.makedirs(out_dir, exist_ok=True)
+    failures = []
+    mesh = _mesh_default()
+    for op in ("summa", "potrf"):
+        rep = run_memwatch(op, n=96, nb=8, depth=1, bcast_impl="ring",
+                           mesh=mesh)
+        errs = report.validate_report(rep)
+        if errs:
+            failures.append(f"{op} schema: {errs[:4]}")
+        vals = rep["values"]
+        if vals["mem.temp_bytes"] <= 0:
+            failures.append(f"{op}: temp bytes not positive")
+        if vals["mem.model_err_frac"] > MODEL_TOL:
+            failures.append(
+                f"{op}: model workspace off by "
+                f"{vals['mem.model_err_frac']:.1%} (> {MODEL_TOL:.0%}): "
+                f"model {vals['mem.model_workspace_bytes']:,.0f} vs "
+                f"measured {vals['mem.temp_bytes']:,.0f}")
+        if vals["mem.donation_alias_frac"] < 1.0:
+            failures.append(
+                f"{op}: a donation-registry entry does not fully alias "
+                f"(frac {vals['mem.donation_alias_frac']:.3f})")
+        path = os.path.join(out_dir, f"mem_{op}.report.json")
+        write_mem_report(path, rep)
+
+        # the gate must actually trip on a seeded donation loss: an
+        # unchanged report passes, a zeroed alias frac fails
+        import contextlib
+        import io
+
+        lost = copy.deepcopy(rep)
+        for k in lost["values"]:
+            if k.endswith("_alias_frac"):
+                lost["values"][k] = 0.0
+        lost_path = os.path.join(out_dir, f"mem_{op}.lost.json")
+        with open(lost_path, "w") as f:
+            json.dump(lost, f)
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc_same = report.main(
+                ["--check", path, path, "--ignore", "mem.*_runtime_*"])
+            rc_lost = report.main(
+                ["--check", lost_path, path, "--ignore", "mem.*_runtime_*"])
+        os.remove(lost_path)
+        if rc_same != 0:
+            failures.append(f"{op}: --check of an unchanged mem report "
+                            f"exited {rc_same} (want 0)")
+        if rc_lost != 1:
+            failures.append(f"{op}: --check missed the seeded donation "
+                            f"loss (exited {rc_lost}, want 1)")
+        if failures:
+            print(buf.getvalue(), end="")
+        print(f"obs.memwatch smoke: {op} ok — temp "
+              f"{vals['mem.temp_bytes']:,.0f} B/dev, model err "
+              f"{vals['mem.model_err_frac']:.1%}, donation alias "
+              f"{vals['mem.donation_alias_frac']:.2f} -> {path}")
+    if failures:
+        print(f"obs.memwatch smoke: FAILED with {len(failures)} problem(s):")
+        for msg in failures:
+            print(f"  FAIL {msg}")
+        return 1
+    print(f"obs.memwatch smoke: OK — reports in {out_dir}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m slate_tpu.obs.memwatch", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("op", nargs="?", choices=MEM_OPS,
+                    help="mesh kernel to analyze")
+    ap.add_argument("--n", type=int, default=96)
+    ap.add_argument("--nb", type=int, default=8)
+    ap.add_argument("--depth", type=int, default=1)
+    ap.add_argument("--impl", default="ring",
+                    help="bcast impl (psum|ring|doubling|auto)")
+    ap.add_argument("--out", default=None,
+                    help="report path (default artifacts/obs/"
+                         "mem_<op>.report.json; for --smoke: the "
+                         "artifact directory)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI acceptance run (summa + potrf at the "
+                         "tier-1 shape)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)  # registry donation operands
+
+    if args.smoke:
+        return _smoke(args.out or os.path.join("artifacts", "obs"))
+    if not args.op:
+        ap.error("give an op to analyze or --smoke")
+    rep = run_memwatch(args.op, n=args.n, nb=args.nb, depth=args.depth,
+                       bcast_impl=args.impl)
+    out = args.out or os.path.join("artifacts", "obs",
+                                   f"mem_{args.op}.report.json")
+    write_mem_report(out, rep)
+    v = rep["values"]
+    print(f"memwatch {args.op}: arg {v['mem.arg_bytes']:,.0f}  out "
+          f"{v['mem.out_bytes']:,.0f}  temp {v['mem.temp_bytes']:,.0f}  "
+          f"alias {v['mem.alias_bytes']:,.0f} B/dev")
+    print(f"  model workspace {v['mem.model_workspace_bytes']:,.0f} "
+          f"(err {v['mem.model_err_frac']:.1%}), peak "
+          f"{v['mem.model_peak_bytes']:,.0f} B/dev")
+    print(f"  donation alias frac {v.get('mem.donation_alias_frac', 1.0):.2f}")
+    print(f"  wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    # runpy loads this file as __main__; delegate to the canonical module
+    # instance (the obs.flight pattern) so shared module state is single
+    from slate_tpu.obs import memwatch as _canonical
+
+    sys.exit(_canonical.main())

@@ -102,6 +102,16 @@ def chrome_trace_events(
                     "args": {"bytes": link_total},
                 }
             )
+    # memory counter tracks (ISSUE 9): the obs.memory samples recorded at
+    # driver_span boundaries render as Perfetto counter series next to
+    # the span Gantt — live-buffer bytes plus per-device allocator
+    # bytes_in_use where the backend reports them
+    import sys as _sys
+
+    _mem = _sys.modules.get(__package__ + ".memory")
+    if _mem is not None and _mem.SAMPLES:
+        mbase = base if spans else min(s["t"] for s in _mem.SAMPLES)
+        evs.extend(memory_counter_events(_mem.SAMPLES, mbase))
     # shift legacy events into the span timebase when their clock origin
     # is known (and spans exist to define that base)
     shift = (legacy_t0 - base) if (legacy_t0 is not None and spans) else 0.0
@@ -144,9 +154,44 @@ def write_chrome_trace(
     return path
 
 
+def memory_counter_events(samples: Iterable[dict], base: float = 0.0,
+                          tid: int = 0, time_key: str = "t") -> List[dict]:
+    """Counter events (``ph: "C"``) from obs.memory samples: one
+    ``mem.live_bytes`` series plus one ``mem.bytes_in_use[<device>]``
+    series per device that reports allocator stats.  ``time_key``
+    selects absolute perf_counter stamps (``"t"``, rebased by ``base``)
+    or already-relative seconds (``"t_s"``, flight reports)."""
+    evs: List[dict] = []
+    for s in samples:
+        t = s.get(time_key)
+        if t is None:
+            continue
+        ts = max(0.0, (float(t) - (base if time_key == "t" else 0.0))) * _US
+        evs.append(
+            {"name": "mem.live_bytes", "cat": "mem", "ph": "C",
+             "pid": PID, "tid": tid, "ts": ts,
+             "args": {"bytes": s.get("live_bytes", 0.0)}}
+        )
+        for dev, b in sorted((s.get("bytes_in_use") or {}).items()):
+            evs.append(
+                {"name": f"mem.bytes_in_use[{dev}]", "cat": "mem",
+                 "ph": "C", "pid": PID, "tid": tid, "ts": ts,
+                 "args": {"bytes": b}}
+            )
+        for dev, b in sorted((s.get("live_per_device") or {}).items()):
+            evs.append(
+                {"name": f"mem.live_bytes[{dev}]", "cat": "mem",
+                 "ph": "C", "pid": PID, "tid": tid, "ts": ts,
+                 "args": {"bytes": b}}
+            )
+    return evs
+
+
 def flight_trace_events(events: Iterable[dict],
                         hop_events: Optional[Iterable[dict]] = None,
-                        grid: Optional[tuple] = None) -> List[dict]:
+                        grid: Optional[tuple] = None,
+                        mem_samples: Optional[Iterable[dict]] = None
+                        ) -> List[dict]:
     """Per-device Gantt of a flight timeline (obs.flight): one track per
     mesh coordinate, one complete event per fenced phase dispatch, and
     flow arrows (``ph: s``/``f``) from the broadcast owner to each hop
@@ -222,12 +267,19 @@ def flight_trace_events(events: Iterable[dict],
                                           "k": he["k"]}))
                     evs.append(dict(common, ph="f", bp="e", tid=tid(*d_rc),
                                     ts=te, args={}))
+    # per-device memory counter track beside the Gantt (ISSUE 9): flight
+    # mem samples carry report-relative t_s stamps
+    if mem_samples:
+        evs.extend(memory_counter_events(mem_samples, tid=199,
+                                         time_key="t_s"))
     return evs
 
 
-def flight_chrome_trace(events, hop_events=None, grid=None) -> dict:
+def flight_chrome_trace(events, hop_events=None, grid=None,
+                        mem_samples=None) -> dict:
     return {
-        "traceEvents": flight_trace_events(events, hop_events, grid),
+        "traceEvents": flight_trace_events(events, hop_events, grid,
+                                           mem_samples),
         "displayTimeUnit": "ms",
         "otherData": {"producer": "slate_tpu.obs.flight"},
     }

@@ -39,21 +39,34 @@ _LOWER_BETTER = ("second", "time", "byte", "error", "err", "resid", "latency",
                  "wall_s", "compute_s",
                  # mixed-precision refinement outcomes: more iterations /
                  # escalations / full-f64 fallbacks per solve = worse
-                 "iters_total", "escalated", "fallback")
+                 "iters_total", "escalated", "fallback",
+                 # memory observability: OOM events are the failure the
+                 # mem gate exists to pre-empt ("byte" already covers the
+                 # residency maxima)
+                 "oom")
 
 # metric-name prefixes that form versioned report SECTIONS: when the new
 # report carries them and the old artifact predates the section entirely
 # (e.g. sched.* against a pre-flight report, ft_* against a pre-PR-4
-# BENCH_*.json, ir_* against a pre-mixed-precision report), --check
-# reports each key as inconclusive instead of silently ignoring it or
-# failing the whole check
-_SECTION_PREFIXES = ("sched.", "ft_", "ir_")
+# BENCH_*.json, ir_* against a pre-mixed-precision report, mem.*/mem_*
+# against a pre-memory-observability report), --check reports each key
+# as inconclusive instead of silently ignoring it or failing the whole
+# check
+_SECTION_PREFIXES = ("sched.", "ft_", "ir_", "mem_", "mem.")
 
 # pure cost-model estimates with no better/worse direction: halving the
 # XLA flop estimate is usually an optimization, doubling may be a bigger
 # problem — either way it is information, not a gate (checked before the
 # _LOWER_BETTER substrings, so bytes_accessed stays neutral too)
-_NEUTRAL = frozenset({"flops", "transcendentals", "bytes_accessed"})
+_NEUTRAL = frozenset({"flops", "transcendentals", "bytes_accessed",
+                      # a sampling COUNT is instrumentation volume, not a
+                      # quality direction (the sampled maxima gate instead)
+                      "mem_samples",
+                      # aliased donation bytes RISING is an improvement
+                      # (more buffers reused), and a collapse to zero is
+                      # gated by the higher-is-better donation_*_alias_frac
+                      # keys — the raw byte count itself has no direction
+                      "mem.alias_bytes"})
 
 
 def _env_info() -> dict:
@@ -84,6 +97,7 @@ def make_report(
     base = min((s["t0"] for s in spans), default=0.0)
     from ..ft.policy import ft_counter_values
     from ..linalg.refine import ir_counter_values
+    from .memory import mem_counter_values
 
     return {
         "schema": SCHEMA,
@@ -100,6 +114,9 @@ def make_report(
         # converged / iteration count / GMRES escalations / f64 fallbacks
         # / residual-gemm comm bytes accumulated this run
         "ir": ir_counter_values(),
+        # memory-observability totals (obs.memory): live/allocator byte
+        # maxima sampled at driver_span boundaries + OOM event count
+        "mem": mem_counter_values(),
         "metrics": REGISTRY.snapshot(),
         "spans": [
             {
@@ -147,7 +164,7 @@ def validate_report(rep) -> List[str]:
         not isinstance(m.get(k), list) for k in ("counters", "gauges", "histograms")
     ):
         errs.append("metrics must hold counters/gauges/histograms lists")
-    for sec in ("ft", "ir"):  # optional (reports predate these sections)
+    for sec in ("ft", "ir", "mem"):  # optional (reports predate these)
         sv = rep.get(sec)
         if sv is not None and (
             not isinstance(sv, dict)
@@ -205,6 +222,15 @@ def load_values(doc: dict, include_series: bool = False) -> Dict[str, float]:
                   if isinstance(v, (int, float))}
         if any(irvals.values()):
             vals.update({f"ir_{k}": float(v) for k, v in irvals.items()})
+        # mem.* totals gate the same way: under a fixed instrumented
+        # workload a live/peak-byte maximum rising is a residency
+        # regression (and oom_events appearing is the crash the gate
+        # exists to pre-empt); an all-zero section (no sampling this
+        # run) stays out of the comparison surface
+        memvals = {k: v for k, v in (doc.get("mem") or {}).items()
+                   if isinstance(v, (int, float))}
+        if any(memvals.values()):
+            vals.update({f"mem_{k}": float(v) for k, v in memvals.items()})
         if include_series:
             vals.update(flatten_snapshot(doc.get("metrics", {})))
         return {k: float(v) for k, v in vals.items()

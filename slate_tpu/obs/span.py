@@ -66,10 +66,16 @@ def force_enabled(value: bool = True):
 
 
 def reset() -> None:
-    """Drop finished spans + metrics (fresh run boundary)."""
+    """Drop finished spans + metrics + memory samples (fresh run
+    boundary)."""
     with _finished_lock:
         FINISHED.clear()
     REGISTRY.reset()
+    import sys as _sys
+
+    mem = _sys.modules.get(__package__ + ".memory")
+    if mem is not None:  # only if the memory layer was ever consulted
+        mem.reset()
 
 
 def _stack() -> List["Span"]:
@@ -220,6 +226,16 @@ def driver_span(name: str, **tags):
             for op, nbytes, mult, _ph, st, pairs in sched_records
             if pairs
         ][:64]
+        # memory sampling at driver_span boundaries (ISSUE 9): top-level
+        # spans only, and only while obs is on — the disabled path above
+        # never reaches here, so disabled mode makes zero live_arrays
+        # calls (asserted by tests/test_mem.py)
+        try:
+            from . import memory as _memory
+
+            _memory.sample_span(span)
+        except Exception:
+            pass
         with _finished_lock:
             if len(FINISHED) < _EVENT_CAP:
                 FINISHED.append(
@@ -249,10 +265,28 @@ def _default_tags(args) -> Dict[str, Any]:
     return {}
 
 
+def _oom_note(name: str, exc: BaseException) -> None:
+    """OOM forensics at the drivers' dispatch layer (ISSUE 9): on a
+    RESOURCE_EXHAUSTED class failure, emit the live-tensor / model-peak
+    report before the exception propagates.  Only runs on the exception
+    path (rare), so the lazy import + marker match live in one place —
+    memory.is_oom is the single source of the marker list — and the
+    whole hook is wrapped so forensics can never mask the original
+    failure."""
+    try:
+        from . import memory as _memory
+
+        _memory.handle_driver_exception(name, exc)
+    except Exception:
+        pass
+
+
 def instrument(name: Optional[str] = None, **static_tags) -> Callable:
     """Decorator wiring a driver into the observability layer.  With
-    observability disabled the wrapper is a bare passthrough; enabled, the
-    call runs inside ``driver_span(name, **shape_tags)``."""
+    observability disabled the wrapper is a bare passthrough (plus an
+    exception-path OOM forensics hook — no jaxpr change, no overhead off
+    the error path); enabled, the call runs inside
+    ``driver_span(name, **shape_tags)``."""
 
     def deco(fn: Callable) -> Callable:
         span_name = name or fn.__name__
@@ -260,11 +294,19 @@ def instrument(name: Optional[str] = None, **static_tags) -> Callable:
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             if not _enabled:
-                return fn(*args, **kwargs)
+                try:
+                    return fn(*args, **kwargs)
+                except Exception as e:
+                    _oom_note(span_name, e)
+                    raise
             tags = dict(static_tags)
             tags.update(_default_tags(args))
-            with driver_span(span_name, **tags):
-                return fn(*args, **kwargs)
+            try:
+                with driver_span(span_name, **tags):
+                    return fn(*args, **kwargs)
+            except Exception as e:
+                _oom_note(span_name, e)
+                raise
 
         wrapper.__wrapped__ = fn
         return wrapper

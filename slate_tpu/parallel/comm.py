@@ -582,6 +582,22 @@ def la_depth(lookahead, nt: int) -> int:
     return max(0, min(int(lookahead), int(nt)))
 
 
+def la_live_buffers(depth: int, factor_loop: bool = False) -> int:
+    """Panel-broadcast payloads the lookahead schedule pins LIVE at once
+    — the per-device residency the pipelining buys overlap with, and the
+    depth term of ``obs.memmodel.MemoryModel`` (single source: changing
+    a loop's carry structure here moves the memory model with it).
+
+    ``prefetch_bcast`` keeps the d-deep FIFO plus the in-flight head:
+    1 + d payloads.  ``pipelined_factor_loop`` carries the deferred
+    step-(k-1) payload next to the freshly-broadcast step-k payload and
+    its effective depth caps at 1: 1 + 2·min(d, 1) payload pairs."""
+    d = max(0, int(depth))
+    if factor_loop:
+        return 1 + 2 * min(d, 1)
+    return 1 + d
+
+
 def prefetch_bcast(nt: int, depth: int, fetch, consume, state):
     """Software-pipelined k-loop over READ-ONLY panel broadcasts.
 

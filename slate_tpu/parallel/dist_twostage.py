@@ -38,7 +38,8 @@ from ..linalg.eig import _he2hb_panel_count
 from ..obs import instrument
 from ..linalg.qr import _larft_v, _panel_qr_offset
 from .comm import (PRECISE, all_gather_a, audit_scope, bcast_from_col,
-                   bcast_from_row, local_indices, psum_a, shard_map_compat)
+                   bcast_from_row, bcast_impl_scope, local_indices, psum_a,
+                   resolve_bcast_impl, shard_map_compat)
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 
@@ -471,17 +472,22 @@ def _gather_diagband_jit(tiles, mesh, p, q, nb, w):
 
 
 @instrument("chase_apply_dist")
-def chase_apply_dist(vs, taus, z, n: int, w: int, mesh) -> jax.Array:
+def chase_apply_dist(vs, taus, z, n: int, w: int, mesh,
+                     bcast_impl=None) -> jax.Array:
     """Z <- U Z for a bulge-chase reflector basis with Z column-sharded
     over ALL p*q devices and the (sweep, hop) family sharded by sweep
     blocks — the distributed unmtr_hb2st / unmbr_tb2bd (reference
-    src/unmtr_hb2st.cc:1-80).  Block b is psum-broadcast from its owner
-    (O(n^2/p) per step) and applied locally to my column shard via the
-    offset _chase_sweep_apply; peak per-device memory is O(n^2 / (p q)),
-    never the O(n^2) of the replicated form (asserted by
+    src/unmtr_hb2st.cc:1-80).  Block b travels from its linearized owner
+    (r, c) = (b // q, b % q) as a TWO-HOP rooted broadcast — along the
+    row axis from mesh row r, then along the column axis from mesh
+    column c (the ``bcast_diag_tile`` pattern; formerly a waived
+    tuple-axis masked psum) — lowered per ``bcast_impl``
+    (Option.BcastImpl: ppermute ring/doubling at half the all-reduce
+    bytes, or the legacy masked psum), O(n^2/p) per step either way, and
+    applied locally to my column shard via the offset
+    _chase_sweep_apply; peak per-device memory is O(n^2 / (p q)), never
+    the O(n^2) of the replicated form (asserted by
     tests/test_parallel.py::test_chase_apply_dist_memory)."""
-    from ..linalg.eig import _chase_sweep_apply
-
     p, q = mesh_shape(mesh)
     nparts = p * q
     nsweeps, max_hops, wv = vs.shape
@@ -492,34 +498,39 @@ def chase_apply_dist(vs, taus, z, n: int, w: int, mesh) -> jax.Array:
     ncols = z.shape[1]
     cpad = (-ncols) % nparts
     zp = jnp.pad(z, ((0, 0), (0, cpad)))
-    out = _chase_apply_dist_jit(vs_p, ta_p, zp, mesh, p, q, n, w, blk)
+    out = _chase_apply_dist_jit(vs_p, ta_p, zp, mesh, p, q, n, w, blk,
+                                resolve_bcast_impl(bcast_impl))
     return out[:, :ncols]
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8))
-def _chase_apply_dist_jit(vs, taus, z, mesh, p, q, n, w, blk):
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _chase_apply_dist_jit(vs, taus, z, mesh, p, q, n, w, blk, bi="auto"):
     from ..linalg.eig import _chase_sweep_apply
 
     nparts = p * q
     both = (ROW_AXIS, COL_AXIS)
 
     def kernel(vs_loc, ta_loc, z_loc):
-        me = lax.axis_index(ROW_AXIS) * q + lax.axis_index(COL_AXIS)
-
         def body(b, z_loc):
             src = nparts - 1 - b  # reverse chronological block order
-            sel = me == src
-            vs_b = psum_a(jnp.where(sel, vs_loc, 0), both)
-            ta_b = psum_a(jnp.where(sel, ta_loc, 0), both)
+            # two-hop rooted broadcast from the linearized owner: hop 1
+            # delivers mesh row (src // q)'s local block down each
+            # column, hop 2 roots at mesh column (src % q) — every
+            # device then holds device (src // q, src % q)'s exact bytes
+            # (bitwise what the masked tuple-axis psum summed out of
+            # zeros, at half the wire bytes under the engine lowerings)
+            vs_b = bcast_from_col(bcast_from_row(vs_loc, src // q), src % q)
+            ta_b = bcast_from_col(bcast_from_row(ta_loc, src // q), src % q)
             return _chase_sweep_apply(vs_b, ta_b, z_loc, n, w, False, j0=src * blk)
 
         with audit_scope(nparts):
             return lax.fori_loop(0, nparts, body, z_loc)
 
-    return shard_map_compat(
-        kernel,
-        mesh=mesh,
-        in_specs=(P(both), P(both), P(None, both)),
-        out_specs=P(None, both),
-        check_vma=False,
-    )(vs, taus, z)
+    with bcast_impl_scope(bi):
+        return shard_map_compat(
+            kernel,
+            mesh=mesh,
+            in_specs=(P(both), P(both), P(None, both)),
+            out_specs=P(None, both),
+            check_vma=False,
+        )(vs, taus, z)

@@ -273,7 +273,7 @@ def heev_mesh(
     z = ztri.astype(a.dtype)
     if cplx:
         z = phases[:, None] * z
-    z = chase_apply_dist(f2.vs, f2.taus, z, n, nb, mesh)
+    z = chase_apply_dist(f2.vs, f2.taus, z, n, nb, mesh, bcast_impl=_bi(opts))
     zd = unmtr_he2hb_dist(f, from_dense(z, mesh, nb))
     return w, to_dense(zd)
 

@@ -355,6 +355,12 @@ def main(argv=None):
                          "(slate_tpu.obs.flight) for the first requested "
                          "routine that has a flight driver (gemm / potrf / "
                          "getrf / trsm); needs the 8-device CPU mesh")
+    ap.add_argument("--mem", default="",
+                    help="also write a mem.* RunReport JSON "
+                         "(slate_tpu.obs.memwatch: AOT memory analysis + "
+                         "MemoryModel + donation aliasing) for the first "
+                         "requested routine with a mem driver (gemm / "
+                         "potrf / getrf); needs the 8-device CPU mesh")
     args = ap.parse_args(argv)
 
     import jax
@@ -466,6 +472,28 @@ def main(argv=None):
                 # obs must never flip a passed sweep's exit code (e.g.
                 # <8 CPU devices without the forced-device XLA_FLAGS)
                 print(f"flight report failed: {e!r}")
+    if args.mem:
+        from slate_tpu.obs import memwatch as _memwatch
+
+        mem_ops = {"gemm": "summa", "potrf": "potrf",
+                   "getrf": "getrf_nopiv"}
+        op = next((mem_ops[r] for r in args.routines if r in mem_ops), None)
+        if op is None:
+            print(f"mem: none of {args.routines} has a mem driver "
+                  f"({sorted(mem_ops)})")
+        else:
+            try:
+                n_m = max(_parse_dims(args.dim))
+                rep = _memwatch.run_memwatch(op, n=n_m,
+                                             nb=max(8, n_m // 12))
+                _memwatch.write_mem_report(args.mem, rep)
+                v = rep["values"]
+                print(f"mem report written to {args.mem} (temp "
+                      f"{v['mem.temp_bytes']:,.0f} B/dev, model err "
+                      f"{v['mem.model_err_frac']:.1%})")
+            except Exception as e:
+                # obs must never flip a passed sweep's exit code
+                print(f"mem report failed: {e!r}")
     return 1 if failures else 0
 
 

@@ -1,0 +1,457 @@
+"""HBM memory observability (ISSUE 9): MemoryModel vs measured
+``memory_analysis`` temps for the mesh kernels, donation-alias
+verification over the whole donation registry, lookahead residency
+arithmetic, mem.* report schema + ``--check`` gating, zero-overhead
+disabled mode (no live_arrays calls, jaxpr-identical drivers), OOM
+forensics, the model-driven f64 potrf routing, and the Perfetto memory
+counter track."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from slate_tpu import obs
+from slate_tpu.obs import memmodel, memory, memwatch, perfetto, report
+from tests.conftest import cpu_devices
+
+
+def mesh24():
+    from slate_tpu.parallel import make_mesh
+
+    return make_mesh(2, 4, devices=cpu_devices(8))
+
+
+def _case(op, n, nb, depth, impl, mesh):
+    return memwatch._build_case(op, n, nb, mesh, depth, impl)
+
+
+# ---------------------------------------------------------------------------
+# model vs measured (the tentpole acceptance: within 10% on tier-1 shapes)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("impl", ["psum", "ring"])
+@pytest.mark.parametrize("n,nb,depth", [(96, 8, 1), (192, 16, 0)])
+@pytest.mark.parametrize("op", ["summa", "potrf", "getrf_nopiv"])
+def test_model_matches_measured_temps(op, n, nb, depth, impl):
+    mesh = mesh24()
+    fn, args, _run = _case(op, n, nb, depth, impl, mesh)
+    meas = memory.aot_memory_analysis(fn, *args)
+    assert meas is not None and meas["temp_bytes"] > 0
+    model = memmodel.MemoryModel(op, n, nb, (2, 4), "float32",
+                                 lookahead=depth, bcast_impl=impl)
+    err = abs(model.workspace_bytes - meas["temp_bytes"]) / meas["temp_bytes"]
+    assert err <= memwatch.MODEL_TOL, (
+        f"{op} n={n} nb={nb} d={depth} {impl}: model "
+        f"{model.workspace_bytes:,.0f} vs measured {meas['temp_bytes']:,.0f} "
+        f"({err:.1%})")
+    # the exact terms: argument and output shards are tile arithmetic
+    assert meas["arg_bytes"] == model.arg_bytes
+    assert abs(meas["out_bytes"] - model.out_bytes) <= 64
+
+
+def test_model_peak_is_arg_out_workspace():
+    m = memmodel.MemoryModel("summa", 96, 8, (2, 4))
+    assert m.peak_bytes == m.arg_bytes + m.out_bytes + m.workspace_bytes
+
+
+# ---------------------------------------------------------------------------
+# lookahead residency: depth adds exactly d panel-payload buffers
+# ---------------------------------------------------------------------------
+
+
+def test_lookahead_adds_exactly_d_panel_buffers():
+    base = memmodel.MemoryModel("summa", 192, 16, (2, 4), lookahead=0)
+    for d in (1, 2, 3):
+        m = memmodel.MemoryModel("summa", 192, 16, (2, 4), lookahead=d)
+        assert m.workspace_bytes - base.workspace_bytes == d * m.payload_bytes
+    # factor loops carry the deferred payload next to the fresh one and
+    # cap at depth 1: +2 payload pairs at any depth >= 1
+    b0 = memmodel.MemoryModel("potrf", 192, 16, (2, 4), lookahead=0)
+    for d in (1, 3):
+        m = memmodel.MemoryModel("potrf", 192, 16, (2, 4), lookahead=d)
+        assert m.workspace_bytes - b0.workspace_bytes == 2 * m.payload_bytes
+
+
+def test_la_live_buffers_single_source():
+    from slate_tpu.parallel.comm import la_live_buffers
+
+    assert la_live_buffers(0) == 1
+    assert la_live_buffers(2) == 3
+    assert la_live_buffers(0, factor_loop=True) == 1
+    assert la_live_buffers(1, factor_loop=True) == 3
+    assert la_live_buffers(5, factor_loop=True) == 3  # caps at depth 1
+
+
+def test_ft_augmentation_grows_tile_grid():
+    plain = memmodel.MemoryModel("potrf", 96, 8, (2, 4))
+    ft = memmodel.MemoryModel("potrf", 96, 8, (2, 4), ft=True)
+    assert ft.nt > plain.nt
+    assert ft.arg_bytes > plain.arg_bytes
+
+
+# ---------------------------------------------------------------------------
+# donation verification: every registry entry must MEASURABLY alias
+# ---------------------------------------------------------------------------
+
+
+def test_every_donation_registry_entry_aliases():
+    from slate_tpu.analysis import registry
+
+    ctx = registry.make_ctx()
+    assert registry.DONATIONS, "donation registry is empty"
+    for name, spec in sorted(registry.DONATIONS.items()):
+        fn, args, donate = spec.build(ctx)
+        donated, aliased = memory.donation_alias_bytes(fn, args, donate)
+        assert donated > 0, name
+        assert aliased >= donated, (
+            f"{name}: donated {donated:,.0f} B but only {aliased:,.0f} "
+            "aliased in the compiled executable — the donation is lost")
+
+
+def test_seeded_donation_loss_is_measurable():
+    # the bug class the gate exists for: drop donate_argnums and the
+    # measured alias bytes collapse to zero
+    n = 128
+    ap = jnp.asarray(np.random.default_rng(0).standard_normal((n, n)))
+    fn = lambda x: x * 2.0 + 1.0  # noqa: E731
+    donated, aliased = memory.donation_alias_bytes(fn, (ap,), (0,))
+    assert aliased >= donated > 0
+    donated2, aliased2 = memory.donation_alias_bytes(fn, (ap,), ())
+    assert donated2 == 0 and aliased2 == 0.0
+
+
+# ---------------------------------------------------------------------------
+# mem report schema + --check gating
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mem_report():
+    return memwatch.run_memwatch("summa", n=96, nb=8, depth=1,
+                                 bcast_impl="ring", mesh=mesh24(),
+                                 with_donations=False)
+
+
+def test_mem_report_schema(mem_report):
+    assert report.validate_report(mem_report) == []
+    vals = mem_report["values"]
+    for key in ("mem.arg_bytes", "mem.out_bytes", "mem.temp_bytes",
+                "mem.alias_bytes", "mem.model_workspace_bytes",
+                "mem.model_peak_bytes", "mem.model_err_frac"):
+        assert key in vals, key
+    assert vals["mem.temp_bytes"] > 0
+    assert vals["mem.model_err_frac"] <= memwatch.MODEL_TOL
+
+
+def test_mem_report_check_gating(mem_report, tmp_path):
+    import copy
+
+    good = tmp_path / "mem_good.json"
+    good.write_text(json.dumps(mem_report))
+    # unchanged passes (runtime keys ignored like CI does)
+    rc = report.main(["--check", str(good), str(good),
+                      "--ignore", "mem.*_runtime_*"])
+    assert rc == 0
+    # a 10x model error (the extra-copy bug class) fails the gate
+    bad = copy.deepcopy(mem_report)
+    bad["values"]["mem.model_err_frac"] = \
+        max(0.5, 10 * bad["values"]["mem.model_err_frac"])
+    bad["values"]["mem.temp_bytes"] *= 3.0
+    bad_path = tmp_path / "mem_bad.json"
+    bad_path.write_text(json.dumps(bad))
+    rc = report.main(["--check", str(bad_path), str(good),
+                      "--ignore", "mem.*_runtime_*"])
+    assert rc == 1
+    # runtime keys alone never gate: wildly different runtime peaks pass
+    runtime = copy.deepcopy(mem_report)
+    runtime["values"]["mem.summa_runtime_live_bytes"] = \
+        runtime["values"].get("mem.summa_runtime_live_bytes", 1.0) * 1e6 + 1e9
+    rt_path = tmp_path / "mem_rt.json"
+    rt_path.write_text(json.dumps(runtime))
+    rc = report.main(["--check", str(rt_path), str(good),
+                      "--ignore", "mem.*_runtime_*"])
+    assert rc == 0
+
+
+def test_mem_section_rides_run_reports():
+    obs.reset()
+    with obs.force_enabled(), memory.force_sampling():
+        with obs.driver_span("memsec_probe"):
+            jnp.zeros((8, 8)).block_until_ready()
+    rep = report.make_report("memsec")
+    assert "mem" in rep and rep["mem"]["samples"] >= 1
+    vals = report.load_values(rep)
+    assert vals.get("mem_samples", 0) >= 1
+    assert "mem_live_bytes_max" in vals
+    obs.reset()
+
+
+def test_mem_keys_are_sectioned_inconclusive_against_old_artifacts():
+    new = {"mem.temp_bytes": 100.0, "x_gflops": 5.0}
+    old = {"x_gflops": 5.0}
+    keys = report.inconclusive_keys(new, old)
+    assert keys == ["mem.temp_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# disabled mode: zero overhead, jaxpr-identical
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_mode_makes_no_live_array_calls():
+    from slate_tpu.parallel import potrf_dist
+    from slate_tpu.parallel.dist import from_dense
+
+    obs.reset()
+    assert not obs.enabled()
+    mesh = mesh24()
+    rng = np.random.default_rng(0)
+    g = rng.standard_normal((64, 64))
+    spd = jnp.asarray((g @ g.T / 64 + 2 * np.eye(64)).astype(np.float32))
+    ad = from_dense(spd, mesh, 8, diag_pad_one=True)
+    before = memory.LIVE_CALLS
+    _, info = potrf_dist(ad)
+    assert int(info) == 0
+    assert memory.LIVE_CALLS == before
+
+
+def test_disabled_instrumented_driver_is_jaxpr_identical():
+    from slate_tpu.parallel import potrf_dist
+    from slate_tpu.parallel.dist import from_dense
+
+    assert not obs.enabled()
+    mesh = mesh24()
+    rng = np.random.default_rng(0)
+    g = rng.standard_normal((64, 64))
+    spd = jnp.asarray((g @ g.T / 64 + 2 * np.eye(64)).astype(np.float32))
+    ad = from_dense(spd, mesh, 8, diag_pad_one=True)
+    wrapped = jax.make_jaxpr(lambda d: potrf_dist(d))(ad)
+    raw = jax.make_jaxpr(lambda d: potrf_dist.__wrapped__(d))(ad)
+    assert str(wrapped) == str(raw)
+
+
+def test_enabled_span_records_mem_sample():
+    from slate_tpu.parallel import potrf_dist
+    from slate_tpu.parallel.dist import from_dense
+
+    obs.reset()
+    mesh = mesh24()
+    rng = np.random.default_rng(0)
+    g = rng.standard_normal((64, 64))
+    spd = jnp.asarray((g @ g.T / 64 + 2 * np.eye(64)).astype(np.float32))
+    ad = from_dense(spd, mesh, 8, diag_pad_one=True)
+    with obs.force_enabled(), memory.force_sampling():
+        before = memory.LIVE_CALLS
+        _, info = potrf_dist(ad)
+        assert memory.LIVE_CALLS > before
+    spans = [s for s in obs.FINISHED if s["name"] == "potrf_dist"]
+    assert spans and spans[0]["metrics"].get("mem.live_bytes", 0) > 0
+    assert memory.mem_counter_values()["samples"] >= 1
+    obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+
+
+def test_oom_detection_and_report_text():
+    exc = RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating "
+                       "8589934592 bytes")
+    assert memory.is_oom(exc)
+    assert not memory.is_oom(ValueError("shape mismatch"))
+    text = memory.oom_report_text("potrf_mesh", exc)
+    assert "OOM forensics: potrf_mesh" in text
+    assert "live buffers" in text or "live-buffer walk" in text
+    assert "staged" in text  # the escape-route suggestions
+    assert "Lookahead" in text
+    assert "predict_max_n" in text
+    # potrf drivers get the per-form predicted peaks
+    assert "fused_ll" in text and "ozaki_cache" in text
+
+
+def test_instrumented_driver_emits_oom_forensics(capsys):
+    memory.reset()
+
+    @obs.instrument("oom_probe")
+    def boom():
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of HBM")
+
+    assert not obs.enabled()  # forensics must fire even when obs is off
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        boom()
+    assert memory.mem_counter_values()["oom_events"] == 1
+    assert "OOM forensics: oom_probe" in capsys.readouterr().err
+    memory.reset()
+
+
+# ---------------------------------------------------------------------------
+# feasibility + the model-driven f64 potrf routing (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_predict_max_n_respects_budget():
+    budget = 16 * 2**30
+    nmax = memmodel.predict_max_n(budget, "potrf", nb=256, grid=(2, 4),
+                                  dtype="float32")
+    assert nmax > 0
+    m = memmodel.MemoryModel("potrf", nmax, 256, (2, 4), "float32")
+    assert m.peak_bytes <= budget
+    step = 256 * 4  # nb * lcm(2, 4)
+    m2 = memmodel.MemoryModel("potrf", nmax + step, 256, (2, 4), "float32")
+    assert m2.peak_bytes > budget
+    # more devices -> bigger feasible n
+    assert memmodel.predict_max_n(budget, "potrf", nb=256, grid=(4, 4)) > nmax
+
+
+def test_potrf_f64_routes_staged_above_fused_fit(monkeypatch):
+    from slate_tpu.linalg import chol
+
+    monkeypatch.delenv(memmodel.HBM_ENV, raising=False)
+    budget = memmodel.V5E_HBM_BYTES
+    # the ADVICE r5 failure: the fused form's ~7.2 live copies exceed a
+    # v5e at n = 32768 (8 GB matrix); the model must route staged
+    assert memmodel.potrf_fused_ll_peak(32768) > budget
+    assert memmodel.potrf_staged_peak(32768) < budget
+    assert memmodel.potrf_f64_form(32768, concrete=True,
+                                   ozaki_dispatch=False,
+                                   budget=budget) == "staged"
+    assert chol._potrf_f64_form(32768, concrete=True,
+                                ozaki_dispatch=False) == "staged"
+    # ... and traced calls keep the fused form (staged is eager-only)
+    assert memmodel.potrf_f64_form(32768, concrete=False,
+                                   ozaki_dispatch=False,
+                                   budget=budget) == "fused"
+    # small problems stay fused; the ozaki cache ceiling reproduces the
+    # on-chip-validated 16384 point
+    assert memmodel.potrf_f64_form(8192, concrete=True,
+                                   ozaki_dispatch=False,
+                                   budget=budget) == "fused"
+    assert memmodel.potrf_ozaki_cache_max_n(budget) >= 16384
+    assert memmodel.potrf_f64_form(16384, concrete=True,
+                                   ozaki_dispatch=True,
+                                   budget=budget) == "ozaki"
+    assert memmodel.potrf_f64_form(24576, concrete=True,
+                                   ozaki_dispatch=True,
+                                   budget=budget) == "staged"
+
+
+def test_hbm_budget_env_override(monkeypatch):
+    monkeypatch.setenv(memmodel.HBM_ENV, str(123 * 2**20))
+    assert memmodel.hbm_budget() == 123 * 2**20
+
+
+def test_potrf_c128_routes_by_doubled_itemsize():
+    budget = memmodel.V5E_HBM_BYTES
+    # c128 peaks are twice f64's: a size whose f64 fused form fits must
+    # route staged for complex128 (and never take the f64-only ozaki
+    # cache even with the dispatch live)
+    n = 12288
+    assert memmodel.potrf_fused_fits(n, budget, itemsize=8)
+    assert not memmodel.potrf_fused_fits(n, budget, itemsize=16)
+    assert memmodel.potrf_f64_form(n, True, False, budget,
+                                   itemsize=8) == "fused"
+    assert memmodel.potrf_f64_form(n, True, False, budget,
+                                   itemsize=16) == "staged"
+    assert memmodel.potrf_f64_form(8192, True, True, budget,
+                                   itemsize=16) == "fused"
+
+
+def test_mixed_ladder_residency_arithmetic():
+    base = memmodel.mixed_ladder_residency(4096, 256, (2, 4), nrhs=1)
+    m64 = memmodel.MemoryModel("potrf", 4096, 256, (2, 4), "float64")
+    assert base > 2.0 * m64.stack_bytes  # A64 + A32 + L32 + RHS stacks
+    assert memmodel.mixed_ladder_residency(8192, 256, (2, 4)) > base
+    # wider RHS blocks grow the two RHS-shaped stacks only
+    wide = memmodel.mixed_ladder_residency(4096, 256, (2, 4), nrhs=2048)
+    assert wide > base
+    assert wide - base < 2.0 * m64.stack_bytes
+
+
+def test_memwatch_artifact_mem_section_is_empty(mem_report):
+    # the process-global mem section is machine-dependent and cannot be
+    # --ignore'd by the CI glob; memwatch artifacts must not gate on it
+    assert mem_report.get("mem") == {}
+    assert not any(k.startswith("mem_") and not k.startswith("mem.")
+                   for k in report.load_values(mem_report))
+
+
+def test_alias_bytes_are_direction_neutral():
+    new = {"mem.alias_bytes": 2000.0}
+    old = {"mem.alias_bytes": 1000.0}
+    failures, compared = report.check_regression(new, old, 1.5)
+    assert failures == [] and compared == 0
+
+
+# ---------------------------------------------------------------------------
+# Perfetto memory counter track
+# ---------------------------------------------------------------------------
+
+
+def test_memory_counter_events_validate():
+    samples = [
+        {"t": 10.0, "live_bytes": 1000.0,
+         "bytes_in_use": {"dev0": 500.0, "dev1": 500.0},
+         "live_per_device": {"dev0": 400.0}},
+        {"t": 10.5, "live_bytes": 2000.0, "bytes_in_use": {},
+         "live_per_device": {}},
+    ]
+    evs = perfetto.memory_counter_events(samples, base=10.0)
+    assert any(e["name"] == "mem.live_bytes" for e in evs)
+    assert any(e["name"].startswith("mem.bytes_in_use[") for e in evs)
+    tr = {"traceEvents": evs, "displayTimeUnit": "ms"}
+    assert perfetto.validate_chrome_trace(tr) == []
+
+
+def test_span_trace_carries_memory_counters():
+    obs.reset()
+    with obs.force_enabled(), memory.force_sampling():
+        with obs.driver_span("memtrace_probe"):
+            jnp.zeros((4, 4)).block_until_ready()
+    tr = perfetto.chrome_trace()
+    assert any(e.get("ph") == "C" and e["name"].startswith("mem.")
+               for e in tr["traceEvents"])
+    assert perfetto.validate_chrome_trace(tr) == []
+    obs.reset()
+
+
+def test_flight_trace_memory_counter_track():
+    events = [{"op": "summa", "k": 0, "phase": "bulk", "device": [0, 0],
+               "t0_s": 0.0, "t1_s": 0.1, "bytes": 10.0, "flops": 1.0}]
+    mem_samples = [{"t_s": 0.05, "live_bytes": 42.0,
+                    "bytes_in_use": {}, "live_per_device": {"d0": 42.0}}]
+    tr = perfetto.flight_chrome_trace(events, [], grid=(1, 1),
+                                      mem_samples=mem_samples)
+    assert any(e.get("ph") == "C" and e["name"].startswith("mem.")
+               for e in tr["traceEvents"])
+    assert perfetto.validate_chrome_trace(tr) == []
+
+
+# ---------------------------------------------------------------------------
+# chase_apply broadcast engine conversion (ISSUE 9 satellite): the former
+# tuple-axis masked psum is now a two-hop rooted broadcast — all three
+# lowerings bitwise-identical
+# ---------------------------------------------------------------------------
+
+
+def test_chase_apply_dist_impls_bitwise():
+    from slate_tpu.linalg.eig import hb2st
+    from slate_tpu.parallel.dist_twostage import chase_apply_dist
+
+    n, w = 64, 8
+    rng = np.random.default_rng(42)
+    g = rng.standard_normal((n, n))
+    band = np.tril(np.triu(g + g.T, -w), w)
+    d, e, f2, _ = hb2st(jnp.asarray(band), w)
+    z = jnp.asarray(rng.standard_normal((n, n)))
+    mesh = mesh24()
+    ref = np.asarray(chase_apply_dist(f2.vs, f2.taus, z, n, w, mesh,
+                                      bcast_impl="psum"))
+    for impl in ("ring", "doubling", "auto"):
+        got = np.asarray(chase_apply_dist(f2.vs, f2.taus, z, n, w, mesh,
+                                          bcast_impl=impl))
+        assert np.array_equal(got, ref), impl

@@ -1086,7 +1086,9 @@ def test_chase_apply_dist_memory():
     vs = jnp.zeros((blk * nparts, max_hops, w), jnp.float64)
     taus = jnp.zeros((blk * nparts, max_hops), jnp.float64)
     z = jnp.zeros((n, n), jnp.float64)
-    c = _chase_apply_dist_jit.lower(vs, taus, z, mesh, 2, 4, n, w, blk).compile()
+    c = _chase_apply_dist_jit.lower(
+        vs, taus, z, mesh, 2, 4, n, w, blk, "auto"
+    ).compile()
     ma = c.memory_analysis()
     per_dev = ma.argument_size_in_bytes + ma.temp_size_in_bytes + ma.output_size_in_bytes
     repl = (vs.size + taus.size + 2 * z.size) * 8  # replicated footprint
