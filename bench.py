@@ -578,6 +578,7 @@ def main():
     _emit_obs_report(gflops, extras)
     _emit_flight_report()
     _emit_mem_report()
+    _emit_num_report()
 
 
 def _emit_obs_report(gflops, extras):
@@ -667,6 +668,40 @@ def _emit_mem_report():
             f"{v['mem.model_err_frac']:.1%})")
     except Exception as e:  # the headline line must never die on obs
         _progress(f"mem report failed: {e!r}")
+
+
+def _emit_num_report():
+    """Numerics-observability twin (ISSUE 10): when SLATE_TPU_OBS_NUM=
+    <path> is set, run the numwatch pass (monitored-factor growth/margin
+    gauges + distributed Hager-Higham condest + mixed-ladder health
+    routing on seeded adversarial inputs) and write the num.* RunReport
+    there — the accuracy report shipping next to the perf numbers, so a
+    bench artifact records not just how fast the kernels ran but whether
+    the answers they produce are numerically healthy."""
+    path = _os.environ.get("SLATE_TPU_OBS_NUM")
+    if not path:
+        return
+    try:
+        import jax as _jax
+
+        from slate_tpu.obs import numwatch as _numwatch
+        from slate_tpu.parallel import make_mesh as _make_mesh
+
+        devs = _jax.devices()
+        if len(devs) >= 8:
+            mesh = _make_mesh(2, 4, devices=devs[:8])
+        else:
+            mesh = _make_mesh(1, len(devs), devices=devs)
+        rep = _numwatch.run_numwatch("mixed", n=96, nb=16, mesh=mesh)
+        _numwatch.write_num_report(path, rep)
+        v = rep["values"]
+        _progress(
+            f"num report written to {path} (condest "
+            f"{v.get('num.condest_cond', 0):.3g}, routed_gmres "
+            f"{v.get('num.routed_gmres', 0):.0f}, ir_iters_well "
+            f"{v.get('num.ir_iters_well', 0):.0f})")
+    except Exception as e:  # the headline line must never die on obs
+        _progress(f"num report failed: {e!r}")
 
 
 def _selftest_kill():

@@ -79,6 +79,36 @@ python -m slate_tpu.obs.report --check \
     artifacts/obs/mem_potrf.report.json \
     --ignore 'mem.*_runtime_*' --ignore 'mem.model_err_frac'
 
+# numwatch smoke (ISSUE 10): the numerics observability layer — seeded
+# adversarial inputs (Wilkinson growth, prescribed-spectrum
+# ill-conditioned, near-singular-diagonal SPD) through the monitored
+# kernels must trip the num.* gauges exactly (the Wilkinson growth is
+# the CLOSED-FORM 2^{n-1}), the distributed Hager-Higham condest must
+# match the single-chip estimators to rtol, the mixed ladder must
+# health-route the pathological input to the GMRES tier, and every
+# non-runtime gauge must be BITWISE-invariant across psum/ring (asserted
+# inside the smoke).  The fresh reports then gate against the committed
+# references: growth factors, condition estimates and iteration counts
+# are bitwise-reproducible at fixed shape, so only the wall-clock keys
+# are --ignore'd — the accuracy surface gates tight.
+python -m slate_tpu.obs.numwatch --smoke --out artifacts/obs_num
+for op in lu potrf mixed; do
+  python -m slate_tpu.obs.report --check \
+      "artifacts/obs_num/num_${op}.report.json" \
+      "artifacts/obs/num_${op}.report.json" \
+      --ignore 'num.*_runtime_*'
+done
+# the acceptance bound "gate green under both psum and ring": the smoke
+# artifacts above ran ring; re-derive the lu gauges under the explicit
+# legacy psum lowering and gate them against the SAME committed ring
+# reference — they pass because the values are equal, not merely close
+python -m slate_tpu.obs.numwatch lu --impl psum \
+    --out artifacts/obs_num/num_lu_psum.report.json
+python -m slate_tpu.obs.report --check \
+    artifacts/obs_num/num_lu_psum.report.json \
+    artifacts/obs/num_lu.report.json \
+    --ignore 'num.*_runtime_*'
+
 # scaling-curve artifact (ISSUE 7 satellite): fold the MULTICHIP round
 # artifacts into one RunReport-schema curve and schema-validate it
 # through the standard CLI (the committed twin lives at

@@ -940,3 +940,99 @@ def _potrf_flight(ctx):
 @register("getrf_nopiv_dist_flight", tags=("flight",))
 def _getrf_nopiv_flight(ctx):
     return _flight_build(ctx, "getrf_nopiv", "tril")
+
+
+# ---------------------------------------------------------------------------
+# Numerics-monitored variants (ISSUE 10): the Option.NumMonitor=on
+# lowerings under the gate.  The default entries above trace nm=off
+# (jaxpr-identical to the pre-monitoring kernels); these pin the
+# monitored k-loops — the gauge carries ride the same audited loops, the
+# exit reductions are unaudited pmin/pmax with declared axis names (the
+# _lu_info_dist class), so collective-axis, audit_scope coverage and
+# HIGHEST-dot checks all see the monitored jaxpr surface.  The condest
+# drivers trace the distributed Hager-Higham probe loop (a Python loop
+# of mesh trsm solve pairs over a concrete factor, the unmqr pattern).
+# ---------------------------------------------------------------------------
+
+
+@register("potrf_dist_num", tags=("num",))
+def _potrf_num(ctx):
+    from ..parallel.dist_chol import potrf_dist
+
+    a = ctx.dist(kind="spd", diag_pad=True)
+    return (lambda x: potrf_dist(x, num_monitor="on")), (a,)
+
+
+@register("getrf_nopiv_dist_num", tags=("num",))
+def _getrf_nopiv_num(ctx):
+    from ..parallel.dist_lu import getrf_nopiv_dist
+
+    a = ctx.dist(kind="tril", diag_pad=True)
+    return (lambda x: getrf_nopiv_dist(x, num_monitor="on")), (a,)
+
+
+@register("getrf_pp_dist_num", tags=("num",))
+def _getrf_pp_num(ctx):
+    from ..parallel.dist_lu import getrf_pp_dist
+
+    a = ctx.dist(diag_pad=True)
+    return (lambda x: getrf_pp_dist(x, num_monitor="on")), (a,)
+
+
+@register("getrf_tntpiv_dist_num", tags=("num",))
+def _getrf_tnt_num(ctx):
+    from ..parallel.dist_lu import getrf_tntpiv_dist
+
+    a = ctx.dist(diag_pad=True)
+    return (lambda x: getrf_tntpiv_dist(x, num_monitor="on")), (a,)
+
+
+@register("posv_mixed_mesh_num", tags=("num", "mixed"))
+def _posv_mixed_num(ctx):
+    """The fused refinement program with the (||r||, ||x||) history
+    buffer riding the while_loop carry (Option.NumMonitor=on)."""
+    from ..parallel import dist_refine
+    from ..types import Option
+
+    a = ctx.dense(kind="spd")
+    b = ctx.dense_thin()
+    opts = {Option.NumMonitor: "on"}
+    return (lambda x, y: dist_refine.posv_mixed_mesh(
+        x, y, ctx.mesh, NB, opts=opts)), (a, b)
+
+
+@register("gecondest_dist", tags=("num",))
+def _gecondest(ctx):
+    import jax.numpy as jnp
+
+    from ..parallel.dist_aux import gecondest_dist, norm_dist
+    from ..parallel.dist_lu import getrf_pp_dist
+    from ..types import Norm
+
+    a = ctx.dist(diag_pad=True)
+    lu, perm, _info = getrf_pp_dist(a)  # concrete factor once; the trace
+    anorm = norm_dist(Norm.One, ctx.dist())  # covers the probe loop
+    return (lambda l, p_: gecondest_dist(
+        DistLike(l, lu), p_, anorm)), (lu.tiles, perm)
+
+
+@register("pocondest_dist", tags=("num",))
+def _pocondest(ctx):
+    from ..parallel.dist_aux import norm_dist, pocondest_dist
+    from ..parallel.dist_chol import potrf_dist
+    from ..types import Norm
+
+    a = ctx.dist(kind="spd", diag_pad=True)
+    l, _info = potrf_dist(a)
+    anorm = norm_dist(Norm.One, ctx.dist(kind="spd"))
+    return (lambda lt: pocondest_dist(DistLike(lt, l), anorm)), (l.tiles,)
+
+
+def DistLike(tiles, like):
+    """Rewrap a traced tile stack in ``like``'s DistMatrix layout (the
+    condest traces take the raw tile stack so make_jaxpr sees it as an
+    input rather than a constant)."""
+    from ..parallel.dist import DistMatrix
+
+    return DistMatrix(tiles=tiles, m=like.m, n=like.n, nb=like.nb,
+                      mesh=like.mesh, diag_pad=like.diag_pad)

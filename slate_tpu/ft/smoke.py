@@ -45,12 +45,15 @@ def run_smoke(out_dir: str, n: int = 64, nb: int = 8) -> int:
     mesh = make_mesh(2, 4, devices=devs[:8])
     grid = (2, 4)
     nt = -(-n // nb)
-    rng = np.random.default_rng(0)
-    a = jnp.asarray(rng.standard_normal((n, n)))
-    b = jnp.asarray(rng.standard_normal((n, n)))
-    g = rng.standard_normal((n, n))
-    spd = jnp.asarray(g @ g.T + n * np.eye(n))
-    dd = jnp.asarray(rng.standard_normal((n, n)) + n * np.eye(n))
+    # seeded operands through the shared generator catalogue
+    # (utils.testing.generate — the same kinds numwatch's adversarial
+    # targeting and the numerics tests draw from)
+    from ..utils.testing import generate
+
+    a = jnp.asarray(generate("randn", n, seed=0))
+    b = jnp.asarray(generate("randn", n, seed=1))
+    spd = jnp.asarray(n * generate("spd", n, seed=2))
+    dd = jnp.asarray(generate("dominant", n, seed=3))
     failures = []
 
     def check(name, ok, detail=""):

@@ -27,6 +27,13 @@ map) fused with xprof-style annotation:
   (``MemoryModel``, ``predict_max_n``) on the analytic side, and
   ``python -m slate_tpu.obs.memwatch`` emitting the committed ``mem.*``
   regression artifacts.
+- ``numerics`` / ``numwatch`` are the accuracy sibling (ISSUE 10):
+  ``Option.NumMonitor`` in-carry element-growth / Schur-margin /
+  IR-trajectory gauges in the mesh k-loops (off = jaxpr-identical, on =
+  zero extra audited bytes), distributed Hager-Higham condition
+  estimation over factored tiles, health-aware mixed-ladder routing,
+  and ``python -m slate_tpu.obs.numwatch`` emitting the committed
+  ``num.*`` regression artifacts.
 """
 
 # NOTE: perfetto/report are deliberately NOT imported here so that

@@ -142,7 +142,18 @@ def aot_memory_analysis(fn, *args, donate_argnums=(), static_argnums=()
     jitted = fn if hasattr(fn, "lower") else jax.jit(
         fn, donate_argnums=donate_argnums, static_argnums=static_argnums)
     try:
-        compiled = jitted.lower(*args).compile()
+        # measure a FRESH compile: an executable deserialized from the
+        # persistent compilation cache reports empty buffer-assignment
+        # stats (alias/temp bytes read 0), which would fake the exact
+        # lost-donation signal this analysis exists to catch
+        prev = getattr(jax.config, "jax_enable_compilation_cache", None)
+        if prev:
+            jax.config.update("jax_enable_compilation_cache", False)
+        try:
+            compiled = jitted.lower(*args).compile()
+        finally:
+            if prev:
+                jax.config.update("jax_enable_compilation_cache", True)
         return _ma_dict(compiled.memory_analysis())
     except Exception:
         return None

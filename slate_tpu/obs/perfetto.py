@@ -319,3 +319,28 @@ def validate_chrome_trace(obj) -> List[str]:
             if ph != "M" and not isinstance(e.get(k), int):
                 errs.append(f"{where}: bad {k} {e.get(k)!r}")
     return errs
+
+
+def numerics_counter_events(history, op: str = "", tid: int = 0,
+                            t0: float = 0.0, dt: float = 1e-3) -> List[dict]:
+    """Counter events (``ph: "C"``) for a refinement convergence
+    trajectory (obs.numerics.last_history): one ``num.ir_rnorm[op]`` and
+    one ``num.ir_xnorm[op]`` series with one sample per refinement
+    iteration.  Iterations are spaced ``dt`` seconds apart starting at
+    ``t0`` (the trajectory is ordinal — per-iteration, not wall-clock —
+    so the spacing is presentational); rendered beside the flight Gantt
+    the track shows WHERE a solve's convergence stalled, not just that
+    it did."""
+    evs: List[dict] = []
+    suffix = f"[{op}]" if op else ""
+    for i, (rn, xn) in enumerate(history):
+        ts = (t0 + i * dt) * _US
+        evs.append(
+            {"name": f"num.ir_rnorm{suffix}", "cat": "num", "ph": "C",
+             "pid": PID, "tid": tid, "ts": ts, "args": {"rnorm": rn}}
+        )
+        evs.append(
+            {"name": f"num.ir_xnorm{suffix}", "cat": "num", "ph": "C",
+             "pid": PID, "tid": tid, "ts": ts, "args": {"xnorm": xn}}
+        )
+    return evs

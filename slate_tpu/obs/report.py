@@ -43,7 +43,14 @@ _LOWER_BETTER = ("second", "time", "byte", "error", "err", "resid", "latency",
                  # memory observability: OOM events are the failure the
                  # mem gate exists to pre-empt ("byte" already covers the
                  # residency maxima)
-                 "oom")
+                 "oom",
+                 # numerics observability: element growth, condition
+                 # estimates, gauge alarms and per-solve iteration counts /
+                 # trajectory lengths rising = accuracy health degrading
+                 # under a fixed workload (num.chol_margin_min and the
+                 # history_drop convergence ratio stay higher-is-better)
+                 "growth", "condest", "alarm", "routed", "ir_iters",
+                 "history_len")
 
 # metric-name prefixes that form versioned report SECTIONS: when the new
 # report carries them and the old artifact predates the section entirely
@@ -52,7 +59,8 @@ _LOWER_BETTER = ("second", "time", "byte", "error", "err", "resid", "latency",
 # against a pre-memory-observability report), --check reports each key
 # as inconclusive instead of silently ignoring it or failing the whole
 # check
-_SECTION_PREFIXES = ("sched.", "ft_", "ir_", "mem_", "mem.")
+_SECTION_PREFIXES = ("sched.", "ft_", "ir_", "mem_", "mem.", "num_",
+                     "num.")
 
 # pure cost-model estimates with no better/worse direction: halving the
 # XLA flop estimate is usually an optimization, doubling may be a bigger
@@ -98,6 +106,7 @@ def make_report(
     from ..ft.policy import ft_counter_values
     from ..linalg.refine import ir_counter_values
     from .memory import mem_counter_values
+    from .numerics import num_counter_values
 
     return {
         "schema": SCHEMA,
@@ -117,6 +126,10 @@ def make_report(
         # memory-observability totals (obs.memory): live/allocator byte
         # maxima sampled at driver_span boundaries + OOM event count
         "mem": mem_counter_values(),
+        # numerics-observability totals (obs.numerics): monitored-kernel
+        # count, worst element growth / condition estimate, gauge alarms
+        # and health-based GMRES routes accumulated this run
+        "num": num_counter_values(),
         "metrics": REGISTRY.snapshot(),
         "spans": [
             {
@@ -164,7 +177,7 @@ def validate_report(rep) -> List[str]:
         not isinstance(m.get(k), list) for k in ("counters", "gauges", "histograms")
     ):
         errs.append("metrics must hold counters/gauges/histograms lists")
-    for sec in ("ft", "ir", "mem"):  # optional (reports predate these)
+    for sec in ("ft", "ir", "mem", "num"):  # optional (older reports predate these)
         sv = rep.get(sec)
         if sv is not None and (
             not isinstance(sv, dict)
@@ -231,6 +244,14 @@ def load_values(doc: dict, include_series: bool = False) -> Dict[str, float]:
                    if isinstance(v, (int, float))}
         if any(memvals.values()):
             vals.update({f"mem_{k}": float(v) for k, v in memvals.items()})
+        # num.* totals gate the same way: under a fixed monitored
+        # workload, worst growth/condest rising (or alarms appearing) is
+        # an accuracy-health regression; an all-zero section (nothing
+        # monitored this run) stays out of the comparison surface
+        numvals = {k: v for k, v in (doc.get("num") or {}).items()
+                   if isinstance(v, (int, float))}
+        if any(numvals.values()):
+            vals.update({f"num_{k}": float(v) for k, v in numvals.items()})
         if include_series:
             vals.update(flatten_snapshot(doc.get("metrics", {})))
         return {k: float(v) for k, v in vals.items()

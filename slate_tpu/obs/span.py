@@ -66,8 +66,8 @@ def force_enabled(value: bool = True):
 
 
 def reset() -> None:
-    """Drop finished spans + metrics + memory samples (fresh run
-    boundary)."""
+    """Drop finished spans + metrics + memory samples + numerics gauges
+    (fresh run boundary)."""
     with _finished_lock:
         FINISHED.clear()
     REGISTRY.reset()
@@ -76,6 +76,9 @@ def reset() -> None:
     mem = _sys.modules.get(__package__ + ".memory")
     if mem is not None:  # only if the memory layer was ever consulted
         mem.reset()
+    num = _sys.modules.get(__package__ + ".numerics")
+    if num is not None:  # only if the numerics layer was ever consulted
+        num.reset()
 
 
 def _stack() -> List["Span"]:

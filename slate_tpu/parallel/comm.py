@@ -474,6 +474,15 @@ def reduce_to_row(x: jax.Array, owner_row) -> jax.Array:
     return _rooted_reduce(x, owner_row, ROW_AXIS)
 
 
+def num_gauge_dtype(dtype):
+    """Gauge dtype for the Option.NumMonitor loop carries (obs/numerics):
+    real, and at least f32 so bf16 runs do not saturate the running
+    extrema.  Single source shared by the LU and Cholesky kernels so the
+    gauge precision policy cannot drift between them."""
+    rdt = jnp.real(jnp.zeros((), dtype)).dtype
+    return jnp.float32 if rdt == jnp.bfloat16 else rdt
+
+
 def local_indices(p: int, q: int, mtl: int, ntl: int):
     """(r, c, i_log, j_log): my mesh coordinates and the logical tile
     indices of my local tile stack under cyclic layout (the trace-time

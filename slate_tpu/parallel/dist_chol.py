@@ -42,6 +42,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..obs import instrument
+from ..obs.numerics import resolve_num_monitor
 from ..ops.pallas_ops import (
     chol_panel_tiles_pallas,
     panel_engaged,
@@ -52,6 +53,7 @@ from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 from .comm import (
     PRECISE,
+    num_gauge_dtype,
     all_gather_a,
     audit_scope,
     bcast_diag_tile,
@@ -72,6 +74,7 @@ from typing import Optional
 def potrf_dist(
     a: DistMatrix, lookahead: Optional[int] = None,
     bcast_impl: Optional[str] = None, panel_impl: Optional[str] = None,
+    num_monitor: Optional[str] = None,
 ) -> Tuple[DistMatrix, jax.Array]:
     """Factor A = L L^H (lower). ``a`` holds the lower triangle (upper tile
     content ignored). Returns (L as DistMatrix, info).
@@ -85,23 +88,42 @@ def potrf_dist(
     also bitwise-identical.  ``panel_impl`` (Option.PanelImpl) picks the
     panel-phase lowering: ``xla`` (today's cholesky + batched-trsm chain,
     bitwise) or ``pallas`` (one fused on-chip kernel per panel; matches
-    to the documented explicit-inverse tolerance class)."""
+    to the documented explicit-inverse tolerance class).  ``num_monitor``
+    (Option.NumMonitor) threads the in-carry numerics gauges: ``on``
+    accumulates the Schur-diagonal near-breakdown margin in the loop
+    carry (each pivot tile's diagonal sampled right before its own panel
+    factorization — a strict-schedule intermediate at ANY lookahead
+    depth, so the gauge is depth-invariant) plus the final factor's diag
+    min/max, reduced once at loop exit; ``off`` (and the flight
+    step-dispatch path) is jaxpr-identical and records nothing."""
     p, q = mesh_shape(a.mesh)
     if a.mt != a.nt:
         raise ValueError("potrf_dist needs a square tile grid")
     a.require_diag_pad("potrf_dist")
     from ..obs import flight as _flight
+    from ..obs import numerics as _num
 
+    nm = resolve_num_monitor(num_monitor) == "on"
     if _flight.step_dispatch_active():
         # flight-recorder step dispatch: same arithmetic, fenced per phase
+        # (the per-phase programs carry no gauges — monitoring is the
+        # fused kernels' surface)
         lt, info = _flight.potrf_steps(
             a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
             resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
         )
+    elif nm:
+        lt, info, gz = _potrf_jit(
+            a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
+            resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
+            True, a.n,
+        )
+        _num.record_chol_gauges("potrf", gz[0], gz[1], gz[2])
     else:
         lt, info = _potrf_jit(
             a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
             resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
+            False, 0,
         )
     return DistMatrix(
         tiles=lt, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True
@@ -223,8 +245,8 @@ def _chol_bulk(view, payload, lower, cplx, excl_kc=None):
     return view - jnp.where(mask, upd, 0)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
-def _potrf_jit(at, mesh, p, q, nt, la, bi, pi):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9))
+def _potrf_jit(at, mesh, p, q, nt, la, bi, pi, nm=False, n_true=0):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
@@ -232,6 +254,19 @@ def _potrf_jit(at, mesh, p, q, nt, la, bi, pi):
         dtype = t_loc.dtype
         cplx = jnp.issubdtype(dtype, jnp.complexfloating)
         r, c, _, _ = local_indices(p, q, mtl, ntl)
+        rdt = num_gauge_dtype(dtype)  # Option.NumMonitor gauge carries
+
+        def diag_probe(k, view, i_v, j_v):
+            """Min Schur-complement diagonal entry of the not-yet-factored
+            trailing part (logical tile >= k, true extent only) — the
+            near-breakdown margin gauge.  Sampled at panel entry of step
+            k, where tile (k, k)'s diagonal holds exactly the pivots the
+            factor is about to take sqrt of."""
+            dvals = jnp.einsum("ijaa->ija", jnp.real(view)).astype(rdt)
+            gidx = i_v[:, None, None] * nb + jnp.arange(nb)[None, None, :]
+            m = ((i_v[:, None] == j_v[None, :])[:, :, None]
+                 & (i_v >= k)[:, None, None] & (gidx < n_true))
+            return jnp.min(jnp.where(m, dvals, jnp.inf))
 
         def phases_on(i_log, j_log, roff, coff):
             """Panel / narrow / bulk phases of one right-looking step
@@ -273,6 +308,7 @@ def _potrf_jit(at, mesh, p, q, nt, la, bi, pi):
         # each bucket: the deferred update drains at the bucket boundary
         # before the view is re-sliced.
 
+        margin = jnp.asarray(jnp.inf, rdt)
         for k0, k1, s0r, s0c in bucket_plan(nt, p, q):
             view = t_loc[s0r:, s0c:]
             i_log_v = r + (s0r + jnp.arange(mtl - s0r)) * p
@@ -282,23 +318,71 @@ def _potrf_jit(at, mesh, p, q, nt, la, bi, pi):
                 jnp.zeros((mtl - s0r, nb, nb), dtype),
                 jnp.zeros((ntl - s0c, nb, nb), dtype),
             )
-            view = pipelined_factor_loop(
-                k0, k1, la, panel, narrow, bulk, view, zero_pl
-            )
+            if nm:
+                # thread the margin gauge through the pipelined loop's
+                # carry: probe at panel ENTRY (each pivot tile's column
+                # was just refreshed by ``narrow``, so its sample is the
+                # strict-schedule Schur diagonal at every depth); zero
+                # extra collectives — the scalar rides the carry
+                def panel_nm(k, st, panel=panel, i_v=i_log_v, j_v=j_log_v):
+                    view, g = st
+                    g = jnp.minimum(g, diag_probe(k, view, i_v, j_v))
+                    view, pl = panel(k, view)
+                    return (view, g), pl
+
+                def narrow_nm(k, st, pl, narrow=narrow):
+                    return (narrow(k, st[0], pl), st[1])
+
+                def bulk_nm(k, st, pl, bulk=bulk):
+                    return (bulk(k, st[0], pl), st[1])
+
+                view, margin = pipelined_factor_loop(
+                    k0, k1, la, panel_nm, narrow_nm, bulk_nm,
+                    (view, margin), zero_pl
+                )
+            else:
+                view = pipelined_factor_loop(
+                    k0, k1, la, panel, narrow, bulk, view, zero_pl
+                )
             t_loc = t_loc.at[s0r:, s0c:].set(view)
 
         _, _, i_log, j_log = local_indices(p, q, mtl, ntl)
         info = _chol_info_dist(t_loc, i_log, j_log, nt, nb)
+        if nm:
+            # final factor diag extrema + the carried margin, reduced once
+            # at loop exit through the same unaudited pmin/pmax class the
+            # info computation uses (no audited wire bytes)
+            dvals = jnp.einsum("ijaa->ija", jnp.real(t_loc)).astype(rdt)
+            gidx = i_log[:, None, None] * nb + jnp.arange(nb)[None, None, :]
+            dm = (i_log[:, None] == j_log[None, :])[:, :, None] & (gidx < n_true)
+            lmin = jnp.min(jnp.where(dm, dvals, jnp.inf))
+            lmax = jnp.max(jnp.where(dm, dvals, -jnp.inf))
+
+            def allr(x, op):
+                return op(op(x, ROW_AXIS), COL_AXIS)
+
+            gauges = jnp.stack([
+                allr(margin, lax.pmin), allr(lmin, lax.pmin),
+                allr(lmax, lax.pmax),
+            ])
+            return t_loc, info[None, None], gauges[None, None]
         return t_loc, info[None, None]
 
+    out_specs = (spec, P(ROW_AXIS, COL_AXIS))
+    if nm:
+        out_specs = out_specs + (P(ROW_AXIS, COL_AXIS),)
     with bcast_impl_scope(bi), panel_impl_scope(pi):
-        lt, info = shard_map_compat(
+        out = shard_map_compat(
             kernel,
             mesh=mesh,
             in_specs=(spec,),
-            out_specs=(spec, P(ROW_AXIS, COL_AXIS)),
+            out_specs=out_specs,
             check_vma=False,
         )(at)
+    if nm:
+        lt, info, gz = out
+        return lt, jnp.max(info), gz[0, 0]
+    lt, info = out
     return lt, jnp.max(info)
 
 

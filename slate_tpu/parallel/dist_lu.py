@@ -42,6 +42,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..linalg.lu import _getrf_nopiv_rec, _tournament_reduce
 from ..obs import instrument
+from ..obs.numerics import resolve_num_monitor
 from ..ops.pallas_ops import (
     lu_panel_tiles_pallas,
     lu_rowsolve_tiles_pallas,
@@ -53,6 +54,7 @@ from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 from .comm import (
     PRECISE,
+    num_gauge_dtype,
     all_gather_a,
     audit_scope,
     bcast_diag_tile,
@@ -75,6 +77,7 @@ from typing import Optional
 def getrf_nopiv_dist(
     a: DistMatrix, lookahead: Optional[int] = None,
     bcast_impl: Optional[str] = None, panel_impl: Optional[str] = None,
+    num_monitor: Optional[str] = None,
 ) -> Tuple[DistMatrix, jax.Array]:
     """Factor A = L U in place (packed LU tiles). Returns (LU, info).
 
@@ -86,23 +89,39 @@ def getrf_nopiv_dist(
     bitwise-identical.  ``panel_impl`` (Option.PanelImpl) picks the
     panel-phase lowering: ``xla`` (today's recursive diag factor +
     batched trsm pair, bitwise) or ``pallas`` (fused on-chip panel
-    kernels; documented-tolerance parity)."""
+    kernels; documented-tolerance parity).  ``num_monitor``
+    (Option.NumMonitor) threads the in-carry element-growth gauge —
+    running max|working array|/max|A|, THE no-pivot breakdown monitor —
+    sampled at panel entry of every step (strict-schedule intermediates
+    at any depth) and reduced once at loop exit; ``off`` is
+    jaxpr-identical and records nothing."""
     p, q = mesh_shape(a.mesh)
     if a.mt != a.nt:
         raise ValueError("getrf_nopiv_dist needs a square tile grid")
     a.require_diag_pad("getrf_nopiv_dist")
     from ..obs import flight as _flight
+    from ..obs import numerics as _num
 
+    nm = resolve_num_monitor(num_monitor) == "on"
     if _flight.step_dispatch_active():
         # flight-recorder step dispatch: same arithmetic, fenced per phase
+        # (per-phase programs carry no gauges)
         lut, info = _flight.lu_steps(
             a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
             resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
         )
+    elif nm:
+        lut, info, gz = _lu_jit(
+            a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
+            resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
+            True, a.m,
+        )
+        _num.record_lu_growth("getrf_nopiv", gz[0], gz[1])
     else:
         lut, info = _lu_jit(
             a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
             resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
+            False, 0,
         )
     return DistMatrix(
         tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True
@@ -290,14 +309,41 @@ def _lu_info_dist(t_loc, i_log, j_log, nt, nb):
     return jnp.where(info >= big, 0, info).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
-def _lu_jit(at, mesh, p, q, nt, la, bi, pi):
+def _wabs_max(view, i_v, j_v, nb, m_true, rdt):
+    """Masked abs-max of the working array over the true extent — the
+    element-growth probe (running max of max|A^(k)|, the quantity the
+    Wilkinson growth bound speaks about).  Purely local: the gauge rides
+    the loop carry and is pmax-reduced ONCE at kernel exit."""
+    gr = i_v[:, None, None, None] * nb + jnp.arange(nb)[None, None, :, None]
+    gc = j_v[None, :, None, None] * nb + jnp.arange(nb)[None, None, None, :]
+    m = (gr < m_true) & (gc < m_true)
+    return jnp.max(jnp.where(m, jnp.abs(view), 0)).astype(rdt)
+
+
+def _lu_growth_out(amax0, g, gfinal):
+    """Stacked (max|A|, running max|A^(k)|) gauge pair, globally reduced
+    (unaudited pmax — the _lu_info_dist reduction class: no audited wire
+    bytes, so comm-audit totals are unchanged under monitoring)."""
+    g = jnp.maximum(g, gfinal)
+
+    def allr(x):
+        return lax.pmax(lax.pmax(x, ROW_AXIS), COL_AXIS)
+
+    return jnp.stack([allr(amax0), allr(g)])[None, None]
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9))
+def _lu_jit(at, mesh, p, q, nt, la, bi, pi, nm=False, m_true=0):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
         mtl, ntl, nb, _ = t_loc.shape
         dtype = t_loc.dtype
         r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
+        rdt = num_gauge_dtype(dtype)
+        if nm:
+            amax0 = _wabs_max(t_loc, i_log, j_log, nb, m_true, rdt)
+            g = amax0
 
         # trailing-update bucketing (see dist_chol.py): each segment runs
         # on a statically smaller trailing view, cutting the masked flops.
@@ -323,22 +369,56 @@ def _lu_jit(at, mesh, p, q, nt, la, bi, pi):
                 jnp.zeros((mtl - s0r, nb, nb), dtype),
                 jnp.zeros((ntl - s0c, nb, nb), dtype),
             )
-            view = pipelined_factor_loop(
-                k0, k1, la, panel, narrow, bulk, view, zero_pl
-            )
+            if nm:
+                # growth gauge rides the pipelined loop's carry, sampled
+                # at panel entry: every column is sampled fully-updated
+                # at its own factor step, so the running max equals the
+                # strict schedule's at any lookahead depth
+                def panel_nm(k, st, panel=panel, i_v=i_v, j_v=j_v):
+                    view, g = st
+                    g = jnp.maximum(
+                        g, _wabs_max(view, i_v, j_v, nb, m_true, rdt))
+                    view, pl = panel(k, view)
+                    return (view, g), pl
+
+                def narrow_nm(k, st, pl, narrow=narrow):
+                    return (narrow(k, st[0], pl), st[1])
+
+                def bulk_nm(k, st, pl, bulk=bulk):
+                    return (bulk(k, st[0], pl), st[1])
+
+                view, g = pipelined_factor_loop(
+                    k0, k1, la, panel_nm, narrow_nm, bulk_nm,
+                    (view, g), zero_pl
+                )
+            else:
+                view = pipelined_factor_loop(
+                    k0, k1, la, panel, narrow, bulk, view, zero_pl
+                )
             t_loc = t_loc.at[s0r:, s0c:].set(view)
 
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
+        if nm:
+            gz = _lu_growth_out(
+                amax0, g, _wabs_max(t_loc, i_log, j_log, nb, m_true, rdt))
+            return t_loc, info[None, None], gz
         return t_loc, info[None, None]
 
+    out_specs = (spec, P(ROW_AXIS, COL_AXIS))
+    if nm:
+        out_specs = out_specs + (P(ROW_AXIS, COL_AXIS),)
     with bcast_impl_scope(bi), panel_impl_scope(pi):
-        lut, info = shard_map_compat(
+        out = shard_map_compat(
             kernel,
             mesh=mesh,
             in_specs=(spec,),
-            out_specs=(spec, P(ROW_AXIS, COL_AXIS)),
+            out_specs=out_specs,
             check_vma=False,
         )(at)
+    if nm:
+        lut, info, gz = out
+        return lut, jnp.max(info), gz[0, 0]
+    lut, info = out
     return lut, jnp.max(info)
 
 
@@ -350,7 +430,7 @@ def _lu_jit(at, mesh, p, q, nt, la, bi, pi):
 @instrument("getrf_tntpiv_dist")
 def getrf_tntpiv_dist(
     a: DistMatrix, lookahead: Optional[int] = None,
-    bcast_impl: Optional[str] = None,
+    bcast_impl: Optional[str] = None, num_monitor: Optional[str] = None,
 ) -> Tuple[DistMatrix, jax.Array, jax.Array]:
     """Factor P A = L U with tournament pivoting across the mesh.
 
@@ -364,16 +444,30 @@ def getrf_tntpiv_dist(
     column) overlap it — the CALU form of the reference's lookahead.  The
     deferred update must land before the cross-shard row swaps (they move
     full rows), so the overlap window is the tournament, not the whole
-    panel.  Results are bitwise-identical at any depth.
+    panel.  Results are bitwise-identical at any depth.  ``num_monitor``
+    (Option.NumMonitor): ``on`` carries the element-growth gauge through
+    the k-loop (the tournament's pivot quality monitor — growth far
+    above the partial-pivot bound flags a lost tournament); ``off`` is
+    jaxpr-identical.
     """
+    from ..obs import numerics as _num
+
     p, q = mesh_shape(a.mesh)
     if a.mt != a.nt:
         raise ValueError("getrf_tntpiv_dist needs a square tile grid")
     a.require_diag_pad("getrf_tntpiv_dist")
-    lut, perm, info = _tntpiv_jit(
-        a.tiles, a.mesh, p, q, a.nt, a.m, la_depth(lookahead, a.nt),
-        resolve_bcast_impl(bcast_impl),
-    )
+    nm = resolve_num_monitor(num_monitor) == "on"
+    if nm:
+        lut, perm, info, gz = _tntpiv_jit(
+            a.tiles, a.mesh, p, q, a.nt, a.m, la_depth(lookahead, a.nt),
+            resolve_bcast_impl(bcast_impl), True,
+        )
+        _num.record_lu_growth("getrf_tntpiv", gz[0], gz[1])
+    else:
+        lut, perm, info = _tntpiv_jit(
+            a.tiles, a.mesh, p, q, a.nt, a.m, la_depth(lookahead, a.nt),
+            resolve_bcast_impl(bcast_impl), False,
+        )
     return (
         DistMatrix(tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True),
         perm,
@@ -381,8 +475,8 @@ def getrf_tntpiv_dist(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
-def _tntpiv_jit(at, mesh, p, q, nt, m_true, la, bi):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8))
+def _tntpiv_jit(at, mesh, p, q, nt, m_true, la, bi, nm=False):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
@@ -475,17 +569,38 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true, la, bi):
             )
             return t_loc, rowperm
 
+        rdt = num_gauge_dtype(dtype)
+        if nm:
+            amax0 = _wabs_max(t_loc, i_log, j_log, nb, m_true, rdt)
+            g0 = amax0
+
+        def probe(t_loc, g):
+            """Growth-gauge sample at step entry (rides the carry; row
+            swaps permute values so the max is swap-invariant)."""
+            return jnp.maximum(
+                g, _wabs_max(t_loc, i_log, j_log, nb, m_true, rdt))
+
         rowperm0 = jnp.arange(mglob)
         if la <= 0:
             def step(k, carry):
-                t_loc, rowperm = carry
+                if nm:
+                    t_loc, rowperm, g = carry
+                    g = probe(t_loc, g)
+                else:
+                    t_loc, rowperm = carry
                 win = tournament(k, t_loc)
                 t_loc, rowperm = apply_swaps(k, win, t_loc, rowperm)
                 # ---- standard right-looking step on the pivoted panel ----
-                return _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c), rowperm
+                t_loc = _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c)
+                return (t_loc, rowperm, g) if nm else (t_loc, rowperm)
 
+            init = (t_loc, rowperm0, g0) if nm else (t_loc, rowperm0)
             with audit_scope(nt):
-                t_loc, rowperm = lax.fori_loop(0, nt, step, (t_loc, rowperm0))
+                out = lax.fori_loop(0, nt, step, init)
+            if nm:
+                t_loc, rowperm, g = out
+            else:
+                t_loc, rowperm = out
         else:
             # Lookahead: carry the previous step's (pan, urow); refresh
             # the panel column, run the tournament (its collectives are
@@ -493,40 +608,60 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true, la, bi):
             # rest of the deferred update, then swap and factor, deferring
             # this step's own trailing gemm.
             def step(k, carry):
-                t_loc, rowperm, pl = carry
+                if nm:
+                    t_loc, rowperm, pl, g = carry
+                    g = probe(t_loc, g)
+                else:
+                    t_loc, rowperm, pl = carry
                 t_loc = _nopiv_narrow(t_loc, pl, k, p, q, with_row=False)
                 win = tournament(k, t_loc)
                 t_loc = _nopiv_bulk(t_loc, pl, excl_kc=k // q)
                 t_loc, rowperm = apply_swaps(k, win, t_loc, rowperm)
                 t_loc, pl_new = _nopiv_panel(t_loc, k, p, q, i_log, j_log, r, c)
-                return t_loc, rowperm, pl_new
+                return ((t_loc, rowperm, pl_new, g) if nm
+                        else (t_loc, rowperm, pl_new))
 
             zero_pl = (
                 jnp.zeros((mtl, nb, nb), dtype),
                 jnp.zeros((ntl, nb, nb), dtype),
             )
+            init = ((t_loc, rowperm0, zero_pl, g0) if nm
+                    else (t_loc, rowperm0, zero_pl))
             with audit_scope(nt):
-                t_loc, rowperm, pl = lax.fori_loop(
-                    0, nt, step, (t_loc, rowperm0, zero_pl)
-                )
+                out = lax.fori_loop(0, nt, step, init)
+            if nm:
+                t_loc, rowperm, pl, g = out
+            else:
+                t_loc, rowperm, pl = out
             t_loc = _nopiv_bulk(t_loc, pl)  # drain the last deferred gemm
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
+        if nm:
+            gz = _lu_growth_out(
+                amax0, g, _wabs_max(t_loc, i_log, j_log, nb, m_true, rdt))
+            return t_loc, rowperm[None], info[None, None], gz
         return t_loc, rowperm[None], info[None, None]
 
+    out_specs = (spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS))
+    if nm:
+        out_specs = out_specs + (P(ROW_AXIS, COL_AXIS),)
     # pivoted kernels keep the XLA panel forms: their k-step cost is the
     # pivot machinery (tournament / argmax collectives + row swaps), and
     # pinning the scope keeps this jit's cache impl-independent — the
     # nopiv kernel (and the ft variants) are the PanelImpl consumers
     with bcast_impl_scope(bi), panel_impl_scope("xla"):
-        lut, perm, info = shard_map_compat(
+        out = shard_map_compat(
             kernel,
             mesh=mesh,
             in_specs=(spec,),
-            out_specs=(spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS)),
+            out_specs=out_specs,
             check_vma=False,
         )(at)
     # every device computes the identical replicated permutation; the
     # out-spec stacks one copy per mesh row — take the first
+    if nm:
+        lut, perm, info, gz = out
+        return lut, perm[0], jnp.max(info), gz[0, 0]
+    lut, perm, info = out
     return lut, perm[0], jnp.max(info)
 
 
@@ -540,7 +675,7 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true, la, bi):
 @instrument("getrf_pp_dist")
 def getrf_pp_dist(
     a: DistMatrix, lookahead: Optional[int] = None,
-    bcast_impl: Optional[str] = None,
+    bcast_impl: Optional[str] = None, num_monitor: Optional[str] = None,
 ) -> Tuple[DistMatrix, jax.Array, jax.Array]:
     """Factor P A = L U with classic partial (per-column argmax) pivoting.
 
@@ -559,15 +694,29 @@ def getrf_pp_dist(
     contract as getrf_tntpiv_dist.  ``lookahead`` >= 1 overlaps the
     pivoted panel factor's collectives with the previous step's deferred
     trailing gemm (bitwise-identical reorder; see getrf_tntpiv_dist).
+    ``num_monitor`` (Option.NumMonitor): ``on`` carries the
+    element-growth gauge (max 2^{n-1} under partial pivoting — the
+    Wilkinson bound — so a tripped gauge is a certified pathological
+    input); ``off`` is jaxpr-identical.
     """
+    from ..obs import numerics as _num
+
     p, q = mesh_shape(a.mesh)
     if a.mt != a.nt:
         raise ValueError("getrf_pp_dist needs a square tile grid")
     a.require_diag_pad("getrf_pp_dist")
-    lut, perm, info = _pp_jit(
-        a.tiles, a.mesh, p, q, a.nt, a.m, la_depth(lookahead, a.nt),
-        resolve_bcast_impl(bcast_impl),
-    )
+    nm = resolve_num_monitor(num_monitor) == "on"
+    if nm:
+        lut, perm, info, gz = _pp_jit(
+            a.tiles, a.mesh, p, q, a.nt, a.m, la_depth(lookahead, a.nt),
+            resolve_bcast_impl(bcast_impl), True,
+        )
+        _num.record_lu_growth("getrf_pp", gz[0], gz[1])
+    else:
+        lut, perm, info = _pp_jit(
+            a.tiles, a.mesh, p, q, a.nt, a.m, la_depth(lookahead, a.nt),
+            resolve_bcast_impl(bcast_impl), False,
+        )
     return (
         DistMatrix(tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True),
         perm,
@@ -743,8 +892,8 @@ def _pp_panel_and_swaps(t_loc, rowperm, k, p, q, r, c, nt, m_true,
     )
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
-def _pp_jit(at, mesh, p, q, nt, m_true, la, bi):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8))
+def _pp_jit(at, mesh, p, q, nt, m_true, la, bi, nm=False):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
@@ -753,23 +902,40 @@ def _pp_jit(at, mesh, p, q, nt, m_true, la, bi):
         r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
         mglob = nt * nb
         zero = jnp.zeros((), jnp.int32)
+        rdt = num_gauge_dtype(dtype)
+        if nm:
+            amax0 = _wabs_max(t_loc, i_log, j_log, nb, m_true, rdt)
+            g0 = amax0
+
+        def probe(t_loc, g):
+            return jnp.maximum(
+                g, _wabs_max(t_loc, i_log, j_log, nb, m_true, rdt))
 
         rowperm0 = jnp.arange(mglob)
         if la <= 0:
             def step(k, carry):
-                t_loc, rowperm = carry
+                if nm:
+                    t_loc, rowperm, g = carry
+                    g = probe(t_loc, g)
+                else:
+                    t_loc, rowperm = carry
                 t_loc, rowperm = _pp_panel_and_swaps(
                     t_loc, rowperm, k, p, q, r, c, nt, m_true,
                     zero, mtl, zero, ntl,
                 )
                 # ---- shared tail: row solve + trailing update ----
-                return (
-                    _nopiv_step(t_loc, k, p, q, i_log, j_log, r, c, panel_done=True),
-                    rowperm,
+                t_loc = _nopiv_step(
+                    t_loc, k, p, q, i_log, j_log, r, c, panel_done=True
                 )
+                return (t_loc, rowperm, g) if nm else (t_loc, rowperm)
 
+            init = (t_loc, rowperm0, g0) if nm else (t_loc, rowperm0)
             with audit_scope(nt):
-                t_loc, rowperm = lax.fori_loop(0, nt, step, (t_loc, rowperm0))
+                out = lax.fori_loop(0, nt, step, init)
+            if nm:
+                t_loc, rowperm, g = out
+            else:
+                t_loc, rowperm = out
         else:
             # Lookahead (getrf.cc's panel/update overlap): refresh the
             # panel column, factor it with pivoting (its collectives are
@@ -777,7 +943,11 @@ def _pp_jit(at, mesh, p, q, nt, m_true, la, bi):
             # the deferred update, then swap full rows, row-solve, and
             # defer this step's own trailing gemm.
             def step(k, carry):
-                t_loc, rowperm, pl = carry
+                if nm:
+                    t_loc, rowperm, pl, g = carry
+                    g = probe(t_loc, g)
+                else:
+                    t_loc, rowperm, pl = carry
                 t_loc = _nopiv_narrow(t_loc, pl, k, p, q, with_row=False)
                 flat, piv_pos = _pp_panel_factor(
                     t_loc, k, p, q, r, c, nt, m_true, zero, mtl
@@ -790,28 +960,44 @@ def _pp_jit(at, mesh, p, q, nt, m_true, la, bi):
                 t_loc, pl_new = _nopiv_panel(
                     t_loc, k, p, q, i_log, j_log, r, c, panel_done=True
                 )
-                return t_loc, rowperm, pl_new
+                return ((t_loc, rowperm, pl_new, g) if nm
+                        else (t_loc, rowperm, pl_new))
 
             zero_pl = (
                 jnp.zeros((mtl, nb, nb), dtype),
                 jnp.zeros((ntl, nb, nb), dtype),
             )
+            init = ((t_loc, rowperm0, zero_pl, g0) if nm
+                    else (t_loc, rowperm0, zero_pl))
             with audit_scope(nt):
-                t_loc, rowperm, pl = lax.fori_loop(
-                    0, nt, step, (t_loc, rowperm0, zero_pl)
-                )
+                out = lax.fori_loop(0, nt, step, init)
+            if nm:
+                t_loc, rowperm, pl, g = out
+            else:
+                t_loc, rowperm, pl = out
             t_loc = _nopiv_bulk(t_loc, pl)  # drain the last deferred gemm
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
+        if nm:
+            gz = _lu_growth_out(
+                amax0, g, _wabs_max(t_loc, i_log, j_log, nb, m_true, rdt))
+            return t_loc, rowperm[None], info[None, None], gz
         return t_loc, rowperm[None], info[None, None]
 
+    out_specs = (spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS))
+    if nm:
+        out_specs = out_specs + (P(ROW_AXIS, COL_AXIS),)
     with bcast_impl_scope(bi), panel_impl_scope("xla"):  # see _tntpiv_jit
-        lut, perm, info = shard_map_compat(
+        out = shard_map_compat(
             kernel,
             mesh=mesh,
             in_specs=(spec,),
-            out_specs=(spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS)),
+            out_specs=out_specs,
             check_vma=False,
         )(at)
+    if nm:
+        lut, perm, info, gz = out
+        return lut, perm[0], jnp.max(info), gz[0, 0]
+    lut, perm, info = out
     return lut, perm[0], jnp.max(info)
 
 

@@ -225,13 +225,18 @@ def _inf_norm_pair_jit(rt, xt, mesh, p, q, m_true, n_true):
 
 
 def _ir_common(ad: DistMatrix, bd: DistMatrix, lo_solve, info,
-               max_iter: int, la, bi: str, ri: str):
+               max_iter: int, la, bi: str, ri: str, nm: bool = False):
     """Shared refinement body over a factored low-precision solve.
 
     ``lo_solve(rd) -> DistMatrix`` applies the f32 factor to a distributed
     RHS and returns the f64 upcast.  Returns (x_tiles, r_tiles, iters,
     converged, rnorm, xnorm) — all device values; a failed factor
     (info != 0) skips the loop and NaN-fills x so misuse fails loudly.
+    ``nm`` (Option.NumMonitor resolved) additionally carries a fixed-size
+    (max_iter + 1, 2) history buffer of the per-iteration (||r||, ||x||)
+    pair through the while_loop — the convergence TRAJECTORY, read back
+    once at exit (rows never reached stay NaN); ``nm=False`` is
+    jaxpr-identical to the unmonitored program and returns no buffer.
 
     Loop structure: the initial f32 solve IS the first ``lax.while_loop``
     trip (carry starts at x = 0, r = b, it = -1), so every distributed
@@ -262,18 +267,25 @@ def _ir_common(ad: DistMatrix, bd: DistMatrix, lo_solve, info,
                      lookahead=la, bcast_impl=bi).tiles
 
     def cond(state):
-        _x, _r, _rn, _xn, it, done = state
+        it, done = state[4], state[5]
         return ok & (~done) & (it < max_iter)
 
     def body(state):
-        x_t, r_t, _rn, _xn, it, _done = state
+        x_t, r_t, _rn, _xn, it, _done = state[:6]
         with phase_scope("correct"):
             d = lo_solve(wrap(r_t, bd)).tiles
         x_t = x_t + d
         with phase_scope("residual"):
             r_t = residual(x_t)
         rn, xn = _inf_norm_pair_jit(r_t, x_t, ad.mesh, p, q, bd.m, bd.n)
-        return x_t, r_t, rn, xn, it + 1, rn <= xn * cte
+        out = (x_t, r_t, rn, xn, it + 1, rn <= xn * cte)
+        if nm:
+            # trajectory buffer rides the carry: row it+1 (the trip the
+            # initial solve counts as trip 0) gets this trip's norm pair
+            hist = lax.dynamic_update_slice_in_dim(
+                state[6], jnp.stack([rn, xn])[None], it + 1, axis=0)
+            out = out + (hist,)
+        return out
 
     # audit_scope(max_iter + 1): the while trip count is dynamic, so the
     # trace-time comm audit records the refinement loop's collectives at
@@ -282,19 +294,24 @@ def _ir_common(ad: DistMatrix, bd: DistMatrix, lo_solve, info,
     rdt = jnp.real(jnp.zeros((), dtype)).dtype
     init = (jnp.zeros_like(bd.tiles), bd.tiles, jnp.asarray(jnp.inf, rdt),
             jnp.zeros((), rdt), jnp.int32(-1), jnp.zeros((), bool))
+    if nm:
+        init = init + (jnp.full((max_iter + 1, 2), jnp.nan, rdt),)
     with audit_scope(max_iter + 1):
-        x_t, r_t, rn, xn, iters, done = lax.while_loop(cond, body, init)
+        out = lax.while_loop(cond, body, init)
+    x_t, r_t, rn, xn, iters, done = out[:6]
     x_t = jnp.where(ok, x_t, jnp.full_like(x_t, jnp.nan))
+    if nm:
+        return x_t, r_t, iters, done & ok, rn, xn, out[6]
     return x_t, r_t, iters, done & ok, rn, xn
 
 
 @functools.partial(
     jax.jit,
-    static_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12, 13),
+    static_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14),
     donate_argnums=(1,),
 )
 def _ir_posv_jit(at, bt, lt, info, mesh, p, q, m, nrhs, nb,
-                 max_iter, la, bi, ri):
+                 max_iter, la, bi, ri, nm=False):
     ad = DistMatrix(tiles=at, m=m, n=m, nb=nb, mesh=mesh, diag_pad=True)
     bd = DistMatrix(tiles=bt, m=m, n=nrhs, nb=nb, mesh=mesh, diag_pad=False)
     ld = DistMatrix(tiles=lt, m=m, n=m, nb=nb, mesh=mesh, diag_pad=True)
@@ -307,16 +324,16 @@ def _ir_posv_jit(at, bt, lt, info, mesh, p, q, m, nrhs, nb,
                       bcast_impl=bi)
         return _astype_dist(x, at.dtype)
 
-    return _ir_common(ad, bd, lo_solve, info, max_iter, la, bi, ri)
+    return _ir_common(ad, bd, lo_solve, info, max_iter, la, bi, ri, nm)
 
 
 @functools.partial(
     jax.jit,
-    static_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13, 14),
+    static_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15),
     donate_argnums=(1,),
 )
 def _ir_gesv_jit(at, bt, lut, perm, info, mesh, p, q, m, nrhs, nb,
-                 max_iter, la, bi, ri):
+                 max_iter, la, bi, ri, nm=False):
     ad = DistMatrix(tiles=at, m=m, n=m, nb=nb, mesh=mesh, diag_pad=True)
     bd = DistMatrix(tiles=bt, m=m, n=nrhs, nb=nb, mesh=mesh, diag_pad=False)
     lud = DistMatrix(tiles=lut, m=m, n=m, nb=nb, mesh=mesh, diag_pad=True)
@@ -330,7 +347,7 @@ def _ir_gesv_jit(at, bt, lut, perm, info, mesh, p, q, m, nrhs, nb,
                       bcast_impl=bi)
         return _astype_dist(x, at.dtype)
 
-    return _ir_common(ad, bd, lo_solve, info, max_iter, la, bi, ri)
+    return _ir_common(ad, bd, lo_solve, info, max_iter, la, bi, ri, nm)
 
 
 def _factor_f32(kind: str, a: jax.Array, mesh: Mesh, nb: int, opts):
@@ -370,15 +387,20 @@ def _prefactor(kind: str, a: jax.Array, mesh: Mesh, nb: int, opts):
 def _mixed_ir_solve(kind: str, a: jax.Array, b: jax.Array, mesh: Mesh,
                     nb: int, max_iter, opts, pre=None):
     """Factor + fused refinement; returns (x_dense, iters, converged,
-    rnorm, xnorm, info, resid_bytes_per_iter) with iters/converged
-    still on device."""
+    rnorm, xnorm, info, resid_bytes_per_iter, history) with
+    iters/converged still on device.  ``history`` is the carried
+    (||r||, ||x||) trajectory buffer under Option.NumMonitor=on, else
+    None (the monitored program is a distinct static variant; off is
+    jaxpr-identical to the pre-monitoring kernel)."""
     from ..obs import flight as _flight
+    from ..obs import numerics as _num
 
     p, q = mesh_shape(mesh)
     la = _la(opts)
     bi = resolve_bcast_impl(get_option(opts, Option.BcastImpl))
     ri = resolve_residual_impl(opts)
     mi = _max_iter(opts, max_iter)
+    nm = _num.resolve_num_monitor(_num.monitor_from_opts(opts)) == "on"
     fact, perm, info, ad = pre if pre is not None else _prefactor(
         kind, a, mesh, nb, opts)
     bd = from_dense(b, mesh, nb)
@@ -387,19 +409,21 @@ def _mixed_ir_solve(kind: str, a: jax.Array, b: jax.Array, mesh: Mesh,
     # above records normally, the refinement runs as the one fused program
     with _flight.no_flight():
         if kind == "posv":
-            x_t, _r_t, iters, conv, rn, xn = _ir_posv_jit(
+            out = _ir_posv_jit(
                 ad.tiles, bd.tiles, fact.tiles, info, mesh, p, q, ad.m,
-                bd.n, nb, mi, la, bi, ri,
+                bd.n, nb, mi, la, bi, ri, nm,
             )
         else:
-            x_t, _r_t, iters, conv, rn, xn = _ir_gesv_jit(
+            out = _ir_gesv_jit(
                 ad.tiles, bd.tiles, fact.tiles, perm, info, mesh, p, q,
-                ad.m, bd.n, nb, mi, la, bi, ri,
+                ad.m, bd.n, nb, mi, la, bi, ri, nm,
             )
+    x_t, _r_t, iters, conv, rn, xn = out[:6]
+    hist = out[6] if nm else None
     xd = DistMatrix(tiles=x_t, m=bd.m, n=bd.n, nb=nb, mesh=mesh)
     per_iter = float(residual_comm_bytes(
         ad.tiles.shape[0], bd.tiles.shape[1], ad.nt, nb, p, q, bi, ri))
-    return to_dense(xd), iters, conv, rn, xn, info, per_iter
+    return to_dense(xd), iters, conv, rn, xn, info, per_iter, hist
 
 
 @instrument("posv_mixed_mesh")
@@ -417,11 +441,11 @@ def posv_mixed_mesh(
     ``_prefactor``).  ``pre`` is the routing ladder's shared
     ``_prefactor`` result (internal)."""
     _require_f64(a, "posv_mixed_mesh")
-    x, raw_iters, conv, rn, xn, info, per_iter = _mixed_ir_solve(
+    x, raw_iters, conv, rn, xn, info, per_iter, hist = _mixed_ir_solve(
         "posv", a, b, mesh, nb, max_iter, opts, pre
     )
     iters = jnp.where(conv, raw_iters, -1).astype(jnp.int32)
-    _record_ir("posv", iters, raw_iters, rn, xn, per_iter)
+    _record_ir("posv", iters, raw_iters, rn, xn, per_iter, hist)
     return x, iters, jnp.asarray(info, jnp.int32)
 
 
@@ -435,15 +459,16 @@ def gesv_mixed_mesh(
     f64 mesh refinement (src/gesv_mixed.cc:16-44).  Returns
     (x, iters, info); see posv_mixed_mesh."""
     _require_f64(a, "gesv_mixed_mesh")
-    x, raw_iters, conv, rn, xn, info, per_iter = _mixed_ir_solve(
+    x, raw_iters, conv, rn, xn, info, per_iter, hist = _mixed_ir_solve(
         "gesv", a, b, mesh, nb, max_iter, opts, pre
     )
     iters = jnp.where(conv, raw_iters, -1).astype(jnp.int32)
-    _record_ir("gesv", iters, raw_iters, rn, xn, per_iter)
+    _record_ir("gesv", iters, raw_iters, rn, xn, per_iter, hist)
     return x, iters, jnp.asarray(info, jnp.int32)
 
 
-def _record_ir(kind: str, iters, raw_iters, rnorm, xnorm, per_iter) -> None:
+def _record_ir(kind: str, iters, raw_iters, rnorm, xnorm, per_iter,
+               hist=None) -> None:
     """The ir.* observability surface (always-on, like the ft.* counters):
     per-solve gauges + the totals obs.report gates.  One host readback —
     the final (iters, norms) the drivers return anyway.  Under tracing
@@ -467,6 +492,14 @@ def _record_ir(kind: str, iters, raw_iters, rnorm, xnorm, per_iter) -> None:
     ir_count("ir.residual_gemm_bytes", kind, per_iter * (raw + 1))
     if it >= 0:
         ir_count("ir.converged", kind)
+    if hist is not None:
+        # the carried (||r||, ||x||) trajectory (Option.NumMonitor=on):
+        # lands as the ir.residual_history gauge series so a stalling-
+        # but-eventually-converging solve is distinguishable from a
+        # healthy one in the RunReport (ISSUE 10 satellite)
+        from ..obs import numerics as _num
+
+        _num.record_ir_history(kind, hist, raw)
 
 
 # ---------------------------------------------------------------------------
@@ -731,6 +764,40 @@ def gesv_mixed_gmres_mesh(
 # ---------------------------------------------------------------------------
 
 
+def _route_health(kind, pre, opts) -> bool:
+    """The measured-health entry-tier decision for ``MixedPrecision=auto``
+    under Option.NumMonitor=on: read the monitored f32 factor's in-carry
+    gauges (element growth / Cholesky diagonal margin — already recorded
+    by the factor kernel), run the distributed Hager-Higham condition
+    estimate over the factored tiles (dist_aux.gecondest_dist /
+    pocondest_dist: ~2*iters+1 single-column mesh trsm solve pairs), and
+    return True when the input sits in the IR-cannot-converge regime so
+    the ladder enters at GMRES-IR."""
+    from ..obs import numerics as _num
+    from .dist_aux import gecondest_dist, pocondest_dist
+
+    fact, perm, info, ad = pre
+    try:
+        if int(info) != 0:
+            return False  # failed factor: the existing NaN/fallback path
+    except (jax.errors.TracerIntegerConversionError,
+            jax.errors.ConcretizationTypeError):
+        return False
+    la = _la(opts)
+    bi = get_option(opts, Option.BcastImpl)
+    gauges = _num.last_gauges("potrf" if kind == "posv" else "getrf_pp")
+    anorm = norm_dist(Norm.One, ad)
+    if kind == "posv":
+        rcond = pocondest_dist(fact, anorm, lookahead=la, bcast_impl=bi)
+    else:
+        rcond = gecondest_dist(fact, perm, anorm, lookahead=la,
+                               bcast_impl=bi)
+    if _num.route_entry_tier(kind, gauges, float(rcond)):
+        _num.record_routed_gmres(kind)
+        return True
+    return False
+
+
 def mixed_mesh_route(kind, a, b, mesh, nb, opts, plain_fn):
     """Route an f64 ``gesv_mesh``/``posv_mesh`` call through the mixed
     ladder per the resolved Option.MixedPrecision.  Returns (x, info), or
@@ -746,7 +813,19 @@ def mixed_mesh_route(kind, a, b, mesh, nb, opts, plain_fn):
     f64 path — which is also exactly the pre-mixed trace semantics of
     the public drivers (a user jitting gesv_mesh gets the same jaxpr as
     before this routing existed; the mixed tiers are reachable under
-    jit via the explicit ``*_mixed_mesh`` drivers' fused programs)."""
+    jit via the explicit ``*_mixed_mesh`` drivers' fused programs).
+
+    Health-aware entry tier (ISSUE 10): under Option.NumMonitor=on (auto
+    = on when the obs layer is enabled) the f32 factor runs MONITORED —
+    its element-growth / diagonal-margin gauges ride the k-loop carry —
+    and ``auto`` mode additionally runs a distributed Hager-Higham
+    condition estimate over the just-computed factor (a handful of mesh
+    trsm solves on one column, no O(n^3)).  Pathological health —
+    growth above numerics.GROWTH_THRESHOLD or cond(A) above
+    numerics.CONDEST_THRESHOLD, the regime where classic IR on an f32
+    factor is known to stall (Carson & Higham 2018) — skips the IR tier
+    entirely and enters at GMRES-IR (``num.routed_gmres``), instead of
+    burning max_iter refinement iterations to learn the same fact."""
     mode = resolve_mixed(opts)
     if (mode == "off" or getattr(a, "dtype", None) != jnp.float64
             or getattr(b, "ndim", 0) != 2
@@ -754,20 +833,41 @@ def mixed_mesh_route(kind, a, b, mesh, nb, opts, plain_fn):
             or isinstance(b, jax.core.Tracer)):
         return None
     from ..obs import driver_span
+    from ..obs import numerics as _num
 
+    nm_on = _num.resolve_num_monitor(_num.monitor_from_opts(opts)) == "on"
+    if nm_on:
+        # pin the resolved mode into the opts every tier consumes, so the
+        # f32 factor's k-loop carries the gauges the router reads
+        opts = dict(opts or {})
+        opts[Option.NumMonitor] = "on"
     drv = posv_mixed_mesh if kind == "posv" else gesv_mixed_mesh
     with driver_span(f"{kind}_mixed", mode=mode) as sp:
         # one f32 factor for the whole ladder: the GMRES tier
-        # preconditions with the exact factor the IR tier refined on
+        # preconditions with the exact factor the IR tier refined on.
+        # Clear the op's last-gauge entry first so the router only ever
+        # reads THIS factor's health — a factor path that records no
+        # gauges (e.g. Option.FaultTolerance routes to the ABFT kernels,
+        # which carry no monitor) yields an empty dict and the routing
+        # decision falls back to the condest alone
+        if nm_on:
+            _num.clear_last("potrf" if kind == "posv" else "getrf_pp")
         pre = _prefactor(kind, a, mesh, nb, opts)
-        if mode in ("ir", "auto"):
+        skip_ir = False
+        if nm_on and mode == "auto":
+            with sp.phase("health"):
+                skip_ir = _route_health(kind, pre, opts)
+        if mode in ("ir", "auto") and not skip_ir:
             with sp.phase("ir"):
                 x, iters, info = drv(a, b, mesh, nb, opts=opts, pre=pre)
             if int(info) == 0 and int(iters) >= 0:
                 return x, info
         if mode in ("gmres", "auto"):
-            if mode == "auto":  # gmres-pinned runs it as tier 1, not an
-                ir_count("ir.escalated_gmres", kind)  # escalation event
+            if mode == "auto" and not skip_ir:
+                # gmres-pinned runs it as tier 1 and a health-routed
+                # entry (num.routed_gmres) is a ROUTE, not an escalation
+                # — only an IR tier that actually ran and failed counts
+                ir_count("ir.escalated_gmres", kind)
             with sp.phase("gmres"):
                 x, rnorm, conv, info = _mixed_gmres_solve(
                     kind, a, b, mesh, nb, opts, restart=30, pre=pre

@@ -62,6 +62,16 @@ def _pi(opts: Optional[Options]):
     return get_option(opts, Option.PanelImpl)
 
 
+def _nm(opts: Optional[Options]):
+    """Raw Option.NumMonitor value from a driver ``opts`` mapping — the
+    in-carry numerics-gauge switch the factor kernels consume (growth /
+    diagonal-margin monitoring, obs/numerics.py).  May be None:
+    ``obs.numerics.resolve_num_monitor`` inside each kernel is the
+    single authority for the context/env/auto default chain (auto = on
+    iff the obs layer is enabled)."""
+    return get_option(opts, Option.NumMonitor)
+
+
 def _ft_on(opts: Optional[Options]) -> bool:
     """True when Option.FaultTolerance selects an active ABFT policy.
     Off (the default) keeps this module on the plain kernels with zero
@@ -109,7 +119,7 @@ def potrf_mesh(
         return potrf_mesh_ft(a, mesh, nb, opts)
     return potrf_dist(
         from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts),
-        bcast_impl=_bi(opts), panel_impl=_pi(opts),
+        bcast_impl=_bi(opts), panel_impl=_pi(opts), num_monitor=_nm(opts),
     )
 
 
@@ -165,7 +175,7 @@ def getrf_nopiv_mesh(
         return getrf_nopiv_mesh_ft(a, mesh, nb, opts)
     return getrf_nopiv_dist(
         from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts),
-        bcast_impl=_bi(opts), panel_impl=_pi(opts),
+        bcast_impl=_bi(opts), panel_impl=_pi(opts), num_monitor=_nm(opts),
     )
 
 
@@ -325,7 +335,7 @@ def getrf_tntpiv_mesh(
     Returns (LU, perm over the padded row space, info)."""
     return getrf_tntpiv_dist(
         from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts),
-        bcast_impl=_bi(opts),
+        bcast_impl=_bi(opts), num_monitor=_nm(opts),
     )
 
 
@@ -514,7 +524,7 @@ def getrf_mesh(
     Returns (LU, perm over the padded row space, info)."""
     return getrf_pp_dist(
         from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts),
-        bcast_impl=_bi(opts),
+        bcast_impl=_bi(opts), num_monitor=_nm(opts),
     )
 
 

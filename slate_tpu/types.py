@@ -267,6 +267,22 @@ class Option(enum.Enum):
     # Resolution order: explicit option > dist_refine.use_mixed context >
     # SLATE_TPU_MIXED environment > auto.
     MixedPrecision = "mixed_precision"
+    # Numerical-health monitoring for the mesh factorization k-loops and
+    # the mixed-precision refinement loop (obs/numerics.py): "off" (the
+    # plain kernels, jaxpr-IDENTICAL — the PanelImpl/MixedPrecision
+    # pattern), "on" (the loop carry accumulates running element-growth /
+    # diagonal-margin gauges and the refinement while_loop keeps a
+    # fixed-size (||r||, ||x||) history buffer — zero extra collectives:
+    # the gauges ride the carry and reduce once at loop exit through the
+    # same unaudited pmax the info computation already uses, so comm-audit
+    # wire bytes are unchanged), or "auto" (the default: on when the obs
+    # layer is enabled — SLATE_TPU_OBS=1 / obs.enable() — off otherwise).
+    # Resolution order: explicit option > numerics.use_num_monitor
+    # context > SLATE_TPU_NUM environment > auto.  When monitoring is on,
+    # Option.MixedPrecision=auto additionally consults the measured
+    # f32-factor growth and a Hager-Higham condition estimate to pick its
+    # ladder entry tier (pathological inputs skip straight to GMRES-IR).
+    NumMonitor = "num_monitor"
     # Residual lowering for the mixed-precision refinement loop: "f64"
     # (plain SUMMA at the data dtype — XLA's emulated-f64 pairs on TPU),
     # "ozaki" (the int8 split-integer SUMMA: digit planes of A and X ride

@@ -25,7 +25,23 @@ def generate(
     """Named matrix kinds (matrix_generator.cc): rand, rands, randn, diag,
     identity, svd (geometric singular-value spectrum with condition
     ``cond``), spd (random SPD), hermitian, triangular-friendly `dominant`
-    (row-diagonally dominant, safe for no-pivot LU)."""
+    (row-diagonally dominant, safe for no-pivot LU), plus the adversarial
+    numerics kinds (ISSUE 10 — shared by tests, obs.numwatch, and fault
+    targeting):
+
+    - ``wilkinson``: the classic element-growth matrix (a_ii = 1,
+      a_ij = -1 below the diagonal, last column 1) — partial-pivot LU
+      takes every diagonal pivot and the last column doubles each step,
+      realizing the worst-case 2^{n-1} growth bound EXACTLY, so the
+      ``num.lu_growth`` gauge value is known in closed form.
+    - ``spd_svd``: prescribed-spectrum SPD via an orthogonal similarity
+      Q diag(s) Q^H with the geometric spectrum s_k = cond^{-k/(n-1)} —
+      ill-conditioned but exactly symmetric with known eigenvalues
+      (``svd`` is its general two-sided sibling).
+    - ``spd_neardiag``: near-singular-diagonal SPD — identity with one
+      diagonal entry at 1/cond (plus decoupled small symmetric noise on
+      the rest), so the Cholesky Schur diagonal dips to exactly 1/cond:
+      the ``num.chol_margin`` near-breakdown gauge's seeded target."""
     n = m if n is None else n
     rng = np.random.default_rng(seed)
     cplx = np.issubdtype(dtype, np.complexfloating)
@@ -67,6 +83,33 @@ def generate(
     if kind == "hermitian":
         a = rnd((m, m))
         return ((a + a.conj().T) / 2).astype(dtype)
+    if kind == "wilkinson":
+        a = np.zeros((m, n), dtype=dtype)
+        k = min(m, n)
+        a[np.arange(k), np.arange(k)] = 1
+        a[np.tril_indices(min(m, n), -1)] = -1
+        if m > n:  # keep the growth column last for rectangular shapes
+            a[n:, :] = 0
+        a[:, -1] = 1
+        return a
+    if kind == "spd_svd":
+        k = min(m, n)
+        qm, _ = np.linalg.qr(rnd((m, k)))
+        s = cond ** (-np.arange(k) / max(k - 1, 1))
+        a = (qm * s) @ qm.conj().T
+        return ((a + a.conj().T) / 2).astype(dtype)
+    if kind == "spd_neardiag":
+        a = np.eye(m, dtype=dtype)
+        j = m // 2
+        # small symmetric coupling away from the weak index keeps the
+        # matrix non-trivially dense while the min eigenvalue stays 1/cond
+        g = rnd((m, m)) * (0.1 / m)
+        g = (g + g.conj().T) / 2
+        g[j, :] = 0
+        g[:, j] = 0
+        a = a + g @ g.conj().T
+        a[j, j] = 1.0 / cond
+        return a.astype(dtype)
     if kind == "dominant":
         a = rnd((m, n))
         k = min(m, n)

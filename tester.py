@@ -361,6 +361,14 @@ def main(argv=None):
                          "MemoryModel + donation aliasing) for the first "
                          "requested routine with a mem driver (gemm / "
                          "potrf / getrf); needs the 8-device CPU mesh")
+    ap.add_argument("--num", default="",
+                    help="also write a num.* RunReport JSON "
+                         "(slate_tpu.obs.numwatch: monitored growth/margin "
+                         "gauges + distributed condest + mixed-ladder "
+                         "health routing on seeded inputs) for the first "
+                         "requested routine with a num driver (getrf / "
+                         "gesv -> lu, potrf / posv -> potrf, else mixed); "
+                         "needs the 8-device CPU mesh")
     args = ap.parse_args(argv)
 
     import jax
@@ -494,6 +502,24 @@ def main(argv=None):
             except Exception as e:
                 # obs must never flip a passed sweep's exit code
                 print(f"mem report failed: {e!r}")
+    if args.num:
+        from slate_tpu.obs import numwatch as _numwatch
+
+        num_ops = {"getrf": "lu", "gesv": "lu", "potrf": "potrf",
+                   "posv": "potrf"}
+        op = next((num_ops[r] for r in args.routines if r in num_ops),
+                  "mixed")
+        try:
+            rep = _numwatch.run_numwatch(op)
+            _numwatch.write_num_report(args.num, rep)
+            keys = [k for k in sorted(rep["values"]) if "_runtime_" not in k]
+            print(f"num report written to {args.num} ("
+                  + ", ".join(f"{k.split('num.', 1)[1]}="
+                              f"{rep['values'][k]:.3g}" for k in keys[:3])
+                  + ")")
+        except Exception as e:
+            # obs must never flip a passed sweep's exit code
+            print(f"num report failed: {e!r}")
     return 1 if failures else 0
 
 

@@ -1,0 +1,407 @@
+"""Numerics observability tests (ISSUE 10).
+
+The acceptance surface of the Option.NumMonitor in-carry gauge layer
+(obs/numerics.py + the threaded mesh k-loops), the distributed
+Hager-Higham condition estimators (dist_aux), the mixed ladder's
+health-aware entry-tier routing (dist_refine), and the num.* reporting
+surface:
+
+- NumMonitor=off is jaxpr-IDENTICAL to the unmonitored kernels for
+  every threaded k-loop, and monitoring ON changes neither the results
+  (bitwise) nor the comm-audit wire bytes (the gauges ride the carry).
+- Seeded adversarial inputs (utils.testing: Wilkinson growth,
+  prescribed-spectrum ill-conditioned, near-singular-diagonal SPD) trip
+  the gauges at their CLOSED-FORM values, depth-invariantly.
+- The distributed condest matches the single-chip estimators to rtol
+  and is bitwise-invariant across Option.BcastImpl.
+- MixedPrecision=auto under monitoring routes pathological inputs
+  straight to the GMRES tier (num.routed_gmres; the IR tier never runs)
+  and still meets the residual gate.
+- The refinement trajectory lands in the registry/report surface.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from slate_tpu.obs import REGISTRY, numerics
+from slate_tpu.parallel import make_mesh
+from slate_tpu.parallel.comm import comm_audit, use_bcast_impl
+from slate_tpu.parallel.dist import from_dense
+from slate_tpu.parallel.dist_aux import gecondest_dist, norm_dist, pocondest_dist
+from slate_tpu.parallel.dist_chol import potrf_dist
+from slate_tpu.parallel.dist_lu import (
+    getrf_nopiv_dist,
+    getrf_pp_dist,
+    getrf_tntpiv_dist,
+)
+from slate_tpu.types import Norm, Option, Uplo
+from slate_tpu.utils.testing import generate
+
+from conftest import cpu_devices
+
+N, NB = 48, 8
+
+
+def mesh24():
+    return make_mesh(2, 4, devices=cpu_devices(8))
+
+
+def _dist(a, mesh, pad=True):
+    return from_dense(jnp.asarray(a), mesh, NB, diag_pad_one=pad)
+
+
+def _factor_cases(mesh):
+    """(name, fn(num_monitor), tiles-extractor) per threaded factor loop."""
+    spd = generate("spd", N, seed=0)
+    dom = generate("dominant", N, seed=1)
+    gen = generate("randn", N, seed=2) + N * np.eye(N)
+    return [
+        ("potrf", lambda nm=None, la=None: potrf_dist(
+            _dist(spd, mesh), lookahead=la, num_monitor=nm)),
+        ("getrf_nopiv", lambda nm=None, la=None: getrf_nopiv_dist(
+            _dist(np.tril(dom) + N * np.eye(N), mesh), lookahead=la,
+            num_monitor=nm)),
+        ("getrf_pp", lambda nm=None, la=None: getrf_pp_dist(
+            _dist(gen, mesh), lookahead=la, num_monitor=nm)),
+        ("getrf_tntpiv", lambda nm=None, la=None: getrf_tntpiv_dist(
+            _dist(gen, mesh), lookahead=la, num_monitor=nm)),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# off-mode identity + monitored bitwise equality + wire-byte invariance
+# ---------------------------------------------------------------------------
+
+
+def test_monitoring_adds_zero_audited_wire_bytes():
+    """The acceptance bound: gauges ride the carry, not the network —
+    the audited comm-byte totals are IDENTICAL with monitoring on.
+
+    Runs FIRST in this module (pytest executes in definition order) with
+    a single cache clear, so every off/on kernel traces fresh inside its
+    audit exactly once and later tests reuse the compiled programs."""
+    mesh = mesh24()
+    jax.clear_caches()
+    for name, fn in _factor_cases(mesh):
+        with comm_audit() as off_recs:
+            fn(nm="off")
+        # nm=on is a distinct static-arg variant: first trace, fresh records
+        with comm_audit() as on_recs:
+            fn(nm="on")
+        off_total = sum(b * m for _, b, m in off_recs)
+        on_total = sum(b * m for _, b, m in on_recs)
+        assert off_total == on_total, (
+            f"{name}: monitored kernel moved {on_total - off_total} extra "
+            "audited bytes")
+
+
+def test_off_is_jaxpr_identical_per_kernel():
+    """NumMonitor=off must trace the exact unmonitored jaxpr for every
+    threaded k-loop (and auto must resolve to off while obs is
+    disabled)."""
+    mesh = mesh24()
+    for name, fn in _factor_cases(mesh):
+        j_off = jax.make_jaxpr(lambda _=None, fn=fn: fn(nm="off"))()
+        j_def = jax.make_jaxpr(lambda _=None, fn=fn: fn())()
+        assert str(j_off) == str(j_def), f"{name}: off != default jaxpr"
+        j_on = jax.make_jaxpr(lambda _=None, fn=fn: fn(nm="on"))()
+        assert str(j_on) != str(j_off), f"{name}: on traced no gauges"
+
+
+def test_mixed_refine_off_is_jaxpr_identical(rng):
+    """The fused refinement program: NumMonitor=off == no option (the
+    history buffer only ever enters the carry under on)."""
+    from slate_tpu.parallel.dist_refine import posv_mixed_mesh
+
+    mesh = mesh24()
+    a = jnp.asarray(generate("spd", N, seed=3))
+    b = jnp.asarray(rng.standard_normal((N, 2)))
+    j_off = jax.make_jaxpr(lambda x, y: posv_mixed_mesh(
+        x, y, mesh, NB, opts={Option.NumMonitor: "off"}))(a, b)
+    j_def = jax.make_jaxpr(lambda x, y: posv_mixed_mesh(x, y, mesh, NB))(a, b)
+    assert str(j_off) == str(j_def)
+    j_on = jax.make_jaxpr(lambda x, y: posv_mixed_mesh(
+        x, y, mesh, NB, opts={Option.NumMonitor: "on"}))(a, b)
+    assert str(j_on) != str(j_off)
+
+
+def test_monitored_results_bitwise_and_gauges_recorded():
+    mesh = mesh24()
+    for name, fn in _factor_cases(mesh):
+        off = fn(nm="off")
+        on = fn(nm="on")
+        t_off = off[0].tiles if isinstance(off, tuple) else off.tiles
+        t_on = on[0].tiles if isinstance(on, tuple) else on.tiles
+        assert bool(jnp.all(t_off == t_on)), f"{name}: monitoring moved bits"
+        assert numerics.last_gauges(name), f"{name}: no gauges recorded"
+
+
+# ---------------------------------------------------------------------------
+# gauge trips on the adversarial generators
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("la", [0, 2])
+def test_wilkinson_growth_exact_and_depth_invariant(la):
+    """The Wilkinson matrix realizes the 2^{n-1} partial-pivot growth
+    bound exactly; the in-carry gauge reproduces it at every lookahead
+    depth (panel-entry samples are strict-schedule intermediates)."""
+    mesh = mesh24()
+    w = _dist(generate("wilkinson", N), mesh)
+    alarms0 = REGISTRY.counter_value("num.growth_alarms", op="getrf_pp")
+    _lu, _perm, info = getrf_pp_dist(w, lookahead=la, num_monitor="on")
+    assert int(info) == 0
+    g = numerics.last_gauges("getrf_pp")
+    assert g["growth"] == 2.0 ** (N - 1)
+    assert g["growth"] > numerics.GROWTH_THRESHOLD
+    assert REGISTRY.counter_value(
+        "num.growth_alarms", op="getrf_pp") == alarms0 + 1
+
+
+def test_nopiv_growth_gauge_benign_and_wilkinson():
+    mesh = mesh24()
+    d = _dist(generate("dominant", N, seed=4), mesh)
+    getrf_nopiv_dist(d, num_monitor="on")
+    assert numerics.last_gauges("getrf_nopiv")["growth"] < 4.0
+    # Wilkinson needs no pivoting (unit diagonal pivots), so the nopiv
+    # elimination realizes the same 2^{n-1} growth
+    w = _dist(generate("wilkinson", N), mesh)
+    _lu, info = getrf_nopiv_dist(w, num_monitor="on")
+    assert int(info) == 0
+    assert numerics.last_gauges("getrf_nopiv")["growth"] == 2.0 ** (N - 1)
+
+
+def test_chol_margin_near_breakdown_seeded():
+    """The planted 1/cond Schur pivot is exactly what the margin gauge
+    reads; info stays 0 (the breakdown the info code CANNOT see)."""
+    mesh = mesh24()
+    near = _dist(generate("spd_neardiag", N, seed=5, cond=1e8), mesh)
+    _l, info = potrf_dist(near, num_monitor="on")
+    assert int(info) == 0
+    g = numerics.last_gauges("potrf")
+    assert g["margin"] == pytest.approx(1e-8, rel=1e-3)
+    assert g["diag_min"] == pytest.approx(1e-4, rel=1e-3)
+    well = _dist(generate("spd", N, seed=6), mesh)
+    potrf_dist(well, num_monitor="on")
+    assert numerics.last_gauges("potrf")["margin"] > 0.5
+
+
+def test_chol_margin_depth_invariant():
+    # strict depth 0 vs the default depth 1 the seeded test above ran
+    mesh = mesh24()
+    near = _dist(generate("spd_neardiag", N, seed=5, cond=1e8), mesh)
+    potrf_dist(near, lookahead=0, num_monitor="on")
+    assert numerics.last_gauges("potrf")["margin"] == pytest.approx(
+        1e-8, rel=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# distributed condest vs single-chip, across BcastImpl
+# ---------------------------------------------------------------------------
+
+
+def test_gecondest_dist_matches_single_chip():
+    from slate_tpu.linalg.lu import getrf_array
+    from slate_tpu.linalg.norms import gecondest
+    from slate_tpu.ops.tile_ops import genorm
+
+    mesh = mesh24()
+    a = generate("svd", N, seed=7, cond=1e6)
+    lu, perm, info = getrf_pp_dist(_dist(a, mesh), )
+    assert int(info) == 0
+    anorm = norm_dist(Norm.One, from_dense(jnp.asarray(a), mesh, NB))
+    rc_d = float(gecondest_dist(lu, perm, anorm))
+    rc_s = float(gecondest(Norm.One, getrf_array(jnp.asarray(a)),
+                           genorm(Norm.One, jnp.asarray(a))))
+    assert rc_d == pytest.approx(rc_s, rel=1e-6)
+    # the estimate brackets the true conditioning (Hager-Higham is a
+    # lower bound on ||A^-1||, so rcond is an over-estimate of rcond_true
+    # by at most a small factor)
+    assert 1e-8 < rc_d < 1e-4
+
+
+def test_pocondest_dist_matches_single_chip_and_impl_bitwise():
+    from slate_tpu.linalg.chol import potrf_array
+    from slate_tpu.linalg.norms import pocondest
+    from slate_tpu.ops.tile_ops import genorm
+
+    mesh = mesh24()
+    a = generate("spd_svd", N, seed=8, cond=1e5)
+    l, info = potrf_dist(_dist(a, mesh))
+    assert int(info) == 0
+    anorm = norm_dist(Norm.One, from_dense(jnp.asarray(a), mesh, NB))
+    rc = {}
+    for impl in ("psum", "ring", "doubling"):
+        with use_bcast_impl(impl):
+            rc[impl] = float(pocondest_dist(l, anorm))
+    assert rc["psum"] == rc["ring"] == rc["doubling"]
+    f, _ = potrf_array(jnp.asarray(a), Uplo.Lower)
+    rc_s = float(pocondest(Norm.One, f, genorm(Norm.One, jnp.asarray(a))))
+    assert rc["ring"] == pytest.approx(rc_s, rel=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# resolution chain
+# ---------------------------------------------------------------------------
+
+
+def test_num_monitor_resolution_chain(monkeypatch):
+    from slate_tpu import obs
+
+    monkeypatch.delenv(numerics.NUM_ENV, raising=False)
+    assert numerics.resolve_num_monitor("on") == "on"
+    assert numerics.resolve_num_monitor("off") == "off"
+    # auto: off while obs is disabled, on when enabled
+    assert numerics.resolve_num_monitor(None) == "off"
+    with obs.force_enabled():
+        assert numerics.resolve_num_monitor(None) == "on"
+    # context beats env beats auto; explicit beats context
+    monkeypatch.setenv(numerics.NUM_ENV, "on")
+    assert numerics.resolve_num_monitor(None) == "on"
+    with numerics.use_num_monitor("off"):
+        assert numerics.resolve_num_monitor(None) == "off"
+        assert numerics.resolve_num_monitor("on") == "on"
+    with pytest.raises(ValueError):
+        numerics.resolve_num_monitor("sometimes")
+
+
+# ---------------------------------------------------------------------------
+# IR trajectory + health-aware routing
+# ---------------------------------------------------------------------------
+
+
+def test_ir_history_exported_for_monitored_solve(rng):
+    from slate_tpu.parallel.dist_refine import posv_mixed_mesh
+
+    mesh = mesh24()
+    a = jnp.asarray(generate("spd", N, seed=9))
+    b = jnp.asarray(rng.standard_normal((N, 2)))
+    x, iters, info = posv_mixed_mesh(
+        a, b, mesh, NB, opts={Option.NumMonitor: "on"})
+    assert int(info) == 0 and int(iters) >= 0
+    hist = numerics.last_history("posv")
+    # initial solve + one row per correction step
+    assert len(hist) == int(iters) + 1
+    rnorms = [h[0] for h in hist]
+    assert all(np.isfinite(rnorms))
+    if len(rnorms) >= 2:
+        assert rnorms[-1] < rnorms[0]
+    # the gauge series lands in the registry (the RunReport surface)
+    snap = REGISTRY.snapshot()
+    series = [g for g in snap["gauges"]
+              if g["name"] == "ir.residual_history"
+              and g["tags"].get("op") == "posv"]
+    assert len(series) >= len(hist)
+
+
+def test_health_routing_skips_ir_to_gmres(rng):
+    """cond 1e8 >> CONDEST_THRESHOLD: the monitored auto ladder must
+    measure it on the f32 factor, skip the IR tier entirely, and still
+    deliver an answer at the residual gate via GMRES-IR."""
+    from slate_tpu.parallel.drivers import gesv_mesh
+
+    mesh = mesh24()
+    # N=96/nb=16 matches test_mixed_mesh's ladder shapes, so the heavy
+    # GMRES/IR programs are jit-cache hits from the earlier module
+    M, nb = 96, 16
+    a = generate("svd", M, seed=10, cond=1e8)
+    b = rng.standard_normal((M, 2))
+    routed0 = REGISTRY.counter_value("num.routed_gmres", op="gesv")
+    ir0 = REGISTRY.counter_value("ir.solves", op="gesv")
+    esc0 = REGISTRY.counter_value("ir.escalated_gmres", op="gesv")
+    with numerics.use_num_monitor("on"):
+        x, info = gesv_mesh(jnp.asarray(a), jnp.asarray(b), mesh, nb)
+    assert int(info) == 0
+    assert REGISTRY.counter_value("num.routed_gmres", op="gesv") == routed0 + 1
+    # IR tier skipped: no ir solve ran, and the route is NOT an escalation
+    assert REGISTRY.counter_value("ir.solves", op="gesv") == ir0
+    assert REGISTRY.counter_value("ir.escalated_gmres", op="gesv") == esc0
+    assert numerics.last_gauges("gesv")["cond"] > numerics.CONDEST_THRESHOLD
+    r = b - a @ np.asarray(x)
+    eps = np.finfo(np.float64).eps
+    gate = (np.abs(a).sum(axis=1).max() * np.abs(np.asarray(x)).max()
+            * eps * np.sqrt(M) * 10)
+    assert np.abs(r).max() <= gate
+
+
+def test_unmonitored_ladder_unchanged(rng):
+    """Without monitoring the ladder keeps the pre-ISSUE-10 behavior:
+    the IR tier RUNS (the health route never fires, no condest is
+    measured) — the exact contrast with the monitored test above, which
+    skipped it on the same input."""
+    from slate_tpu.parallel.drivers import gesv_mesh
+
+    mesh = mesh24()
+    M, nb = 96, 16  # shared ladder shapes (see the monitored test above)
+    a = generate("svd", M, seed=10, cond=1e8)
+    b = rng.standard_normal((M, 2))
+    routed0 = REGISTRY.counter_value("num.routed_gmres", op="gesv")
+    ir0 = REGISTRY.counter_value("ir.solves", op="gesv")
+    x, info = gesv_mesh(jnp.asarray(a), jnp.asarray(b), mesh, nb)
+    assert int(info) == 0
+    assert REGISTRY.counter_value("num.routed_gmres", op="gesv") == routed0
+    assert REGISTRY.counter_value("ir.solves", op="gesv") == ir0 + 1
+
+
+# ---------------------------------------------------------------------------
+# generators + reporting surface
+# ---------------------------------------------------------------------------
+
+
+def test_adversarial_generators_properties():
+    w = generate("wilkinson", 16)
+    assert np.all(np.diag(w) == 1) and w[-1, 0] == -1 and np.all(w[:, -1] == 1)
+    s = generate("spd_svd", 32, cond=1e6)
+    ev = np.linalg.eigvalsh(s)
+    assert ev.min() > 0
+    assert ev.max() / ev.min() == pytest.approx(1e6, rel=1e-3)
+    nd = generate("spd_neardiag", 32, cond=1e8)
+    ev2 = np.linalg.eigvalsh(nd)
+    assert ev2.min() == pytest.approx(1e-8, rel=1e-3)
+
+
+def test_num_section_in_report_and_gating():
+    from slate_tpu.obs import report
+
+    numerics.reset()
+    numerics.record_lu_growth("getrf_pp", 1.0, 3.0)
+    rep = report.make_report("num_test")
+    assert rep["num"]["lu_growth_max"] == 3.0
+    vals = report.load_values(rep)
+    assert vals["num_lu_growth_max"] == 3.0
+    # growth rising beyond threshold is a FAIL (lower-is-better)
+    worse = dict(vals, num_lu_growth_max=12.0)
+    failures, compared = report.check_regression(worse, vals, threshold=2.0)
+    assert any("num_lu_growth_max" in f for f in failures)
+    # an all-zero num section stays out of the comparison surface
+    numerics.reset()
+    rep0 = report.make_report("num_zero")
+    assert not any(k.startswith("num_") for k in report.load_values(rep0))
+    # sectioned-inconclusive vs artifacts that predate the num section
+    assert "num_lu_growth_max" in report.inconclusive_keys(vals, {})
+
+
+def test_numerics_perfetto_counter_track():
+    from slate_tpu.obs import perfetto
+
+    hist = [(1.0, 1.0), (1e-8, 1.0), (1e-16, 1.0)]
+    evs = perfetto.numerics_counter_events(hist, op="gesv")
+    assert sum(e["name"] == "num.ir_rnorm[gesv]" for e in evs) == 3
+    trace = perfetto.chrome_trace()
+    trace["traceEvents"].extend(evs)
+    assert perfetto.validate_chrome_trace(trace) == []
+
+
+def test_route_entry_tier_thresholds():
+    assert numerics.route_entry_tier("gesv", {"growth": 2.0**30}, None)
+    assert not numerics.route_entry_tier("gesv", {"growth": 2.0}, None)
+    assert numerics.route_entry_tier("gesv", {}, 1e-9)
+    assert not numerics.route_entry_tier("gesv", {}, 1e-3)
+    # SPD near-breakdown: tiny margin relative to the diag scale
+    assert numerics.route_entry_tier(
+        "posv", {"margin": 1e-9, "diag_max": 1.0}, None)
+    assert not numerics.route_entry_tier(
+        "posv", {"margin": 0.5, "diag_max": 1.0}, None)
