@@ -373,6 +373,23 @@ def _bench_mesh_solve(kind: str, mode: str):
     return flops / best / 1e9
 
 
+# Serving runtime (ISSUE 11): solves/s of the stacked batch driver vs
+# the one-at-a-time loop through the mesh driver at the canonical small
+# serving shape.  The ratio IS the headline the serving layer buys —
+# small problems can't fill the machine one at a time, batched ones can.
+def _bench_serve_batched():
+    from slate_tpu.parallel import make_mesh
+    from slate_tpu.serve.smoke import measure_throughput
+
+    thr = measure_throughput(make_mesh(), n=512, batch=8, reps=2,
+                             loop_reps=1)
+    if not thr["bitwise"]:
+        raise RuntimeError("serve batched parity broke under bench")
+    # stash both rates; the caller derives the speedup ratio
+    _bench_serve_batched.last = thr
+    return thr["batched_solves_per_s"]
+
+
 def _timeit_perturbed(fn, a, *rest, reps=2):
     """Best wall time with a PERTURBED first input per rep (identical
     dispatches are cached by the tunnel) and a queue drain per timing."""
@@ -531,6 +548,8 @@ def main():
         # mixed-precision mesh solve (ISSUE 8): the shipped auto ladder
         # vs the same driver pinned to the direct f64 path — mixed first
         # (cheap), the f64 baselines just before the n=8192 heavyweights
+        # serving runtime (ISSUE 11): batched small-problem throughput
+        ("serve_batched_solves_per_s", _bench_serve_batched),
         ("gesv_mixed_gflops", lambda: _bench_mesh_solve("gesv", "auto")),
         ("posv_mixed_gflops", lambda: _bench_mesh_solve("posv", "auto")),
         ("gesv_f64_direct_gflops", lambda: _bench_mesh_solve("gesv", "off")),
@@ -562,6 +581,9 @@ def main():
         fx = extras.get(f"{kind}_f64_direct_gflops")
         if isinstance(mx, float) and isinstance(fx, float) and fx > 0:
             extras[f"{kind}_mixed_vs_f64_speedup"] = round(mx / fx, 2)
+    thr = getattr(_bench_serve_batched, "last", None)
+    if thr is not None and thr["loop_solves_per_s"] > 0:
+        extras["serve_vs_loop_speedup"] = round(thr["speedup"], 2)
     if isinstance(extras.get("gemm_bf16_gflops"), float):
         extras["bf16_mfu_vs_peak"] = round(extras["gemm_bf16_gflops"] / V5E_BF16_PEAK, 3)
     ge = extras.get("gemm_f64_emulated_gflops")

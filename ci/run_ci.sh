@@ -109,6 +109,26 @@ python -m slate_tpu.obs.report --check \
     artifacts/obs/num_lu.report.json \
     --ignore 'num.*_runtime_*'
 
+# serve smoke (ISSUE 11): the serving runtime — the stacked batch driver
+# must beat the one-at-a-time mesh-dispatch loop >= 3x in solves/s at
+# n = 512 with bitwise per-problem parity, the executable cache must
+# perform ZERO retraces after warm-up (trace-counter asserted), ragged
+# block-diagonal packing must unpack exactly (non-interaction bitwise),
+# and the committed autotuned table (artifacts/serve/tuned.json, written
+# by `python -m slate_tpu.serve.tune` from measured sched.* flights)
+# must load and resolve with the explicit > context > env > tuned > auto
+# precedence.  The ring re-run proves the env tier keeps outranking the
+# tuned tier end-to-end.  The fresh report gates against the committed
+# reference on the deterministic cache-hygiene keys; machine-dependent
+# rates carry the _runtime_ infix and are --ignore'd.
+python -m slate_tpu.serve.smoke --out artifacts/serve_ci
+SLATE_TPU_BCAST_IMPL=ring python -m slate_tpu.serve.smoke \
+    --out artifacts/serve_ci_ring
+python -m slate_tpu.obs.report --check \
+    artifacts/serve_ci/serve.report.json \
+    artifacts/obs/serve.report.json \
+    --ignore 'serve.*_runtime_*'
+
 # scaling-curve artifact (ISSUE 7 satellite): fold the MULTICHIP round
 # artifacts into one RunReport-schema curve and schema-validate it
 # through the standard CLI (the committed twin lives at

@@ -955,6 +955,109 @@ def _getrf_nopiv_flight(ctx):
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# Serving-runtime drivers (ISSUE 11): the stacked batch programs the
+# executable cache pins (lax.map over the single-chip kernels — no
+# collectives, but the HIGHEST-dot / donation / kwarg passes still apply
+# to the mapped bodies), the block-diagonal packed mesh solve (a full
+# distributed posv over a packed operand), and the presplit Ozaki SUMMA
+# (A's digit planes entering as operands instead of being sliced
+# in-kernel — the broadcast schedule must stay lint-identical).
+# ---------------------------------------------------------------------------
+
+
+def _serve_stack(ctx, kind="spd", B=2):
+    import numpy as np
+    import jax.numpy as jnp
+
+    def make():
+        rng = np.random.default_rng(11)
+        g = rng.standard_normal((B, 4 * NB, 4 * NB))
+        if kind == "spd":
+            g = np.einsum("bij,bkj->bik", g, g) / (4 * NB) \
+                + 2 * np.eye(4 * NB)[None]
+        else:
+            g = g + 4 * NB * np.eye(4 * NB)[None]
+        return jnp.asarray(g)
+
+    return ctx._get(("serve_stack", kind, B), make)
+
+
+def _serve_rhs(ctx, B=2):
+    import numpy as np
+    import jax.numpy as jnp
+
+    return ctx._get(("serve_rhs", B), lambda: jnp.asarray(
+        np.random.default_rng(12).standard_normal((B, 4 * NB, 2))))
+
+
+@register("posv_batched", tags=("serve",))
+def _posv_batched(ctx):
+    from ..serve.batch import posv_batched
+
+    return posv_batched, (_serve_stack(ctx, "spd"), _serve_rhs(ctx))
+
+
+@register("gesv_batched", tags=("serve",))
+def _gesv_batched(ctx):
+    from ..serve.batch import gesv_batched
+
+    return gesv_batched, (_serve_stack(ctx, "general"), _serve_rhs(ctx))
+
+
+@register("potrf_batched", tags=("serve",))
+def _potrf_batched(ctx):
+    from ..serve.batch import potrf_batched
+
+    return potrf_batched, (_serve_stack(ctx, "spd"),)
+
+
+@register("gemm_batched", tags=("serve",))
+def _gemm_batched(ctx):
+    from ..serve.batch import gemm_batched
+
+    a = _serve_stack(ctx, "general")
+    return (lambda x, y: gemm_batched(1.0, x, y)), (a, a)
+
+
+@register("posv_packed_mesh", tags=("serve",))
+def _posv_packed(ctx):
+    """The block-diagonal packed mesh solve: two ragged problems through
+    ONE distributed posv (mixed off keeps the trace the direct driver's
+    — the packed path's own identity, not the refinement ladder's)."""
+    import jax.numpy as jnp
+    from ..parallel.drivers import posv_mesh
+    from ..serve.batch import pack_block_diag
+    from ..types import Option
+
+    a1 = ctx.dense(kind="spd")
+    a2 = jnp.eye(N, dtype="float64") * 2.0
+    opts = {Option.MixedPrecision: "off"}
+
+    def fn(x1, x2):
+        a, _ = pack_block_diag([x1, x2], N)
+        b = jnp.ones((2 * N, 2), x1.dtype)
+        return posv_mesh(a, b, ctx.mesh, NB, opts)
+
+    return fn, (a1, a2)
+
+
+@register("gemm_summa_ozaki_presplit", tags=("serve", "mixed"))
+def _gemm_ozaki_presplit(ctx):
+    """The stationary-A Ozaki SUMMA: digit planes enter as operands
+    (ozaki_presplit) — same broadcast engine schedule, same audited
+    bytes as the inline-split form."""
+    from ..parallel.summa import gemm_summa_ozaki, ozaki_presplit
+
+    a, b = ctx.dist(), ctx.dist()
+
+    def fn(x, y):
+        split = ozaki_presplit(x)
+        return gemm_summa_ozaki(1.0, x, y, a_split=split).tiles
+
+    return fn, (a, b)
+
+
 @register("potrf_dist_num", tags=("num",))
 def _potrf_num(ctx):
     from ..parallel.dist_chol import potrf_dist

@@ -202,6 +202,43 @@ def svd_decompose(a: ArrayLike):
     return svd_mod.svd_array(blas3._arr(a), want_vectors=True)
 
 
+# -- serving (slate_tpu.serve): batched small-problem verbs ------------------
+# The simplified-API face of the serving runtime: stacks of same-shaped
+# small problems run as ONE compiled program (bitwise-equal per problem
+# to the single verbs above); ``serve_router`` builds the full request
+# path (admission via the HBM model, condest-keyed accuracy classes,
+# executable cache + autotuned schedule table).
+
+
+def chol_solve_batched(a: Array, b: Array):
+    """Stacked chol_solve: (B, n, n) x (B, n, k) -> (x, info) stacks."""
+    from .serve.batch import posv_batched
+
+    return posv_batched(a, b)
+
+
+def lu_solve_batched(a: Array, b: Array,
+                     method: MethodLU = MethodLU.PartialPiv):
+    """Stacked lu_solve: (B, n, n) x (B, n, k) -> (x, info) stacks."""
+    from .serve.batch import gesv_batched
+
+    return gesv_batched(a, b, method)
+
+
+def multiply_batched(alpha, a: Array, b: Array, beta=0.0, c=None):
+    """Stacked multiply over (B, m, k) x (B, k, n) operand stacks."""
+    from .serve.batch import gemm_batched
+
+    return gemm_batched(alpha, a, b, beta, c)
+
+
+def serve_router(**kwargs):
+    """A serve.Router over this API's drivers (serve/router.py)."""
+    from .serve.router import Router
+
+    return Router(**kwargs)
+
+
 # -- norms / condition -------------------------------------------------------
 
 

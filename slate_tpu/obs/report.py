@@ -50,7 +50,12 @@ _LOWER_BETTER = ("second", "time", "byte", "error", "err", "resid", "latency",
                  # under a fixed workload (num.chol_margin_min and the
                  # history_drop convergence ratio stay higher-is-better)
                  "growth", "condest", "alarm", "routed", "ir_iters",
-                 "history_len")
+                 "history_len",
+                 # serving runtime: misses/retraces/rejections rising
+                 # under a fixed request stream = cache hygiene or
+                 # admission coverage degrading (hits/traces/warmups
+                 # stay direction-neutral counts that gate on equality)
+                 "cache_miss", "retrace", "admission_reject")
 
 # metric-name prefixes that form versioned report SECTIONS: when the new
 # report carries them and the old artifact predates the section entirely
@@ -60,7 +65,7 @@ _LOWER_BETTER = ("second", "time", "byte", "error", "err", "resid", "latency",
 # as inconclusive instead of silently ignoring it or failing the whole
 # check
 _SECTION_PREFIXES = ("sched.", "ft_", "ir_", "mem_", "mem.", "num_",
-                     "num.")
+                     "num.", "serve_", "serve.")
 
 # pure cost-model estimates with no better/worse direction: halving the
 # XLA flop estimate is usually an optimization, doubling may be a bigger
@@ -105,6 +110,7 @@ def make_report(
     base = min((s["t0"] for s in spans), default=0.0)
     from ..ft.policy import ft_counter_values
     from ..linalg.refine import ir_counter_values
+    from ..serve.metrics import serve_counter_values
     from .memory import mem_counter_values
     from .numerics import num_counter_values
 
@@ -130,6 +136,10 @@ def make_report(
         # count, worst element growth / condition estimate, gauge alarms
         # and health-based GMRES routes accumulated this run
         "num": num_counter_values(),
+        # serving-runtime totals (serve.metrics): request/batch counts,
+        # executable-cache hit/miss/trace hygiene, admission rejections,
+        # accuracy-class dispatches, stationary-operator cache reuse
+        "serve": serve_counter_values(),
         "metrics": REGISTRY.snapshot(),
         "spans": [
             {
@@ -177,7 +187,7 @@ def validate_report(rep) -> List[str]:
         not isinstance(m.get(k), list) for k in ("counters", "gauges", "histograms")
     ):
         errs.append("metrics must hold counters/gauges/histograms lists")
-    for sec in ("ft", "ir", "mem", "num"):  # optional (older reports predate these)
+    for sec in ("ft", "ir", "mem", "num", "serve"):  # optional (older reports predate these)
         sv = rep.get(sec)
         if sv is not None and (
             not isinstance(sv, dict)
@@ -252,6 +262,14 @@ def load_values(doc: dict, include_series: bool = False) -> Dict[str, float]:
                    if isinstance(v, (int, float))}
         if any(numvals.values()):
             vals.update({f"num_{k}": float(v) for k, v in numvals.items()})
+        # serve.* totals gate the same way: under a fixed request stream,
+        # cache misses / retraces / admission rejects rising is a serving
+        # hygiene regression; an all-zero section (no serving activity
+        # this run) stays out of the comparison surface
+        srvvals = {k: v for k, v in (doc.get("serve") or {}).items()
+                   if isinstance(v, (int, float))}
+        if any(srvvals.values()):
+            vals.update({f"serve_{k}": float(v) for k, v in srvvals.items()})
         if include_series:
             vals.update(flatten_snapshot(doc.get("metrics", {})))
         return {k: float(v) for k, v in vals.items()

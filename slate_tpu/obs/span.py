@@ -79,6 +79,10 @@ def reset() -> None:
     num = _sys.modules.get(__package__ + ".numerics")
     if num is not None:  # only if the numerics layer was ever consulted
         num.reset()
+    srv = _sys.modules.get(
+        __package__.rsplit(".", 1)[0] + ".serve.metrics")
+    if srv is not None:  # only if the serving layer was ever consulted
+        srv.reset()
 
 
 def _stack() -> List["Span"]:

@@ -70,7 +70,8 @@ from .dist_aux import norm_dist
 from .dist_lu import permute_rows_dist
 from .dist_trsm import trsm_dist
 from .mesh import mesh_shape
-from .summa import gemm_summa, gemm_summa_ozaki
+from .summa import OzakiSplit, gemm_summa, gemm_summa_ozaki, \
+    ozaki_presplit_cached
 
 _DEFAULT_NB = 256
 
@@ -225,7 +226,8 @@ def _inf_norm_pair_jit(rt, xt, mesh, p, q, m_true, n_true):
 
 
 def _ir_common(ad: DistMatrix, bd: DistMatrix, lo_solve, info,
-               max_iter: int, la, bi: str, ri: str, nm: bool = False):
+               max_iter: int, la, bi: str, ri: str, nm: bool = False,
+               qa=None, ea=None):
     """Shared refinement body over a factored low-precision solve.
 
     ``lo_solve(rd) -> DistMatrix`` applies the f32 factor to a distributed
@@ -260,11 +262,18 @@ def _ir_common(ad: DistMatrix, bd: DistMatrix, lo_solve, info,
                           mesh=like.mesh, diag_pad=like.diag_pad)
 
     def residual(x_t):
-        summa = gemm_summa_ozaki if ri == "ozaki" else functools.partial(
-            gemm_summa, method=MethodGemm.GemmC
-        )
-        return summa(-1.0, ad, wrap(x_t, bd), 1.0, bd,
-                     lookahead=la, bcast_impl=bi).tiles
+        if ri == "ozaki":
+            # A's digit planes ride in as loop-invariant operands
+            # (ozaki_presplit): the stationary operand is split ONCE per
+            # request — and, through the buffer-identity cache, once per
+            # OPERATOR — instead of once per refinement iteration
+            split = None if qa is None else OzakiSplit(qa=qa, ea=ea)
+            return gemm_summa_ozaki(-1.0, ad, wrap(x_t, bd), 1.0, bd,
+                                    lookahead=la, bcast_impl=bi,
+                                    a_split=split).tiles
+        return gemm_summa(-1.0, ad, wrap(x_t, bd), 1.0, bd,
+                          method=MethodGemm.GemmC, lookahead=la,
+                          bcast_impl=bi).tiles
 
     def cond(state):
         it, done = state[4], state[5]
@@ -311,7 +320,7 @@ def _ir_common(ad: DistMatrix, bd: DistMatrix, lo_solve, info,
     donate_argnums=(1,),
 )
 def _ir_posv_jit(at, bt, lt, info, mesh, p, q, m, nrhs, nb,
-                 max_iter, la, bi, ri, nm=False):
+                 max_iter, la, bi, ri, nm=False, qa=None, ea=None):
     ad = DistMatrix(tiles=at, m=m, n=m, nb=nb, mesh=mesh, diag_pad=True)
     bd = DistMatrix(tiles=bt, m=m, n=nrhs, nb=nb, mesh=mesh, diag_pad=False)
     ld = DistMatrix(tiles=lt, m=m, n=m, nb=nb, mesh=mesh, diag_pad=True)
@@ -324,7 +333,8 @@ def _ir_posv_jit(at, bt, lt, info, mesh, p, q, m, nrhs, nb,
                       bcast_impl=bi)
         return _astype_dist(x, at.dtype)
 
-    return _ir_common(ad, bd, lo_solve, info, max_iter, la, bi, ri, nm)
+    return _ir_common(ad, bd, lo_solve, info, max_iter, la, bi, ri, nm,
+                      qa, ea)
 
 
 @functools.partial(
@@ -333,7 +343,7 @@ def _ir_posv_jit(at, bt, lt, info, mesh, p, q, m, nrhs, nb,
     donate_argnums=(1,),
 )
 def _ir_gesv_jit(at, bt, lut, perm, info, mesh, p, q, m, nrhs, nb,
-                 max_iter, la, bi, ri, nm=False):
+                 max_iter, la, bi, ri, nm=False, qa=None, ea=None):
     ad = DistMatrix(tiles=at, m=m, n=m, nb=nb, mesh=mesh, diag_pad=True)
     bd = DistMatrix(tiles=bt, m=m, n=nrhs, nb=nb, mesh=mesh, diag_pad=False)
     lud = DistMatrix(tiles=lut, m=m, n=m, nb=nb, mesh=mesh, diag_pad=True)
@@ -347,7 +357,8 @@ def _ir_gesv_jit(at, bt, lut, perm, info, mesh, p, q, m, nrhs, nb,
                       bcast_impl=bi)
         return _astype_dist(x, at.dtype)
 
-    return _ir_common(ad, bd, lo_solve, info, max_iter, la, bi, ri, nm)
+    return _ir_common(ad, bd, lo_solve, info, max_iter, la, bi, ri, nm,
+                      qa, ea)
 
 
 def _factor_f32(kind: str, a: jax.Array, mesh: Mesh, nb: int, opts):
@@ -363,6 +374,59 @@ def _factor_f32(kind: str, a: jax.Array, mesh: Mesh, nb: int, opts):
         return l, None, info
     lu, perm, info = getrf_mesh(a32, mesh, nb, opts)
     return lu, perm, info
+
+
+# stationary-operator prefactor memo (the serving case: ONE operator,
+# a stream of right-hand sides): keyed on the dense operand's buffer
+# identity + the factor-relevant config, holding a strong reference to
+# the key array so its id cannot be recycled while the entry lives.
+# Each entry holds the dense A, the distributed f64 A and its f32
+# factor (~2.5 matrix copies), so residency is bounded two ways: the
+# entry cap, and a per-operand byte ceiling — a large one-shot solve
+# near the HBM ceiling must NOT have its buffers pinned by a serving
+# cache it never asked for (the 256-4096 serving bins all fit under
+# the default 256 MiB; SLATE_TPU_PREFACTOR_CACHE_MAX_BYTES overrides,
+# 0 disables the memo entirely).
+_PREFACTOR_MEMO: dict = {}
+_PREFACTOR_ORDER: list = []
+_PREFACTOR_CAP = 4
+_PREFACTOR_MAX_BYTES_ENV = "SLATE_TPU_PREFACTOR_CACHE_MAX_BYTES"
+
+
+def _prefactor_max_bytes() -> int:
+    try:
+        return int(float(os.environ.get(_PREFACTOR_MAX_BYTES_ENV, "") or
+                         (1 << 28)))
+    except ValueError:
+        return 1 << 28
+
+
+def clear_prefactor_cache() -> None:
+    _PREFACTOR_MEMO.clear()
+    _PREFACTOR_ORDER.clear()
+
+
+def _prefactor_cached(kind: str, a: jax.Array, mesh: Mesh, nb: int, opts):
+    """``_prefactor`` memoized on ``id(a)``: repeated routed solves
+    against the SAME dense operand object (the stationary-A serving
+    stream) reuse the f32 factor, the distributed f64 A — and, through
+    ``ozaki_presplit_cached`` keying on the reused ad.tiles buffer, the
+    Ozaki digit planes — instead of re-running the O(n^3) factor per
+    request.  Tracers bypass the memo (host caching is runtime-only)."""
+    if isinstance(a, jax.core.Tracer) or a.nbytes > _prefactor_max_bytes():
+        return _prefactor(kind, a, mesh, nb, opts)
+    from ..serve.cache import options_signature
+
+    key = (id(a), kind, id(mesh), nb, options_signature(opts))
+    hit = _PREFACTOR_MEMO.get(key)
+    if hit is not None and hit[0] is a:
+        return hit[1]
+    pre = _prefactor(kind, a, mesh, nb, opts)
+    _PREFACTOR_MEMO[key] = (a, pre)
+    _PREFACTOR_ORDER.append(key)
+    while len(_PREFACTOR_ORDER) > _PREFACTOR_CAP:
+        _PREFACTOR_MEMO.pop(_PREFACTOR_ORDER.pop(0), None)
+    return pre
 
 
 def _prefactor(kind: str, a: jax.Array, mesh: Mesh, nb: int, opts):
@@ -401,22 +465,27 @@ def _mixed_ir_solve(kind: str, a: jax.Array, b: jax.Array, mesh: Mesh,
     ri = resolve_residual_impl(opts)
     mi = _max_iter(opts, max_iter)
     nm = _num.resolve_num_monitor(_num.monitor_from_opts(opts)) == "on"
-    fact, perm, info, ad = pre if pre is not None else _prefactor(
+    fact, perm, info, ad = pre if pre is not None else _prefactor_cached(
         kind, a, mesh, nb, opts)
     bd = from_dense(b, mesh, nb)
     # the step-level flight recorder cannot descend into a fused
     # while_loop (its per-phase dispatches are host-driven); the factor
     # above records normally, the refinement runs as the one fused program
+    # stationary-A digit planes: split once per operator (buffer-identity
+    # cached) instead of once per refinement ITERATION — the planes enter
+    # the fused program as loop-invariant operands (summa.ozaki_presplit)
+    split = ozaki_presplit_cached(ad) if ri == "ozaki" else None
+    qa, ea = (split.qa, split.ea) if split is not None else (None, None)
     with _flight.no_flight():
         if kind == "posv":
             out = _ir_posv_jit(
                 ad.tiles, bd.tiles, fact.tiles, info, mesh, p, q, ad.m,
-                bd.n, nb, mi, la, bi, ri, nm,
+                bd.n, nb, mi, la, bi, ri, nm, qa, ea,
             )
         else:
             out = _ir_gesv_jit(
                 ad.tiles, bd.tiles, fact.tiles, perm, info, mesh, p, q,
-                ad.m, bd.n, nb, mi, la, bi, ri, nm,
+                ad.m, bd.n, nb, mi, la, bi, ri, nm, qa, ea,
             )
     x_t, _r_t, iters, conv, rn, xn = out[:6]
     hist = out[6] if nm else None
@@ -699,7 +768,7 @@ def _mixed_gmres_solve(kind: str, a, b, mesh, nb, opts, restart, pre=None):
     max_restarts = _max_iter(opts, None)
     from .comm import audit_scope
 
-    fact, perm, info, ad = pre if pre is not None else _prefactor(
+    fact, perm, info, ad = pre if pre is not None else _prefactor_cached(
         kind, a, mesh, nb, opts)
     b2 = b if b.ndim == 2 else b[:, None]
     cols, rnorms, convs = [], [], []
@@ -852,7 +921,7 @@ def mixed_mesh_route(kind, a, b, mesh, nb, opts, plain_fn):
         # decision falls back to the condest alone
         if nm_on:
             _num.clear_last("potrf" if kind == "posv" else "getrf_pp")
-        pre = _prefactor(kind, a, mesh, nb, opts)
+        pre = _prefactor_cached(kind, a, mesh, nb, opts)
         skip_ir = False
         if nm_on and mode == "auto":
             with sp.phase("health"):

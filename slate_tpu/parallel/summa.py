@@ -20,7 +20,7 @@ any depth is bitwise-identical (only independent work reorders).
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -109,6 +109,110 @@ def gemm_summa(
     return DistMatrix(tiles=out_t, m=a.m, n=b.n, nb=a.nb, mesh=a.mesh)
 
 
+class OzakiSplit(NamedTuple):
+    """A's digit planes + exponent grid in global tile-cyclic storage:
+    the error-free transformation ``gemm_summa_ozaki`` applies to its A
+    operand, precomputed so a STATIONARY operator (the serving/
+    refinement case: one A, many X) pays the split once instead of per
+    product.  ``qa`` is (S, mt, kt, nb, nb) int8, ``ea`` the per-row
+    exponent grid the planes were sliced on — both reingest into the
+    SUMMA kernel under the same shardings the inline split produces, so
+    results are bitwise-identical with or without presplitting."""
+
+    qa: jax.Array
+    ea: jax.Array
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def _ozaki_presplit_jit(at, mesh, p, q, n_slices):
+    from ..ops import ozaki
+
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(a_loc):
+        amax = lax.pmax(
+            jnp.max(jnp.abs(a_loc), axis=(1, 3)).astype(jnp.float32), COL_AXIS
+        )  # (mtl, nb): full-row max, replicated along mesh cols
+        ea = ozaki.row_exp_from_absmax(amax)
+        qa = ozaki.split_tiles(a_loc, ea[:, None, :, None], n_slices)
+        return qa, ea
+
+    return shard_map_compat(
+        kernel, mesh=mesh, in_specs=(spec,),
+        out_specs=(P(None, ROW_AXIS, COL_AXIS), P(ROW_AXIS, None)),
+        check_vma=False,
+    )(at)
+
+
+def ozaki_presplit(a: DistMatrix, n_slices: int = 9) -> OzakiSplit:
+    """Split A's f64 tiles into the int8 digit planes + exponent grid
+    the Ozaki SUMMA consumes (same global per-row maxima the inline
+    split uses — one pmax — so the planes are mesh-shape-invariant)."""
+    if a.dtype != jnp.float64:
+        raise TypeError(f"ozaki_presplit requires f64 tiles, got {a.dtype}")
+    p, q = mesh_shape(a.mesh)
+    qa, ea = _ozaki_presplit_jit(a.tiles, a.mesh, p, q, n_slices)
+    return OzakiSplit(qa=qa, ea=ea)
+
+
+# stationary-A digit-plane cache: keyed on the operand's BUFFER identity
+# (a strong reference to the key array rides the entry, so the id cannot
+# be recycled while it lives).  Serving traffic rotates through a few
+# stationary operators; residency is bounded by the entry cap AND a
+# per-operand byte ceiling (each entry pins the f64 tiles plus
+# n_slices/8 x their bytes in int8 planes — a big one-shot solve must
+# not have that pinned behind its back; the serving bins fit under the
+# default 256 MiB, SLATE_TPU_OZAKI_SPLIT_CACHE_MAX_BYTES overrides).
+_OZAKI_SPLIT_CACHE: "OrderedDict" = None  # type: ignore[assignment]
+_OZAKI_SPLIT_CAP = 8
+_OZAKI_SPLIT_MAX_BYTES_ENV = "SLATE_TPU_OZAKI_SPLIT_CACHE_MAX_BYTES"
+
+
+def _ozaki_split_max_bytes() -> int:
+    import os
+
+    try:
+        return int(float(os.environ.get(_OZAKI_SPLIT_MAX_BYTES_ENV, "") or
+                         (1 << 28)))
+    except ValueError:
+        return 1 << 28
+
+
+def ozaki_presplit_cached(a: DistMatrix, n_slices: int = 9) -> OzakiSplit:
+    """``ozaki_presplit`` memoized on ``id(a.tiles)``: repeated
+    refinement (or repeated products) against a stationary A skips the
+    re-split — the stationary-A twin of the serving executable cache.
+    Tracers bypass the cache (host memoization is a runtime concept)."""
+    global _OZAKI_SPLIT_CACHE
+    if (isinstance(a.tiles, jax.core.Tracer)
+            or a.tiles.nbytes > _ozaki_split_max_bytes()):
+        return ozaki_presplit(a, n_slices)
+    from collections import OrderedDict
+
+    from ..serve.metrics import serve_count
+
+    if _OZAKI_SPLIT_CACHE is None:
+        _OZAKI_SPLIT_CACHE = OrderedDict()
+    key = (id(a.tiles), n_slices)
+    hit = _OZAKI_SPLIT_CACHE.get(key)
+    if hit is not None and hit[0] is a.tiles:
+        _OZAKI_SPLIT_CACHE.move_to_end(key)
+        serve_count("ozaki_presplit_hits")
+        return hit[1]
+    split = ozaki_presplit(a, n_slices)
+    _OZAKI_SPLIT_CACHE[key] = (a.tiles, split)
+    _OZAKI_SPLIT_CACHE.move_to_end(key)
+    while len(_OZAKI_SPLIT_CACHE) > _OZAKI_SPLIT_CAP:
+        _OZAKI_SPLIT_CACHE.popitem(last=False)
+    serve_count("ozaki_presplits")
+    return split
+
+
+def clear_ozaki_split_cache() -> None:
+    global _OZAKI_SPLIT_CACHE
+    _OZAKI_SPLIT_CACHE = None
+
+
 @instrument("gemm_summa_ozaki")
 def gemm_summa_ozaki(
     alpha,
@@ -119,6 +223,7 @@ def gemm_summa_ozaki(
     lookahead: Optional[int] = None,
     bcast_impl: Optional[str] = None,
     n_slices: int = 9,
+    a_split: Optional[OzakiSplit] = None,
 ) -> DistMatrix:
     """C := alpha A B + beta C with the product computed by the Ozaki
     split-integer scheme on block-cyclic tile stacks (ops/ozaki.py taken
@@ -140,7 +245,13 @@ def gemm_summa_ozaki(
     padded k-steps contribute exact zeros (TwoSum identity).
 
     f64 only (the Ozaki split is an f64 error-free transformation);
-    ``n_slices=9`` is full f64 accuracy, 6 the faster ~2^-33 tier."""
+    ``n_slices=9`` is full f64 accuracy, 6 the faster ~2^-33 tier.
+
+    ``a_split`` is A's precomputed digit-plane transformation
+    (``ozaki_presplit``/``ozaki_presplit_cached``): stationary-A callers
+    (the refinement loop's residual, a served operator) pass it so every
+    product after the first skips A's re-split — bitwise-identical to
+    the inline split (same grids, same plane order)."""
     p, q = mesh_shape(a.mesh)
     if a.dtype != jnp.float64 or b.dtype != jnp.float64:
         raise TypeError(
@@ -155,38 +266,57 @@ def gemm_summa_ozaki(
     from .comm import la_depth, resolve_bcast_impl
 
     ctiles = None if c is None else c.tiles
-    out_t = _summa_ozaki_jit(
-        a.tiles, b.tiles, ctiles, alpha, beta, a.mesh, p, q, a.nt,
-        la_depth(lookahead, a.nt), resolve_bcast_impl(bcast_impl), n_slices,
-    )
+    la = la_depth(lookahead, a.nt)
+    bi = resolve_bcast_impl(bcast_impl)
+    if a_split is None:
+        out_t = _summa_ozaki_jit(
+            a.tiles, b.tiles, ctiles, alpha, beta, a.mesh, p, q, a.nt,
+            la, bi, n_slices,
+        )
+    else:
+        if a_split.qa.shape[0] != n_slices:
+            raise ValueError(
+                f"a_split carries {a_split.qa.shape[0]} planes, kernel "
+                f"wants {n_slices}")
+        out_t = _summa_ozaki_presplit_jit(
+            a_split.qa, a_split.ea, b.tiles, ctiles, alpha, beta, a.mesh,
+            p, q, a.nt, la, bi, n_slices,
+        )
     return DistMatrix(tiles=out_t, m=a.m, n=b.n, nb=a.nb, mesh=a.mesh)
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11))
-def _summa_ozaki_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, la, bi, n_slices):
+def _ozaki_summa_kernel(p, q, kt, la, n_slices, presplit: bool):
+    """The shared Ozaki SUMMA device kernel.  ``presplit=False`` takes
+    A's f64 tiles and splits in-kernel (the historical form, bitwise
+    unchanged); ``presplit=True`` takes the (qa, ea) planes as operands
+    — the broadcast schedule and accumulation are IDENTICAL either way,
+    only where A's slicing happens differs."""
     from ..ops import ozaki
-    from .comm import bcast_impl_scope, prefetch_bcast
+    from .comm import prefetch_bcast
 
-    spec = P(ROW_AXIS, COL_AXIS)
-
-    def kernel(a_loc, b_loc):
-        # a_loc: (mtl, ktl, nb, nb) f64; b_loc: (ktl2, ntl, nb, nb) f64
-        mtl, _, nb, _ = a_loc.shape
-        ntl = b_loc.shape[1]
+    def kernel(a_or_qa, ea_in, b_loc):
+        # b_loc: (ktl2, ntl, nb, nb) f64
+        ntl, nb = b_loc.shape[1], b_loc.shape[2]
 
         # global digit grids: per-row (A) / per-column (B) f32 maxima of
         # the hi components, reduced over the mesh axis that shards the
         # contraction — every device then slices on the same grid, which
         # is what makes the planes (and the product) mesh-shape-invariant
-        amax = lax.pmax(
-            jnp.max(jnp.abs(a_loc), axis=(1, 3)).astype(jnp.float32), COL_AXIS
-        )  # (mtl, nb): full-row max of my local rows
+        if presplit:
+            qa, ea = a_or_qa, ea_in
+            mtl = qa.shape[1]
+        else:
+            mtl = a_or_qa.shape[0]
+            amax = lax.pmax(
+                jnp.max(jnp.abs(a_or_qa), axis=(1, 3)).astype(jnp.float32),
+                COL_AXIS,
+            )  # (mtl, nb): full-row max of my local rows
+            ea = ozaki.row_exp_from_absmax(amax)               # (mtl, nb)
+            qa = ozaki.split_tiles(a_or_qa, ea[:, None, :, None], n_slices)
         bmax = lax.pmax(
             jnp.max(jnp.abs(b_loc), axis=(0, 2)).astype(jnp.float32), ROW_AXIS
         )  # (ntl, nb): full-column max of my local columns
-        ea = ozaki.row_exp_from_absmax(amax)                   # (mtl, nb)
         eb = ozaki.row_exp_from_absmax(bmax)                   # (ntl, nb)
-        qa = ozaki.split_tiles(a_loc, ea[:, None, :, None], n_slices)
         qb = ozaki.split_tiles(b_loc, eb[None, :, None, :], n_slices)
 
         def fetch(k):
@@ -207,6 +337,19 @@ def _summa_ozaki_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, la, bi, n_slices):
         sb = ozaki.exp2_scale_f64(eb)[None, :, None, :]   # (1, ntl, 1, nb)
         return ozaki.scale_rows_cols_f64(acc, sa, sb)
 
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _summa_ozaki_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, la, bi, n_slices):
+    from .comm import bcast_impl_scope
+
+    spec = P(ROW_AXIS, COL_AXIS)
+    body = _ozaki_summa_kernel(p, q, kt, la, n_slices, presplit=False)
+
+    def kernel(a_loc, b_loc):
+        return body(a_loc, None, b_loc)
+
     with bcast_impl_scope(bi):
         prod = shard_map_compat(
             kernel, mesh=mesh, in_specs=(spec, spec), out_specs=spec,
@@ -215,6 +358,25 @@ def _summa_ozaki_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, la, bi, n_slices):
     if ct is None:
         return (alpha * prod).astype(at.dtype)
     return (alpha * prod + beta * ct).astype(at.dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(6, 7, 8, 9, 10, 11, 12))
+def _summa_ozaki_presplit_jit(qa, ea, bt, ct, alpha, beta, mesh, p, q, kt,
+                              la, bi, n_slices):
+    from .comm import bcast_impl_scope
+
+    spec = P(ROW_AXIS, COL_AXIS)
+    body = _ozaki_summa_kernel(p, q, kt, la, n_slices, presplit=True)
+
+    with bcast_impl_scope(bi):
+        prod = shard_map_compat(
+            body, mesh=mesh,
+            in_specs=(P(None, ROW_AXIS, COL_AXIS), P(ROW_AXIS, None), spec),
+            out_specs=spec, check_vma=False,
+        )(qa, ea, bt)
+    if ct is None:
+        return (alpha * prod).astype(bt.dtype)
+    return (alpha * prod + beta * ct).astype(bt.dtype)
 
 
 def _gemm_summa_a(alpha, a: DistMatrix, b: DistMatrix, beta, c) -> DistMatrix:

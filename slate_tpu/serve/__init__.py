@@ -1,0 +1,71 @@
+"""Serving runtime: batched small-problem drivers, a persistent
+executable cache, and an autotuned schedule table (ISSUE 11).
+
+The reference SLATE is built for one big factorization at a time; the
+serving workload is the opposite — floods of 256–4096-sized solves where
+the one-at-a-time mesh dispatch leaves the hardware idle between
+requests.  This package is the throughput layer over ``api.py`` /
+``parallel/drivers.py``:
+
+- ``batch``: stacked batch drivers (one compiled program factors a
+  stack of B same-shaped problems, bitwise-equal per problem to the
+  single-problem kernels) plus block-diagonal packing that bins ragged
+  sizes into a few canonical shapes (pad-to-bin, pack k problems into
+  one block-diagonal operand, unpack solutions).
+- ``cache``: the persistent executable cache keyed on
+  ``(op, shape, dtype, batch, mesh, resolved Options)``, layered over
+  JAX's persistent compilation cache, with warm-up/pin APIs and
+  trace-count assertions (steady-state traffic performs ZERO retraces).
+- ``table`` / ``tune``: the autotuned schedule table.  ``python -m
+  slate_tpu.serve.tune`` sweeps (BcastImpl, Lookahead, nb, stationary
+  variant) per cache key using the flight recorder's measured
+  ``sched.*`` metrics as the objective and persists the winners as a
+  versioned artifact (``artifacts/serve/tuned.json``); the request path
+  resolves unset Options through the table (explicit > context > env >
+  tuned > auto — the Option.BcastImpl resolution-chain idiom extended
+  by one tier).
+- ``router``: admission control via ``MemoryModel.predict_max_n``,
+  accuracy-class dispatch via cached condition estimates (cheap
+  nopiv+IR for friendly operators, pp+GMRES-IR above
+  ``numerics.CONDEST_THRESHOLD`` — the Carson–Higham regime boundary),
+  then dispatch through the executable cache.
+- ``python -m slate_tpu.serve.smoke`` is the CI acceptance run; the
+  ``serve.*`` counters land in every RunReport and gate via
+  ``obs.report --check`` like the ft/ir/mem/num sections.
+"""
+
+from .batch import (  # noqa: F401
+    gemm_batched,
+    gesv_batched,
+    pack_block_diag,
+    pad_to_bin,
+    posv_batched,
+    potrf_batched,
+    unpack_block_diag,
+)
+from .cache import CacheKey, ExecutableCache, executable_cache  # noqa: F401
+from .metrics import serve_counter_values  # noqa: F401
+from .router import Router  # noqa: F401
+from .table import (  # noqa: F401
+    load_tuned_table,
+    resolve_request_options,
+    use_tuned_table,
+)
+
+__all__ = [
+    "CacheKey",
+    "ExecutableCache",
+    "executable_cache",
+    "Router",
+    "gemm_batched",
+    "gesv_batched",
+    "posv_batched",
+    "potrf_batched",
+    "pack_block_diag",
+    "pad_to_bin",
+    "unpack_block_diag",
+    "serve_counter_values",
+    "load_tuned_table",
+    "resolve_request_options",
+    "use_tuned_table",
+]

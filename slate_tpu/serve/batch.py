@@ -1,0 +1,216 @@
+"""Batched small-problem drivers + block-diagonal ragged packing.
+
+The serving workload is a flood of same-shaped small solves; running them
+one at a time pays a full dispatch (and, on the mesh, a full shard_map
+round) per problem.  The batch drivers here run a STACK of B problems as
+one compiled program: the per-problem body is ``lax.map`` over the exact
+single-problem kernels (linalg/chol.py, linalg/lu.py, blas3), so batched
+results are BITWISE identical to per-problem solves — slicing a stacked
+operand and mapping the same kernel reproduces the single trace per
+element (vmap is deliberately NOT used: batching the blocked kernels'
+dot_generals changes reduction kernels, which breaks bitwise parity and
+measured slower on the k-loop-heavy bodies).
+
+``vmap`` over the shard_map mesh kernels is not viable (and the mesh
+dispatch is exactly the per-request overhead serving must avoid for
+256–4096-sized problems), so the mesh path batches by PACKING instead:
+``pack_block_diag`` bins ragged sizes into a few canonical shapes
+(pad-to-bin with an identity diagonal, the ``from_dense(diag_pad_one)``
+contract) and packs k problems into one block-diagonal operand — one
+mesh factorization then factors all k at once, and ``unpack_block_diag``
+recovers per-problem solutions.  The blocks never mix: co-packed
+operands only ever contribute structural zeros to each other's rows, so
+each unpacked solution is BITWISE what the same problem yields packed
+alone (asserted in serve.smoke / tests/test_serve.py), and matches the
+unpadded per-problem solve to factorization accuracy.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..types import MethodLU, Options, Uplo
+from .metrics import serve_count
+
+# Canonical serving bins (the steady-state traffic shape classes): a
+# request of size n runs at the smallest bin >= n.  2048/4096 stay listed
+# even though CPU smoke never exercises them — the bin set IS the cache
+# key vocabulary.
+DEFAULT_BINS: Tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096)
+
+
+# ---------------------------------------------------------------------------
+# Stacked batch drivers (bitwise per-problem)
+# ---------------------------------------------------------------------------
+
+
+def posv_batched(a: jax.Array, b: jax.Array):
+    """Stacked SPD solve: ``a`` (B, n, n) lower-referenced, ``b``
+    (B, n, nrhs).  Returns (x (B, n, nrhs), info (B,)) — row i bitwise
+    equals ``chol.posv_array(a[i], b[i])``."""
+    from ..linalg.chol import posv_array
+
+    def one(ab):
+        x, _f, info = posv_array(ab[0], ab[1], Uplo.Lower)
+        return x, info
+
+    return lax.map(one, (a, b))
+
+
+def potrf_batched(a: jax.Array):
+    """Stacked lower Cholesky: (B, n, n) -> (l (B, n, n), info (B,))."""
+    from ..linalg.chol import potrf_array
+
+    return lax.map(lambda x: potrf_array(x, Uplo.Lower), a)
+
+
+def gesv_batched(a: jax.Array, b: jax.Array,
+                 method: MethodLU = MethodLU.PartialPiv):
+    """Stacked general solve: returns (x (B, n, nrhs), info (B,)) — row i
+    bitwise equals ``lu.gesv_array(a[i], b[i], method)``."""
+    from ..linalg.lu import gesv_array
+
+    def one(ab):
+        x, f = gesv_array(ab[0], ab[1], method)
+        return x, f.info
+
+    return lax.map(one, (a, b))
+
+
+def gemm_batched(alpha, a: jax.Array, b: jax.Array, beta=0.0,
+                 c: Optional[jax.Array] = None):
+    """Stacked C = alpha A B + beta C over (B, m, k) x (B, k, n)."""
+    from ..blas3.blas3 import gemm_array
+
+    if c is None:
+        c = jnp.zeros(a.shape[:2] + (b.shape[2],), a.dtype)
+    return lax.map(lambda abc: gemm_array(alpha, abc[0], abc[1], beta,
+                                          abc[2]), (a, b, c))
+
+
+BATCHED_DRIVERS = {
+    "posv": posv_batched,
+    "gesv": gesv_batched,
+    "potrf": potrf_batched,
+    "gemm": gemm_batched,
+}
+
+
+# ---------------------------------------------------------------------------
+# Ragged-size binning + block-diagonal packing
+# ---------------------------------------------------------------------------
+
+
+def bin_for(n: int, bins: Sequence[int] = DEFAULT_BINS) -> Optional[int]:
+    """Smallest canonical bin >= n, or None when n exceeds every bin
+    (too big to serve through the small-problem path)."""
+    for m in sorted(bins):
+        if n <= m:
+            return int(m)
+    return None
+
+
+def pad_to_bin(a: jax.Array, m: int, factorizable: bool = True) -> jax.Array:
+    """Pad an (n, n) operand to (m, m).  ``factorizable`` pads the new
+    diagonal with the identity (the ``from_dense(diag_pad_one=True)``
+    contract: diag(A, I) factors to diag(L, I) with the pad never mixing
+    into data rows); gemm-style operands pad with zeros."""
+    n = a.shape[0]
+    if n == m:
+        return a
+    if n > m:
+        raise ValueError(f"operand of size {n} exceeds bin {m}")
+    out = jnp.zeros((m, m), a.dtype)
+    out = out.at[:n, :n].set(a)
+    if factorizable:
+        out = out.at[jnp.arange(n, m), jnp.arange(n, m)].set(1.0)
+    return out
+
+
+def pad_rhs_to_bin(b: jax.Array, m: int) -> jax.Array:
+    """Zero-pad an (n, nrhs) right-hand side to (m, nrhs)."""
+    n = b.shape[0]
+    if n == m:
+        return b
+    return jnp.zeros((m,) + b.shape[1:], b.dtype).at[:n].set(b)
+
+
+def pack_block_diag(
+    operands: Sequence[jax.Array], m: int,
+    rhs: Optional[Sequence[jax.Array]] = None,
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Pack k ragged operands (each n_i <= m) into ONE (k*m, k*m)
+    block-diagonal matrix (each block identity-padded to the bin) and,
+    when given, stack their right-hand sides into one (k*m, nrhs) RHS.
+    One factorization of the packed operand factors all k problems; the
+    blocks never interact (their cross terms are structural zeros, and
+    partial pivoting cannot select a zero row over a diagonal-1 pad)."""
+    k = len(operands)
+    dtype = operands[0].dtype
+    a = jnp.zeros((k * m, k * m), dtype)
+    for i, op in enumerate(operands):
+        a = a.at[i * m:(i + 1) * m, i * m:(i + 1) * m].set(
+            pad_to_bin(jnp.asarray(op), m))
+    if not isinstance(a, jax.core.Tracer):
+        # runtime-only counter (the ir.*/num.* convention): a traced
+        # packer must not inflate the gated serve section per trace
+        serve_count("packed_problems", k)
+    if rhs is None:
+        return a, None
+    nrhs = max(r.shape[1] for r in rhs)
+    b = jnp.zeros((k * m, nrhs), dtype)
+    for i, r in enumerate(rhs):
+        b = b.at[i * m:i * m + r.shape[0], :r.shape[1]].set(jnp.asarray(r))
+    return a, b
+
+
+def unpack_block_diag(
+    x: jax.Array, sizes: Sequence[int], m: int,
+    nrhs: Optional[Sequence[int]] = None,
+) -> List[jax.Array]:
+    """Slice per-problem solutions back out of a packed solve's (k*m,
+    nrhs) solution stack: block i's rows are [i*m, i*m + sizes[i])."""
+    out = []
+    for i, n in enumerate(sizes):
+        xi = x[i * m:i * m + n]
+        if nrhs is not None:
+            xi = xi[:, :nrhs[i]]
+        out.append(xi)
+    return out
+
+
+def posv_packed_mesh(
+    operands: Sequence[jax.Array], rhs: Sequence[jax.Array], mesh,
+    nb: Optional[int] = None, bins: Sequence[int] = DEFAULT_BINS,
+    opts: Optional[Options] = None,
+) -> Tuple[List[jax.Array], jax.Array]:
+    """Ragged SPD solves through ONE mesh factorization: bin to the
+    largest requested size class, pack block-diagonally, run posv_mesh
+    once, unpack.  The mesh-scale twin of the stacked drivers — use it
+    when the packed size is big enough to want the 2D grid.
+
+    This IS a serving request path, so unset schedule options resolve
+    through the autotuned table (explicit > context > env > tuned >
+    auto; serve/table.py): the tuned ``nb`` becomes the mesh tile size
+    when ``nb`` is None, and tuned BcastImpl/Lookahead ride ``opts``
+    into the mesh k-loops.  Returns (per-problem solutions, info)."""
+    from ..parallel.drivers import posv_mesh
+    from ..parallel.mesh import mesh_shape
+    from ..types import Option, get_option
+    from .table import resolve_request_options
+
+    m = bin_for(max(op.shape[0] for op in operands), bins)
+    if m is None:
+        raise ValueError("packed operand exceeds the largest serving bin")
+    a, b = pack_block_diag(operands, m, rhs)
+    merged = resolve_request_options(
+        opts, "posv", a.shape[0], str(a.dtype), mesh_shape(mesh))
+    if nb is None:
+        nb = int(get_option(merged, Option.BlockSize, default=64))
+    x, info = posv_mesh(a, b, mesh, nb, merged)
+    return unpack_block_diag(x, [op.shape[0] for op in operands], m,
+                             [r.shape[1] for r in rhs]), info

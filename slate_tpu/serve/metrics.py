@@ -1,0 +1,62 @@
+"""serve.* counters: the serving layer's observability surface.
+
+Mirrors the ft.*/ir.*/mem.*/num.* counter sections (ft/policy.py,
+linalg/refine.py): a plain always-on dict that ``obs.report.make_report``
+folds into every RunReport as the ``serve`` section, so cache-hygiene
+regressions (retraces creeping back into the steady state, the batched
+path silently falling back to one-at-a-time dispatch) gate in CI exactly
+like perf regressions.  ``*_runtime_*``-infixed report VALUES are the
+machine-dependent keys the CI gate ``--ignore``s; everything here is a
+deterministic count under a fixed workload and gates tight.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+_ZEROS: Dict[str, float] = {
+    # request router
+    "requests": 0.0,           # single problems entering the serve layer
+    "batches": 0.0,            # compiled batch programs dispatched
+    "batched_solves": 0.0,     # problems solved through batch programs
+    "packed_problems": 0.0,    # ragged problems packed block-diagonally
+    "admission_rejects": 0.0,  # requests over the HBM admission bound
+    "class_friendly": 0.0,     # condest-keyed cheap-path dispatches
+    "class_hostile": 0.0,      # condest-keyed GMRES-IR dispatches
+    # executable cache
+    "cache_hits": 0.0,         # key already held a compiled program
+    "cache_misses": 0.0,       # key built (and traced) a new program
+    "traces": 0.0,             # actual tracer executions of cached programs
+    "warmups": 0.0,            # programs compiled ahead of traffic
+    # schedule-table resolution
+    "tuned_resolutions": 0.0,  # options filled from the tuned table
+    # stationary-operator caches (the serving twins)
+    "condest_cache_hits": 0.0,   # condest served from a factor's memo
+    "ozaki_presplits": 0.0,      # digit-plane splits computed
+    "ozaki_presplit_hits": 0.0,  # splits served from the operand cache
+}
+
+_COUNTS: Dict[str, float] = dict(_ZEROS)
+
+
+def serve_count(name: str, n: float = 1.0) -> None:
+    """Bump one serve.* counter (and its obs-registry twin when the obs
+    layer is enabled, so counts also land tagged in metric snapshots)."""
+    if name not in _COUNTS:
+        raise KeyError(f"unknown serve counter {name!r}")
+    _COUNTS[name] += n
+    from ..obs import REGISTRY, enabled
+
+    if enabled():
+        REGISTRY.counter_add(f"serve.{name}", n)
+
+
+def serve_counter_values() -> Dict[str, float]:
+    """Snapshot for RunReports (obs.report.make_report's ``serve``
+    section)."""
+    return dict(_COUNTS)
+
+
+def reset() -> None:
+    _COUNTS.clear()
+    _COUNTS.update(_ZEROS)

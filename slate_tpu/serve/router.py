@@ -1,0 +1,293 @@
+"""serve.Router: admission -> accuracy class -> cached batched dispatch.
+
+The thin request layer tying the serving pieces to the observability
+stack PRs 7–10 built:
+
+- **Admission** rides ``MemoryModel.predict_max_n``: a request whose
+  modeled residency exceeds the per-request HBM budget is rejected
+  before any pod time is burned (``serve.admission_rejects``).
+- **Accuracy class** rides the cached condition estimate (the Carson &
+  Higham three-precision regime boundary already encoded in
+  ``numerics.CONDEST_THRESHOLD``): friendly general operators dispatch
+  the cheap no-pivot f32 factor + iterative refinement; operators whose
+  condest crosses the threshold dispatch partial pivoting + GMRES-IR
+  (the stall regime where classic IR on a cheap factor diverges).  The
+  estimate is memoized per operand buffer, so a stationary operator
+  pays the Hager–Higham probe loop once across its request stream.
+- **Dispatch** goes through the executable cache: same-shaped requests
+  stack into one compiled batch program (serve/batch.py).  The stacked
+  single-chip programs have no schedule knobs, so tuned options are
+  NOT folded into their cache keys (a re-tuned table must not re-key
+  programs it cannot affect); the autotuned table's consumers are the
+  mesh request paths (batch.posv_packed_mesh resolves explicit >
+  context > env > tuned > auto into nb/BcastImpl/Lookahead).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import Norm, Options, SlateError
+from .batch import DEFAULT_BINS, bin_for, pad_rhs_to_bin, pad_to_bin
+from .cache import ExecutableCache, executable_cache, make_key
+from .metrics import serve_count
+
+
+class _BufferMemo:
+    """Small LRU keyed on operand buffer identity (id()), holding a
+    strong reference to the key array so the id cannot be recycled
+    while the entry lives — the stationary-operator cache pattern
+    (condest, digit planes).  Capped: serving traffic rotates through a
+    handful of stationary operators, not thousands."""
+
+    def __init__(self, cap: int = 16) -> None:
+        self._cap = cap
+        self._entries: OrderedDict = OrderedDict()
+
+    def get(self, arr, extra=()) -> Optional[object]:
+        key = (id(arr),) + tuple(extra)
+        hit = self._entries.get(key)
+        if hit is None:
+            return None
+        ref, value = hit
+        if ref is not arr:  # id recycled across a dropped entry
+            del self._entries[key]
+            return None
+        self._entries.move_to_end(key)
+        return value
+
+    def put(self, arr, value, extra=()) -> None:
+        key = (id(arr),) + tuple(extra)
+        self._entries[key] = (arr, value)
+        self._entries.move_to_end(key)
+        while len(self._entries) > self._cap:
+            self._entries.popitem(last=False)
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+
+class Router:
+    """Synchronous request router over the batched drivers.
+
+    ``solve_batch`` is the serving entry: a list of (op, a, b) requests
+    is admitted, classified, binned into canonical shapes, stacked, and
+    dispatched through the executable cache — steady-state traffic of a
+    bounded shape vocabulary touches a handful of compiled programs and
+    never re-traces."""
+
+    def __init__(self, mesh=None, nb: int = 64,
+                 bins: Sequence[int] = DEFAULT_BINS,
+                 hbm_budget: Optional[int] = None,
+                 cache: Optional[ExecutableCache] = None,
+                 opts: Optional[Options] = None) -> None:
+        from ..obs import memmodel
+
+        self.mesh = mesh
+        self.nb = nb
+        self.bins = tuple(sorted(bins))
+        self.cache = cache if cache is not None else executable_cache
+        self.opts = dict(opts) if opts else {}
+        self._budget = hbm_budget if hbm_budget is not None else int(
+            memmodel.hbm_budget() * memmodel.HBM_SAFETY)
+        self._max_n: Dict[str, int] = {}
+        self._condest_memo = _BufferMemo()
+
+    # -- admission ---------------------------------------------------------
+
+    def max_n(self, op: str) -> int:
+        """Largest admissible n for ``op`` under the HBM budget (modeled
+        per-device peak, memmodel.predict_max_n; cached per op)."""
+        from ..obs import memmodel
+
+        got = self._max_n.get(op)
+        if got is None:
+            model_op = {"posv": "potrf", "potrf": "potrf",
+                        "gemm": "summa", "summa": "summa"}.get(
+                            op, "getrf_nopiv")
+            grid = ((1, 1) if self.mesh is None
+                    else tuple(self.mesh.devices.shape))
+            got = memmodel.predict_max_n(
+                self._budget, op=model_op, nb=max(self.nb, 8), grid=grid,
+                dtype="float64")
+            self._max_n[op] = got
+        return got
+
+    def admit(self, op: str, n: int) -> None:
+        if n > self.max_n(op):
+            serve_count("admission_rejects")
+            raise SlateError(
+                f"serve admission: {op} n={n} exceeds modeled HBM budget "
+                f"(max admissible n={self.max_n(op)}, budget "
+                f"{self._budget / 2**30:.2f} GiB)")
+
+    def admit_batch(self, op: str, m: int, count: int, itemsize: int) -> None:
+        """Aggregate residency check for one stacked dispatch: the whole
+        (count, m, m) operand stack + RHS/solution + factor transients
+        live at once in the single program (per-problem admission bounds
+        one problem, not the stack).  ~3.5 stack copies covers operand +
+        factor + solution + XLA temps for the mapped bodies."""
+        agg = 3.5 * count * m * m * itemsize
+        if agg > self._budget:
+            serve_count("admission_rejects")
+            raise SlateError(
+                f"serve admission: batch of {count} x {op} n={m} needs "
+                f"~{agg / 2**30:.2f} GiB aggregate, over the "
+                f"{self._budget / 2**30:.2f} GiB budget — split the batch")
+
+    # -- accuracy class ----------------------------------------------------
+
+    def classify(self, op: str, a: jax.Array) -> str:
+        """"friendly" | "hostile" per the cached reciprocal condition
+        estimate.  The f32 probe factor is cheap (it is also the factor
+        the friendly path would reuse conceptually); a stationary
+        operator's estimate is memoized on its buffer identity, so a
+        million-solve request stream pays the probe loop once."""
+        from ..linalg import norms
+        from ..obs.numerics import CONDEST_THRESHOLD
+
+        if not jnp.issubdtype(a.dtype, jnp.floating) or a.dtype != jnp.float64:
+            return "friendly"  # accuracy ladder is the f64 story
+        cached = self._condest_memo.get(a, (op,))
+        if cached is None:
+            from ..linalg.lu import getrf_array
+
+            anorm = jnp.abs(a).sum(axis=0).max()  # one-norm
+            f = getrf_array(a.astype(jnp.float32))
+            rcond = norms.gecondest(Norm.One, f, anorm)
+            cached = float(rcond)
+            self._condest_memo.put(a, cached, (op,))
+        else:
+            serve_count("condest_cache_hits")
+        cond = (1.0 / cached) if cached > 0 else float("inf")
+        hostile = cond > CONDEST_THRESHOLD
+        serve_count("class_hostile" if hostile else "class_friendly")
+        return "hostile" if hostile else "friendly"
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _program(self, op: str, variant: str, args: Tuple[jax.Array, ...],
+                 batch: int):
+        # the stacked single-chip programs have NO schedule knobs (no
+        # broadcasts, no k-loop pipelining), so tuned options are
+        # deliberately NOT folded into their cache keys — a re-tuned
+        # table must not re-key (and re-trace) programs it cannot
+        # affect.  The tuned tier's consumers are the mesh paths
+        # (batch.posv_packed_mesh resolves it into nb/BcastImpl/
+        # Lookahead for the packed solve).
+        key = make_key(f"{op}_{variant}", args, batch=batch, mesh=None)
+        return self.cache.get_or_build(key, lambda: _build_batched(
+            op, variant)), key
+
+    def solve_batch(self, requests: Sequence[Tuple[str, jax.Array, jax.Array]]
+                    ) -> List[jax.Array]:
+        """Serve a list of (op, a, b) requests (op in {"posv", "gesv"}).
+        Returns per-request solutions in order.  Same-class requests
+        sharing a bin run as ONE stacked compiled program (ragged sizes
+        identity-pad to the bin; the padded rows solve an appended
+        identity system and never touch data rows)."""
+        groups: Dict[Tuple, List[int]] = {}
+        padded: List[Optional[Tuple[jax.Array, jax.Array]]] = [None] * len(requests)
+        for i, (op, a, b) in enumerate(requests):
+            serve_count("requests")
+            n = a.shape[0]
+            m = bin_for(n, self.bins)
+            if m is None:
+                serve_count("admission_rejects")
+                raise SlateError(f"serve: n={n} exceeds the largest bin "
+                                 f"{self.bins[-1]}")
+            self.admit(op, m)  # the program runs at the PADDED bin size
+            klass = self.classify(op, a) if op == "gesv" else "friendly"
+            bd = b if b.ndim == 2 else b[:, None]
+            padded[i] = (pad_to_bin(a, m), pad_rhs_to_bin(bd, m))
+            groups.setdefault(
+                (op, klass, m, bd.shape[1], str(a.dtype)), []).append(i)
+
+        out: List[Optional[jax.Array]] = [None] * len(requests)
+        for (op, klass, m, nrhs, _dt), idxs in groups.items():
+            a_stack = jnp.stack([padded[i][0] for i in idxs])
+            b_stack = jnp.stack([padded[i][1] for i in idxs])
+            self.admit_batch(op, m, len(idxs), a_stack.dtype.itemsize)
+            prog, _key = self._program(op, klass, (a_stack, b_stack),
+                                       batch=len(idxs))
+            xs, info = prog(a_stack, b_stack)
+            serve_count("batches")
+            serve_count("batched_solves", len(idxs))
+            bad = [idxs[j] for j, v in enumerate(np.asarray(info)) if v != 0]
+            if bad:
+                # never silently serve a failed factorization's output
+                raise SlateError(
+                    f"serve: {op} batch reported nonzero info for request "
+                    f"indices {bad} — operand(s) not factorizable in the "
+                    f"{klass} class")
+            for j, i in enumerate(idxs):
+                n = requests[i][1].shape[0]
+                xi = xs[j, :n]
+                out[i] = xi[:, 0] if requests[i][2].ndim == 1 else xi
+        return out  # type: ignore[return-value]
+
+    def solve(self, op: str, a: jax.Array, b: jax.Array) -> jax.Array:
+        """One request through the full policy (a batch of one)."""
+        return self.solve_batch([(op, a, b)])[0]
+
+
+def _build_batched(op: str, variant: str):
+    """The pure stacked solve body for one (op, accuracy-class) pair —
+    what the executable cache jits and pins."""
+    from jax import lax
+
+    if op == "posv":
+        from ..linalg.chol import posv_array
+
+        def posv(a, b):
+            def one(ab):
+                x, _f, info = posv_array(ab[0], ab[1])
+                return x, info
+
+            return lax.map(one, (a, b))
+
+        return posv
+    if op != "gesv":
+        raise ValueError(f"router has no batched driver for {op!r}")
+    if variant == "hostile":
+        # pp + GMRES-IR: the escalation class for operators past the
+        # Carson–Higham IR stall boundary
+        from ..linalg.refine import gesv_mixed_gmres_array
+
+        def hostile(a, b):
+            def one(ab):
+                x, _resid = gesv_mixed_gmres_array(ab[0], ab[1])
+                # GMRES-IR has no LAPACK info; a non-finite solution is
+                # the observable factor/convergence failure signal
+                ok = jnp.all(jnp.isfinite(x))
+                return x, jnp.where(ok, 0, 1).astype(jnp.int32)
+
+            return lax.map(one, (a, b))
+
+        return hostile
+    from ..linalg.lu import gesv_array, getrf_nopiv_array, getrs_array
+    from ..linalg.refine import _fallback, _refine_loop
+
+    def friendly(a, b):
+        # cheap class: f32 no-pivot factor + f64 IR, full-solve fallback
+        # (the pivot-free factor is the fast tier no-pivoting safety
+        # analysis forbids for hostile operators — which is exactly why
+        # the condest class gate sits in front of it)
+        def one(ab):
+            a1, b1 = ab
+            f32 = getrf_nopiv_array(a1.astype(jnp.float32))
+            solve = lambda r: getrs_array(f32, r.astype(jnp.float32))
+            x, iters, done = _refine_loop(a1, b1, solve, 30)
+            x, _iters, info = _fallback(
+                done, x, iters,
+                lambda: (lambda o: (o[0], o[1].info))(gesv_array(a1, b1)))
+            return x, info
+
+        return lax.map(one, (a, b))
+
+    return friendly

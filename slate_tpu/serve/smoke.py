@@ -1,0 +1,280 @@
+"""Serve smoke: the CI acceptance run for the serving runtime.
+
+Asserts, on the 8-device CPU mesh harness:
+
+(a) **Batched throughput**: the stacked batch driver solves a flood of
+    B same-shaped SPD problems >= 3x faster (solves/s) than the Python
+    loop of one-at-a-time solves through today's request path (the mesh
+    driver, warm executables — per-request dispatch of a 512-sized
+    problem is exactly what the serving layer exists to replace), and
+    every batched solution is BITWISE equal to the single-problem
+    kernel's.
+(b) **Zero steady-state retraces**: after warm-up, a stream of batches
+    through the executable cache performs no retraces (trace-counter
+    asserted, transfer-guard style).
+(c) **Ragged packing**: pack -> solve -> unpack returns exactly the
+    per-(padded-)problem solutions.
+(d) **Tuned table**: the committed artifact loads, validates, and the
+    request path resolves unset options through it (explicit still
+    wins).
+
+Emits ``serve.report.json`` (RunReport schema, ``serve`` counter
+section + headline values) for the CI regression gate — machine-
+dependent rates carry a ``_runtime_`` infix so the committed-artifact
+check can ``--ignore 'serve.*_runtime_*'`` while the deterministic
+cache-hygiene counts gate tight.
+
+Usage::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m slate_tpu.serve.smoke [--out artifacts/serve] [--n 512]
+        [--batch 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def measure_throughput(mesh, n: int = 512, batch: int = 8, nrhs: int = 1,
+                       reps: int = 3, loop_reps: int = 2) -> dict:
+    """Warm solves/s of the stacked batch driver vs the one-at-a-time
+    mesh-driver loop on B SPD problems — the serving headline.  Returns
+    rates + the bitwise-parity flag (also reused by bench.py's
+    ``serve_batched_solves_per_s`` / ``serve_vs_loop_speedup`` extras)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..linalg.chol import posv_array
+    from ..parallel.drivers import posv_mesh
+    from ..types import Option
+    from .batch import posv_batched
+    from .cache import executable_cache, make_key
+
+    rng = np.random.default_rng(0)
+    g = rng.standard_normal((batch, n, n))
+    spd = jnp.asarray(np.einsum("bij,bkj->bik", g, g) / n
+                      + 2 * np.eye(n)[None])
+    b = jnp.asarray(rng.standard_normal((batch, n, nrhs)))
+
+    # today's request path: one mesh dispatch per problem (direct f64
+    # driver — deterministic work per request, no refinement iteration
+    # count in the denominator)
+    opts = {Option.MixedPrecision: "off"}
+    loop_nb = 64
+    jax.block_until_ready(posv_mesh(spd[0], b[0], mesh, loop_nb, opts)[0])
+    t0 = time.perf_counter()
+    for _ in range(loop_reps):
+        outs = [posv_mesh(spd[i], b[i], mesh, loop_nb, opts)[0]
+                for i in range(batch)]
+        jax.block_until_ready(outs)
+    loop_s = (time.perf_counter() - t0) / loop_reps
+
+    # the serving path: ONE compiled program over the stack, through the
+    # executable cache (warmup compiles + pins it)
+    key = make_key("posv_batched", (spd, b), batch=batch, mesh=None)
+    executable_cache.warmup(key, lambda: posv_batched, (spd, b))
+    prog = executable_cache.get_or_build(key, lambda: posv_batched)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        xs, info = prog(spd, b)
+        jax.block_until_ready(xs)
+    bat_s = (time.perf_counter() - t0) / reps
+
+    # bitwise parity vs the single-problem kernel AS DISPATCHED (jitted
+    # — eager concrete calls can take form-dispatch branches a traced
+    # program cannot, so the jitted program is the per-problem identity)
+    single = jax.jit(lambda aa, bb: posv_array(aa, bb)[0])
+    bitwise = all(
+        np.array_equal(np.asarray(xs[i]), np.asarray(single(spd[i], b[i])))
+        for i in range(batch))
+    return {
+        "n": n, "batch": batch, "key": key,
+        "loop_solves_per_s": batch / loop_s,
+        "batched_solves_per_s": batch / bat_s,
+        "speedup": loop_s / bat_s,
+        "bitwise": bitwise,
+        "info_ok": bool(np.all(np.asarray(info) == 0)),
+    }
+
+
+def run_smoke(out_dir: str, n: int = 512, batch: int = 8) -> int:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)  # f64 serving classes
+    import jax.numpy as jnp
+    import numpy as np
+
+    devs = jax.devices("cpu")
+    if len(devs) < 8:
+        print(f"serve.smoke: need 8 CPU devices, have {len(devs)} — set "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+        return 2
+
+    from .. import obs
+    from ..linalg.chol import posv_array
+    from ..obs import report
+    from ..parallel import make_mesh
+    from ..types import Option
+    from . import metrics as serve_metrics
+    from .batch import pack_block_diag, unpack_block_diag
+    from .cache import executable_cache
+    from .table import load_tuned_table, resolve_request_options
+
+    obs.reset()
+    obs.enable()
+    serve_metrics.reset()
+    executable_cache.clear()
+    mesh = make_mesh(2, 4, devices=devs[:8])
+    failures = []
+
+    # (a) batched throughput + bitwise parity ------------------------------
+    thr = measure_throughput(mesh, n=n, batch=batch)
+    print(f"serve.smoke: loop {thr['loop_solves_per_s']:.2f} solves/s, "
+          f"batched {thr['batched_solves_per_s']:.2f} solves/s "
+          f"({thr['speedup']:.1f}x, B={batch}, n={n})")
+    if thr["speedup"] < 3.0:
+        failures.append(
+            f"batched speedup {thr['speedup']:.2f}x < 3x the one-at-a-time "
+            "loop — the serving headline regressed")
+    if not thr["bitwise"]:
+        failures.append("batched solutions are not bitwise-equal to the "
+                        "single-problem kernel")
+    if not thr["info_ok"]:
+        failures.append("batched factorization reported nonzero info")
+
+    # (b) steady state: more traffic, zero retraces ------------------------
+    before = executable_cache.snapshot_traces()
+    rng = np.random.default_rng(1)
+    prog = None
+    for _ in range(5):
+        g = rng.standard_normal((batch, n, n))
+        spd = jnp.asarray(np.einsum("bij,bkj->bik", g, g) / n
+                          + 2 * np.eye(n)[None])
+        bb = jnp.asarray(rng.standard_normal((batch, n, 1)))
+        from .batch import posv_batched
+        from .cache import make_key
+
+        key = make_key("posv_batched", (spd, bb), batch=batch, mesh=None)
+        prog = executable_cache.get_or_build(key, lambda: posv_batched)
+        jax.block_until_ready(prog(spd, bb)[0])
+    try:
+        executable_cache.assert_steady(before)
+    except AssertionError as e:
+        failures.append(str(e))
+
+    # (c) ragged packing round trip: pack -> solve -> unpack is EXACT in
+    # the non-interaction sense — each problem's unpacked solution is
+    # bitwise what it would be packed ALONE (co-packed operands only
+    # ever contribute structural zeros), and matches the per-problem
+    # unpadded solve to factorization accuracy
+    sizes = [48, 33, 64]
+    m = 64
+    k = len(sizes)
+    ops_, rhs_ = [], []
+    for sz in sizes:
+        g = rng.standard_normal((sz, sz))
+        ops_.append(jnp.asarray(g @ g.T / sz + 2 * np.eye(sz)))
+        rhs_.append(jnp.asarray(rng.standard_normal((sz, 2))))
+    a_pack, b_pack = pack_block_diag(ops_, m, rhs_)
+    x_pack, _f, info = posv_array(a_pack, b_pack)
+    got = unpack_block_diag(x_pack, sizes, m, [2] * k)
+    pack_ok = int(info) == 0
+    for i, sz in enumerate(sizes):
+        solo_a, solo_b = pack_block_diag(
+            [ops_[j] if j == i else jnp.eye(m, dtype=a_pack.dtype)
+             for j in range(k)],
+            m,
+            [rhs_[j] if j == i else jnp.zeros((m, 2), a_pack.dtype)
+             for j in range(k)])
+        ref = unpack_block_diag(posv_array(solo_a, solo_b)[0], sizes, m,
+                                [2] * k)[i]
+        if not np.array_equal(np.asarray(got[i]), np.asarray(ref)):
+            pack_ok = False
+        lone = posv_array(ops_[i], rhs_[i])[0]
+        if not np.allclose(np.asarray(got[i]), np.asarray(lone),
+                           rtol=1e-10, atol=1e-10):
+            pack_ok = False
+    if not pack_ok:
+        failures.append("block-diagonal pack -> solve -> unpack lost "
+                        "per-problem exactness (blocks interacted)")
+
+    # (d) tuned table: committed artifact + resolution ---------------------
+    table = load_tuned_table()
+    tuned_entries = len(table["entries"]) if table else 0
+    if table is None:
+        failures.append("committed tuned table missing or invalid "
+                        "(artifacts/serve/tuned.json)")
+    else:
+        merged = resolve_request_options(None, "potrf", 96, "float64", (2, 4))
+        env_pin = os.environ.get("SLATE_TPU_BCAST_IMPL")
+        if Option.Lookahead not in merged:
+            failures.append("tuned table did not resolve an unset Lookahead")
+        if env_pin and merged.get(Option.BcastImpl) is not None:
+            failures.append("tuned tier overrode the environment BcastImpl "
+                            "pin — precedence chain broken")
+        explicit = resolve_request_options(
+            {Option.Lookahead: 0}, "potrf", 96, "float64", (2, 4))
+        if explicit.get(Option.Lookahead) != 0:
+            failures.append("explicit option lost to the tuned table")
+
+    # report ----------------------------------------------------------------
+    os.makedirs(out_dir, exist_ok=True)
+    rep_path = os.path.join(out_dir, "serve.report.json")
+    values = {
+        # machine-dependent rates: _runtime_ infix => CI gate --ignore's
+        "serve.posv_runtime_loop_solves_per_s": thr["loop_solves_per_s"],
+        "serve.posv_runtime_batched_solves_per_s": thr["batched_solves_per_s"],
+        "serve.posv_runtime_speedup": thr["speedup"],
+        # deterministic at fixed workload: gate tight
+        "serve.cache_programs": float(len(executable_cache)),
+        "serve.batched_bitwise_ok": float(thr["bitwise"]),
+        "serve.pack_roundtrip_ok": float(pack_ok),
+        "serve.tuned_entries": float(tuned_entries),
+    }
+    report.write_report(
+        rep_path, name="serve_smoke",
+        config={"n": n, "batch": batch, "grid": "2x4",
+                "driver": "posv_batched"},
+        values=values)
+    with open(rep_path) as f:
+        rep = json.load(f)
+    errs = report.validate_report(rep)
+    if errs:
+        failures.append(f"RunReport schema: {errs}")
+    serve_sec = rep.get("serve") or {}
+    if serve_sec.get("traces", 0) <= 0:
+        failures.append("serve counter section missing trace counts — "
+                        "obs.report is not folding serve.* in")
+    if serve_sec.get("cache_misses", 0) > serve_sec.get("traces", 0):
+        failures.append("cache misses exceed traces — a built program "
+                        "never traced?")
+
+    if failures:
+        print(f"serve.smoke: FAILED with {len(failures)} problem(s):")
+        for msg in failures:
+            print(f"  FAIL {msg}")
+        return 1
+    print(f"serve.smoke: OK — {thr['speedup']:.1f}x batched speedup, "
+          f"{int(serve_sec['traces'])} trace(s) over "
+          f"{len(executable_cache)} program(s), 0 retraces, report "
+          f"{rep_path}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m slate_tpu.serve.smoke")
+    ap.add_argument("--out", default=os.path.join("artifacts", "serve"))
+    ap.add_argument("--n", type=int, default=512)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args(argv)
+    return run_smoke(args.out, args.n, args.batch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,182 @@
+"""Autotuner: sweep schedule knobs per cache key, persist the winners.
+
+``python -m slate_tpu.serve.tune`` runs the flight recorder (obs/flight)
+over every (BcastImpl, Lookahead depth, nb) combination for the swept
+ops and picks each key's winner by MEASURED step-level schedule metrics
+— ``sched.critical_path_s`` as the primary objective (the quantity a
+request's latency is made of), ``sched.exposed_comm_s`` as the
+tie-break (less exposed communication generalizes better to real ICI
+than a CPU-harness wall-clock tie).  For gemm the stationary variant
+(GemmA vs GemmC) is additionally timed at a thin-output serving shape,
+where the |B|-replication schedule can undercut the k-loop.
+
+The winning table is written as the versioned committed artifact
+``artifacts/serve/tuned.json`` (serve/table.py schema); the request
+path resolves unset Options through it (explicit > context > env >
+tuned > auto).
+
+Usage::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m slate_tpu.serve.tune [--out artifacts/serve/tuned.json]
+        [--ops summa,potrf,getrf_nopiv] [--n 96] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .table import DEFAULT_TABLE_PATH, entry_key, write_table
+
+SWEEP_IMPLS = ("doubling", "ring", "psum")
+SWEEP_DEPTHS = {"summa": (0, 1, 2), "potrf": (0, 1), "getrf_nopiv": (0, 1)}
+SWEEP_NB = (8, 16)
+
+
+def _objective(values: Dict[str, float]) -> Tuple[float, float]:
+    return (values["sched.critical_path_s"], values["sched.exposed_comm_s"])
+
+
+def sweep_op(op: str, n: int, mesh, nbs=SWEEP_NB, impls=SWEEP_IMPLS,
+             depths: Optional[Tuple[int, ...]] = None,
+             log=print) -> Tuple[Dict, List[Dict]]:
+    """All (nb, impl, depth) flights for one op; returns (winner entry,
+    full sweep log).  Each flight is a complete step-dispatch run: the
+    measured per-(impl, depth) overlap/critical-path numbers PRs 7's
+    recorder gates are exactly the tuner's objective."""
+    from ..obs.flight import run_flight
+
+    depths = depths if depths is not None else SWEEP_DEPTHS[op]
+    swept: List[Dict] = []
+    best = None
+    for nb in nbs:
+        for impl in impls:
+            for depth in depths:
+                t0 = time.time()
+                rep = run_flight(op, n=n, nb=nb, depth=depth,
+                                 bcast_impl=impl, mesh=mesh)
+                row = {
+                    "nb": nb, "bcast_impl": impl, "lookahead": depth,
+                    "critical_path_s": rep["values"]["sched.critical_path_s"],
+                    "overlap_eff": rep["values"]["sched.overlap_eff"],
+                    "exposed_comm_s": rep["values"]["sched.exposed_comm_s"],
+                    "resid": rep["values"]["resid"],
+                    "sweep_s": round(time.time() - t0, 2),
+                }
+                swept.append(row)
+                log(f"  {op} nb={nb} impl={impl:>8} depth={depth}: "
+                    f"crit={row['critical_path_s'] * 1e3:8.2f} ms "
+                    f"overlap={row['overlap_eff']:.3f} "
+                    f"exposed={row['exposed_comm_s'] * 1e3:8.2f} ms")
+                if best is None or _objective(rep["values"]) < _objective(
+                        {"sched.critical_path_s": best["critical_path_s"],
+                         "sched.exposed_comm_s": best["exposed_comm_s"]}):
+                    best = row
+    entry = {
+        "bcast_impl": best["bcast_impl"],
+        "lookahead": int(best["lookahead"]),
+        "nb": int(best["nb"]),
+        "objective": {
+            "critical_path_s": best["critical_path_s"],
+            "overlap_eff": best["overlap_eff"],
+            "exposed_comm_s": best["exposed_comm_s"],
+        },
+    }
+    return entry, swept
+
+
+def time_gemm_method(n: int, nb: int, mesh, reps: int = 3) -> Dict[str, float]:
+    """Stationary-variant timing at the thin-output serving shape
+    (n x n times n x 2nb): GemmA replicates the thin B and reduces C;
+    GemmC loops broadcasts of A panels.  The flight recorder cannot
+    arbitrate this (GemmA has no k-loop to record), so the variant
+    tunable is decided by warm wall-clock on the serving mesh."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..parallel.dist import from_dense
+    from ..parallel.summa import gemm_summa
+    from ..types import MethodGemm
+
+    rng = np.random.default_rng(0)
+    ad = from_dense(jnp.asarray(rng.standard_normal((n, n))), mesh, nb)
+    bd = from_dense(jnp.asarray(rng.standard_normal((n, 2 * nb))), mesh, nb)
+    out = {}
+    for method in (MethodGemm.GemmA, MethodGemm.GemmC):
+        run = lambda: gemm_summa(1.0, ad, bd, method=method)
+        jax.block_until_ready(run().tiles)  # warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            jax.block_until_ready(run().tiles)
+        out[method.value] = (time.perf_counter() - t0) / reps
+    return out
+
+
+def run_tune(out: str, ops: List[str], n: int, quick: bool = False,
+             log=print) -> int:
+    import jax
+
+    devs = jax.devices("cpu")
+    if len(devs) < 8:
+        log("serve.tune: need 8 CPU devices — set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+        return 2
+    from ..parallel import make_mesh
+    from ..parallel.mesh import mesh_shape
+
+    mesh = make_mesh(2, 4, devices=devs[:8])
+    grid = mesh_shape(mesh)
+    nbs = (SWEEP_NB[0],) if quick else SWEEP_NB
+    entries: Dict[str, Dict] = {}
+    sweeps: Dict[str, List[Dict]] = {}
+    for op in ops:
+        log(f"serve.tune: sweeping {op} (n={n}, grid={grid[0]}x{grid[1]})")
+        entry, swept = sweep_op(op, n, mesh, nbs=nbs, log=log)
+        if op == "summa":
+            times = time_gemm_method(n, entry["nb"], mesh)
+            entry["method"] = min(times, key=times.get)
+            entry["method_runtime_s"] = {k: round(v, 6)
+                                         for k, v in times.items()}
+            key_op = "gemm"
+        else:
+            key_op = {"potrf": "potrf", "getrf_nopiv": "gesv"}.get(op, op)
+        key = entry_key(key_op, n, "float64", grid)
+        entries[key] = entry
+        sweeps[key] = swept
+        # factor winners serve the solve verbs built on them too
+        if op == "potrf":
+            entries[entry_key("posv", n, "float64", grid)] = dict(entry)
+    path = write_table(out, entries, config={
+        "n": n, "grid": f"{grid[0]}x{grid[1]}", "ops": ops,
+        "impls": list(SWEEP_IMPLS), "nbs": list(nbs), "quick": quick,
+        "objective": "min sched.critical_path_s, tie-break "
+                     "sched.exposed_comm_s (obs.flight measured)",
+    })
+    log(f"serve.tune: wrote {len(entries)} entries to {path}")
+    for key, entry in sorted(entries.items()):
+        log(f"  {key}: impl={entry['bcast_impl']} depth={entry['lookahead']} "
+            f"nb={entry['nb']}" + (f" method={entry['method']}"
+                                   if "method" in entry else ""))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m slate_tpu.serve.tune",
+                                 description=__doc__)
+    ap.add_argument("--out", default=DEFAULT_TABLE_PATH)
+    ap.add_argument("--ops", default="summa,potrf,getrf_nopiv",
+                    help="comma-separated flight ops to sweep")
+    ap.add_argument("--n", type=int, default=96)
+    ap.add_argument("--quick", action="store_true",
+                    help="single nb, for fast re-tunes")
+    args = ap.parse_args(argv)
+    return run_tune(args.out, [o for o in args.ops.split(",") if o],
+                    args.n, args.quick)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -283,6 +283,17 @@ class Option(enum.Enum):
     # f32-factor growth and a Hager-Higham condition estimate to pick its
     # ladder entry tier (pathological inputs skip straight to GMRES-IR).
     NumMonitor = "num_monitor"
+    # Tuned-schedule-table consultation for the serving request path
+    # (serve/table.py): "on" (unset schedule options — BcastImpl,
+    # Lookahead, BlockSize, MethodGemm — resolve through the committed
+    # autotuned table, artifacts/serve/tuned.json, BEFORE falling back
+    # to auto; the resolution chain becomes explicit > context > env >
+    # tuned > auto) or "off" (the pre-serve chain, tuned tier skipped).
+    # Resolution order for the switch itself: explicit option >
+    # SLATE_TPU_AUTOTUNE environment > on (serving exists to consume its
+    # own measurements).  Only the serve dispatch path consults this —
+    # direct driver calls never read the table.
+    AutoTune = "auto_tune"
     # Residual lowering for the mixed-precision refinement loop: "f64"
     # (plain SUMMA at the data dtype — XLA's emulated-f64 pairs on TPU),
     # "ozaki" (the int8 split-integer SUMMA: digit planes of A and X ride

@@ -15,6 +15,9 @@ def rng():
     return np.random.default_rng(11)
 
 
+@pytest.mark.slow  # tier-1 budget relief (ISSUE 11): consistency
+# check, not a per-kernel identity gate; ci/run_ci.sh's full pytest
+# pass still runs it
 def test_heev_staged_matches_fused(rng):
     # staged drivers (one XLA program per phase) must agree with the fused
     # heev_array bit-for-bit in structure (same kernels, same order)
@@ -31,6 +34,9 @@ def test_heev_staged_matches_fused(rng):
     assert resid < 1e-11 * max(1, np.abs(np.asarray(w2)).max())
 
 
+@pytest.mark.slow  # tier-1 budget relief (ISSUE 11): consistency
+# check, not a per-kernel identity gate; ci/run_ci.sh's full pytest
+# pass still runs it
 def test_svd_staged_matches_fused(rng):
     from slate_tpu.linalg.svd import svd_array, svd_staged
 
@@ -146,6 +152,9 @@ def test_segmented_chase_matches_fused(rng):
     np.testing.assert_array_equal(np.asarray(o1[2].rvs), np.asarray(o2[2].rvs))
 
 
+@pytest.mark.slow  # tier-1 budget relief (ISSUE 11): consistency
+# check, not a per-kernel identity gate; ci/run_ci.sh's full pytest
+# pass still runs it
 def test_chunked_values_merge_matches_monolithic(rng, monkeypatch):
     # the wide-merge values branch (2s >= _CHUNK_AT) must agree with the
     # monolithic path it replaces — forced down to test scale

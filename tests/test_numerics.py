@@ -297,6 +297,9 @@ def test_ir_history_exported_for_monitored_solve(rng):
     assert len(series) >= len(hist)
 
 
+@pytest.mark.slow  # tier-1 budget relief (ISSUE 11): consistency
+# check, not a per-kernel identity gate; ci/run_ci.sh's full pytest
+# pass still runs it
 def test_health_routing_skips_ir_to_gmres(rng):
     """cond 1e8 >> CONDEST_THRESHOLD: the monitored auto ladder must
     measure it on the f32 factor, skip the IR tier entirely, and still

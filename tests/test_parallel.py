@@ -472,6 +472,9 @@ def test_heev_mesh_complex(rng):
     assert np.abs(zn.conj().T @ zn - np.eye(n)).max() < 50 * n * eps
 
 
+@pytest.mark.slow  # tier-1 budget relief (ISSUE 11): 44 s of accuracy
+# sweeps; distributed SVD stays tier-1-covered by test_svd_mesh_complex,
+# and the full CI pytest pass still runs these
 @pytest.mark.parametrize("shape", [(80, 64), (64, 96), (100, 100)])
 def test_svd_mesh(rng, shape):
     from slate_tpu.parallel import svd_mesh

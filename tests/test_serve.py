@@ -1,0 +1,383 @@
+"""Serving-runtime tests (ISSUE 11): batched-vs-single bitwise parity,
+ragged packing exactness, executable-cache zero-retrace steady state,
+tuned-table resolution precedence, router accuracy-class dispatch, and
+the stationary-operator caches (condest memo, Ozaki presplit).
+
+Budget notes: single-chip parts use n <= 64; the mesh parts reuse the
+8-device mesh at n = 64..96, nb = 8 (shapes other suites already
+compile), and nothing calls jax.clear_caches.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from slate_tpu.parallel.mesh import make_mesh
+from slate_tpu.serve import metrics as serve_metrics
+from slate_tpu.serve.batch import (
+    gesv_batched,
+    pack_block_diag,
+    posv_batched,
+    unpack_block_diag,
+)
+from slate_tpu.serve.cache import ExecutableCache, make_key
+from slate_tpu.serve.table import (
+    TUNED_SCHEMA,
+    TUNED_VERSION,
+    resolve_request_options,
+    use_tuned_table,
+)
+from slate_tpu.types import Option, SlateError
+
+from conftest import cpu_devices
+
+
+def mesh24():
+    return make_mesh(2, 4, devices=cpu_devices(8))
+
+
+def _spd_stack(rng, B, n):
+    g = rng.standard_normal((B, n, n))
+    return jnp.asarray(np.einsum("bij,bkj->bik", g, g) / n
+                       + 2 * np.eye(n)[None])
+
+
+# ---------------------------------------------------------------------------
+# batched drivers: bitwise per problem
+# ---------------------------------------------------------------------------
+
+
+def test_batched_bitwise_vs_single(rng):
+    from slate_tpu.linalg.chol import posv_array
+    from slate_tpu.linalg.lu import gesv_array
+
+    B, n, nrhs = 3, 48, 2
+    spd = _spd_stack(rng, B, n)
+    b = jnp.asarray(rng.standard_normal((B, n, nrhs)))
+    xs, info = posv_batched(spd, b)
+    assert np.all(np.asarray(info) == 0)
+    for i in range(B):
+        ref = posv_array(spd[i], b[i])[0]
+        np.testing.assert_array_equal(np.asarray(xs[i]), np.asarray(ref))
+
+    ga = jnp.asarray(rng.standard_normal((B, n, n)) + n * np.eye(n)[None])
+    xg, infog = gesv_batched(ga, b)
+    assert np.all(np.asarray(infog) == 0)
+    for i in range(B):
+        ref = gesv_array(ga[i], b[i])[0]
+        np.testing.assert_array_equal(np.asarray(xg[i]), np.asarray(ref))
+
+
+# ---------------------------------------------------------------------------
+# ragged block-diagonal packing: pack -> solve -> unpack exact
+# ---------------------------------------------------------------------------
+
+
+def test_pack_roundtrip_exact(rng):
+    """Each unpacked solution is BITWISE the solution of the same
+    problem packed alone (co-packed operands contribute only structural
+    zeros), and matches the unpadded per-problem solve to accuracy."""
+    from slate_tpu.linalg.chol import posv_array
+
+    m, sizes, nrhs = 64, [20, 33, 64], 2
+    k = len(sizes)
+    ops_ = [np.asarray(_spd_stack(rng, 1, s)[0]) for s in sizes]
+    rhs_ = [rng.standard_normal((s, nrhs)) for s in sizes]
+    a_pack, b_pack = pack_block_diag([jnp.asarray(o) for o in ops_], m,
+                                     [jnp.asarray(r) for r in rhs_])
+    x_pack, _f, info = posv_array(a_pack, b_pack)
+    assert int(info) == 0
+    got = unpack_block_diag(x_pack, sizes, m, [nrhs] * k)
+    for i, s in enumerate(sizes):
+        solo_a, solo_b = pack_block_diag(
+            [jnp.asarray(ops_[j]) if j == i else jnp.eye(m, dtype=jnp.float64)
+             for j in range(k)], m,
+            [jnp.asarray(rhs_[j]) if j == i
+             else jnp.zeros((m, nrhs), jnp.float64) for j in range(k)])
+        ref = unpack_block_diag(posv_array(solo_a, solo_b)[0], sizes, m,
+                                [nrhs] * k)[i]
+        np.testing.assert_array_equal(np.asarray(got[i]), np.asarray(ref))
+        lone = np.linalg.solve(ops_[i], rhs_[i])
+        assert np.abs(np.asarray(got[i]) - lone).max() < 1e-10
+
+
+def test_posv_packed_mesh_consumes_tuned_table(rng):
+    """The packed mesh solve IS a serving request path: unset schedule
+    options resolve through the tuned table (nb becomes the mesh tile
+    size), and per-problem solutions come back accurate with info."""
+    from slate_tpu.serve.batch import posv_packed_mesh
+
+    mesh = mesh24()
+    sizes = [48, 64]
+    ops_ = [_spd_stack(rng, 1, s)[0] for s in sizes]
+    rhs_ = [jnp.asarray(rng.standard_normal((s, 2))) for s in sizes]
+    tbl = _table({"posv|n=128|dtype=float64|grid=2x4":
+                  {"bcast_impl": "ring", "lookahead": 0, "nb": 8}})
+    with use_tuned_table(tbl):
+        xs, info = posv_packed_mesh(ops_, rhs_, mesh, bins=(64,))
+    assert int(info) == 0
+    for i, s in enumerate(sizes):
+        ref = np.linalg.solve(np.asarray(ops_[i]), np.asarray(rhs_[i]))
+        assert np.abs(np.asarray(xs[i]) - ref).max() < 1e-8
+
+
+# ---------------------------------------------------------------------------
+# executable cache: steady-state zero retraces (trace-counter asserted)
+# ---------------------------------------------------------------------------
+
+
+def test_cache_steady_state_zero_retrace(rng):
+    cache = ExecutableCache()
+    before_counts = dict(serve_metrics.serve_counter_values())
+    B, n = 2, 16
+    spd = _spd_stack(rng, B, n)
+    b = jnp.asarray(rng.standard_normal((B, n, 1)))
+    key = make_key("posv_batched", (spd, b), batch=B)
+    cache.warmup(key, lambda: posv_batched, (spd, b))
+    assert cache.trace_count(key) == 1
+    snap = cache.snapshot_traces()
+    # steady state: fresh data, same shapes -> same key, zero retraces
+    for _ in range(4):
+        spd2 = _spd_stack(rng, B, n)
+        b2 = jnp.asarray(rng.standard_normal((B, n, 1)))
+        key2 = make_key("posv_batched", (spd2, b2), batch=B)
+        assert key2 == key
+        prog = cache.get_or_build(key2, lambda: posv_batched)
+        jax.block_until_ready(prog(spd2, b2)[0])
+    assert cache.trace_count(key) == 1
+    cache.assert_steady(snap)  # must not raise
+    # a NEW shape is a new key and exactly one new trace
+    b3 = jnp.asarray(rng.standard_normal((B, n, 3)))
+    key3 = make_key("posv_batched", (spd, b3), batch=B)
+    assert key3 != key
+    prog3 = cache.get_or_build(key3, lambda: posv_batched)
+    jax.block_until_ready(prog3(spd, b3)[0])
+    assert cache.trace_count(key3) == 1
+    counts = serve_metrics.serve_counter_values()
+    assert counts["cache_hits"] - before_counts["cache_hits"] == 4
+    assert counts["cache_misses"] - before_counts["cache_misses"] == 2
+    assert counts["traces"] - before_counts["traces"] == 2
+    # a retrace past steady state must trip the assertion
+    cache._trace_counts[key] += 1
+    with pytest.raises(AssertionError, match="retraced"):
+        cache.assert_steady(snap)
+
+
+# ---------------------------------------------------------------------------
+# tuned-table resolution: explicit > context > env > tuned > auto
+# ---------------------------------------------------------------------------
+
+
+def _table(entries):
+    return {"schema": TUNED_SCHEMA, "version": TUNED_VERSION,
+            "entries": entries}
+
+
+def test_tuned_table_resolution_precedence(monkeypatch):
+    from slate_tpu.parallel.comm import BCAST_IMPL_ENV, use_bcast_impl
+    from slate_tpu.serve.table import AUTOTUNE_ENV
+
+    monkeypatch.delenv(BCAST_IMPL_ENV, raising=False)
+    monkeypatch.delenv(AUTOTUNE_ENV, raising=False)
+    tbl = _table({"potrf|n=96|dtype=float64|grid=2x4":
+                  {"bcast_impl": "ring", "lookahead": 2, "nb": 16}})
+    with use_tuned_table(tbl):
+        # tuned beats auto: every unset knob fills from the table
+        got = resolve_request_options(None, "potrf", 96, "float64", (2, 4))
+        assert got[Option.BcastImpl] == "ring"
+        assert got[Option.Lookahead] == 2
+        assert got[Option.BlockSize] == 16
+        # nearest-n fallback inside the same (op, dtype, grid) family
+        near = resolve_request_options(None, "potrf", 128, "float64", (2, 4))
+        assert near[Option.BcastImpl] == "ring"
+        # explicit beats tuned
+        got = resolve_request_options({Option.BcastImpl: "psum",
+                                       Option.Lookahead: 0},
+                                      "potrf", 96, "float64", (2, 4))
+        assert got[Option.BcastImpl] == "psum"
+        assert got[Option.Lookahead] == 0
+        # context beats tuned: the tuned tier must stay silent so
+        # comm.resolve_bcast_impl later picks the context value
+        with use_bcast_impl("doubling"):
+            got = resolve_request_options(None, "potrf", 96, "float64",
+                                          (2, 4))
+            assert Option.BcastImpl not in got
+        # env beats tuned, same mechanism
+        monkeypatch.setenv(BCAST_IMPL_ENV, "psum")
+        got = resolve_request_options(None, "potrf", 96, "float64", (2, 4))
+        assert Option.BcastImpl not in got
+        monkeypatch.delenv(BCAST_IMPL_ENV)
+        # Option.AutoTune=off (and the env switch) silence the tier
+        got = resolve_request_options({Option.AutoTune: "off"}, "potrf",
+                                      96, "float64", (2, 4))
+        assert Option.BcastImpl not in got and Option.Lookahead not in got
+        monkeypatch.setenv(AUTOTUNE_ENV, "0")
+        got = resolve_request_options(None, "potrf", 96, "float64", (2, 4))
+        assert Option.BcastImpl not in got
+    # no table at all: pass-through
+    with use_tuned_table(None):
+        monkeypatch.delenv(AUTOTUNE_ENV, raising=False)
+        got = resolve_request_options({"lookahead": 3}, "potrf", 96,
+                                      "float64", (2, 4))
+        assert got == {"lookahead": 3}
+
+
+def test_committed_tuned_table_valid():
+    """The committed artifact must load, validate, and resolve."""
+    from slate_tpu.serve.table import load_tuned_table, validate_table
+
+    doc = load_tuned_table()
+    assert doc is not None, "artifacts/serve/tuned.json missing or invalid"
+    assert validate_table(doc) == []
+    assert doc["entries"], "tuned table has no entries"
+
+
+# ---------------------------------------------------------------------------
+# router: admission + accuracy-class dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_router_accuracy_class_dispatch(rng):
+    from slate_tpu.serve.router import Router
+
+    before = dict(serve_metrics.serve_counter_values())
+    router = Router(bins=(32,), hbm_budget=1 << 30)
+    n = 32
+    # friendly: well-conditioned operator -> cheap nopiv+IR class
+    good = jnp.asarray(rng.standard_normal((n, n)) + n * np.eye(n))
+    b = jnp.asarray(rng.standard_normal((n, 2)))
+    x = router.solve("gesv", good, b)
+    assert np.abs(np.asarray(good @ x - b)).max() < 1e-8
+    # hostile: planted ill-conditioned operator (prescribed spectrum,
+    # cond 1e9 >> CONDEST_THRESHOLD 1e7) -> pp + GMRES-IR class
+    q1, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    q2, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    sing = np.logspace(0, -9, n)
+    bad = jnp.asarray(q1 @ np.diag(sing) @ q2)
+    xb = router.solve("gesv", bad, b)
+    resid = np.abs(np.asarray(bad @ xb - b)).max()
+    assert resid < 1e-4  # cond 1e9: GMRES-IR still lands a usable answer
+    counts = serve_metrics.serve_counter_values()
+    assert counts["class_friendly"] - before["class_friendly"] >= 1
+    assert counts["class_hostile"] - before["class_hostile"] >= 1
+    # stationary operator: second solve hits the condest memo
+    ch0 = counts["condest_cache_hits"]
+    router.solve("gesv", good, jnp.asarray(rng.standard_normal((n, 2))))
+    counts = serve_metrics.serve_counter_values()
+    assert counts["condest_cache_hits"] - ch0 >= 1
+    # admission: a request over the modeled HBM bound is rejected
+    tiny = Router(bins=(32,), hbm_budget=10_000)
+    with pytest.raises(SlateError, match="admission"):
+        tiny.solve("posv", _spd_stack(rng, 1, n)[0], b)
+    # a failed factorization is surfaced, never silently served: a
+    # non-SPD operand through the posv class reports its info
+    with pytest.raises(SlateError, match="nonzero info"):
+        router.solve("posv", jnp.asarray(-np.eye(n)), b)
+
+
+# ---------------------------------------------------------------------------
+# stationary-operator caches on the mesh: condest memo, ozaki presplit
+# ---------------------------------------------------------------------------
+
+
+def test_condest_memo_on_factor(rng):
+    from slate_tpu.parallel.dist import from_dense
+    from slate_tpu.parallel.dist_aux import norm_dist, pocondest_dist
+    from slate_tpu.parallel.dist_chol import potrf_dist
+    from slate_tpu.types import Norm
+
+    mesh = mesh24()
+    n, nb = 64, 8
+    a = np.asarray(_spd_stack(rng, 1, n)[0])
+    ad = from_dense(jnp.asarray(a), mesh, nb, diag_pad_one=True)
+    l, info = potrf_dist(ad)
+    assert int(info) == 0
+    anorm = norm_dist(Norm.One, from_dense(jnp.asarray(a), mesh, nb))
+    before = serve_metrics.serve_counter_values()["condest_cache_hits"]
+    r1 = pocondest_dist(l, anorm)
+    r2 = pocondest_dist(l, anorm)  # memoized on the factor object
+    assert float(r1) == float(r2)
+    hits = serve_metrics.serve_counter_values()["condest_cache_hits"]
+    assert hits - before == 1
+    # a different probe config is a different memo row, not a stale hit
+    r3 = pocondest_dist(l, anorm, iters=3)
+    assert serve_metrics.serve_counter_values()["condest_cache_hits"] \
+        - before == 1
+    assert float(r3) > 0
+
+
+def test_ozaki_presplit_bitwise_and_cached(rng):
+    from slate_tpu.parallel.dist import from_dense, to_dense
+    from slate_tpu.parallel.summa import (
+        clear_ozaki_split_cache,
+        gemm_summa_ozaki,
+        ozaki_presplit_cached,
+    )
+
+    mesh = mesh24()
+    n, nb = 96, 8
+    a = jnp.asarray(rng.standard_normal((n, n)))
+    b = jnp.asarray(rng.standard_normal((n, n)))
+    ad = from_dense(a, mesh, nb)
+    bd = from_dense(b, mesh, nb)
+    clear_ozaki_split_cache()
+    before = dict(serve_metrics.serve_counter_values())
+    split = ozaki_presplit_cached(ad)
+    inline = to_dense(gemm_summa_ozaki(1.0, ad, bd))
+    pre = to_dense(gemm_summa_ozaki(1.0, ad, bd, a_split=split))
+    np.testing.assert_array_equal(np.asarray(inline), np.asarray(pre))
+    # second lookup on the same tile buffer is a hit
+    split2 = ozaki_presplit_cached(ad)
+    assert split2.qa is split.qa
+    counts = serve_metrics.serve_counter_values()
+    assert counts["ozaki_presplits"] - before["ozaki_presplits"] == 1
+    assert counts["ozaki_presplit_hits"] - before["ozaki_presplit_hits"] == 1
+
+
+def test_prefactor_memo_stationary_operator(rng):
+    """The mixed ladder's f32 factor + distributed A are reused across
+    requests against the same dense operand object (and through them,
+    the Ozaki planes) — the stationary-A serving stream."""
+    from slate_tpu.parallel.dist_refine import (
+        _prefactor_cached,
+        clear_prefactor_cache,
+    )
+
+    mesh = mesh24()
+    n = 64
+    a = _spd_stack(rng, 1, n)[0]
+    clear_prefactor_cache()
+    pre1 = _prefactor_cached("posv", a, mesh, 8, None)
+    pre2 = _prefactor_cached("posv", a, mesh, 8, None)
+    assert pre1[0].tiles is pre2[0].tiles  # factor reused, not recomputed
+    assert pre1[3].tiles is pre2[3].tiles  # distributed A reused
+    # a different operand object misses
+    a2 = _spd_stack(rng, 1, n)[0]
+    pre3 = _prefactor_cached("posv", a2, mesh, 8, None)
+    assert pre3[0].tiles is not pre1[0].tiles
+    clear_prefactor_cache()
+
+
+# ---------------------------------------------------------------------------
+# serve.* counters land in RunReports and gate
+# ---------------------------------------------------------------------------
+
+
+def test_serve_report_section():
+    from slate_tpu.obs import report
+    from slate_tpu.serve.metrics import serve_count
+
+    serve_count("requests")
+    rep = report.make_report("serve_section_test")
+    assert report.validate_report(rep) == []
+    assert rep["serve"]["requests"] >= 1
+    vals = report.load_values(rep)
+    assert vals.get("serve_requests", 0) >= 1
+    # regression direction: cache misses rising is a failure
+    old = dict(vals)
+    new = dict(vals)
+    new["serve_cache_misses"] = old.get("serve_cache_misses", 0) * 4 + 8
+    old["serve_cache_misses"] = old.get("serve_cache_misses", 0) + 1
+    failures, _ = report.check_regression(new, old, threshold=1.5)
+    assert any("serve_cache_misses" in f for f in failures)
