@@ -1058,6 +1058,110 @@ def _gemm_ozaki_presplit(ctx):
     return fn, (a, b)
 
 
+# ---------------------------------------------------------------------------
+# Elastic-reliability variants (ISSUE 12): the checkpointed segment
+# kernels (the chain-of-dispatches form of the factor k-loops), the
+# shard_map block-cyclic redistribution (ppermute ring all-to-all), and
+# the checksum-carrying trsm — all under the gate: declared collective
+# axis names, audit_scope loop coverage, HIGHEST dots on the update
+# einsums, no masked-psum idiom outside comm.py.
+# ---------------------------------------------------------------------------
+
+
+@register("redistribute_dist", tags=("bcast",))
+def _redistribute(ctx):
+    """The shardmap redistribution program (2x4 -> 4x2 over the same
+    devices): every hop an audited ppermute with declared axis names."""
+    from ..parallel import dist
+    from ..parallel.mesh import make_mesh
+
+    a = ctx.dist()
+    mesh2 = make_mesh(4, 2, devices=list(ctx.mesh.devices.flatten()))
+    cmap = dist._shardmap_coord_map(ctx.mesh, mesh2)
+    mt2 = dist.padded_tiles(a.m, a.nb, mesh2)
+    nt2 = dist.padded_tiles(a.n, a.nb, mesh2)
+    dims = (4, 2, a.tiles.shape[0], a.tiles.shape[1], mt2, nt2, a.nb)
+    return (lambda t: dist._redist_shardmap_jit(
+        t, ctx.mesh, ctx.p, ctx.q, dims, cmap, False)), (a.tiles,)
+
+
+@register("potrf_ckpt_seg", tags=("ckpt",))
+def _potrf_ckpt_seg(ctx):
+    """One interior checkpoint segment of the mesh Cholesky (steps
+    [1, nt) of the strict schedule on the full view)."""
+    from ..ft import ckpt
+
+    a = ctx.dist(kind="spd", diag_pad=True)
+    return (lambda t: ckpt._potrf_seg_jit(
+        t, 0.0, ctx.mesh, ctx.p, ctx.q, a.nt, N, 1, a.nt, "auto", "xla",
+        False)), (a.tiles,)
+
+
+@register("getrf_nopiv_ckpt_seg", tags=("ckpt",))
+def _getrf_nopiv_ckpt_seg(ctx):
+    from ..ft import ckpt
+
+    a = ctx.dist(kind="tril", diag_pad=True)
+    return (lambda t: ckpt._lu_seg_jit(
+        t, 0.0, ctx.mesh, ctx.p, ctx.q, a.nt, N, 1, a.nt, "auto", "xla",
+        False)), (a.tiles,)
+
+
+@register("getrf_pp_ckpt_seg", tags=("ckpt",))
+def _getrf_pp_ckpt_seg(ctx):
+    import jax.numpy as jnp
+
+    from ..ft import ckpt
+
+    a = ctx.dist(diag_pad=True)
+    perm = jnp.arange(a.nt * a.nb)
+    return (lambda t, pm: ckpt._pp_seg_jit(
+        t, pm, 0.0, ctx.mesh, ctx.p, ctx.q, a.nt, N, 1, a.nt, "auto",
+        False)), (a.tiles, perm)
+
+
+def _ft_trsm_build(ctx, armed):
+    import jax.numpy as jnp
+
+    from ..ft import abft, inject
+    from ..parallel.comm import resolve_bcast_impl
+    from ..parallel.dist import DistMatrix, from_dense, to_dense
+
+    a = ctx.dense(kind="tril")
+    b = ctx.dense_thin()
+    ints, vals = inject.spec_arrays("trsm")
+    if armed:
+        ints[0] = (1, N // NB - 1, 3, 1, 0, 1 % GRID[0], 0, 2)
+        vals[0] = 3.0
+    fi, fv = jnp.asarray(ints), jnp.asarray(vals)
+
+    def fn(x, y):
+        b_aug, mt, ntb = abft._encode_trsm_rhs(x, y, NB, ctx.mesh)
+        ad = from_dense(x, ctx.mesh, NB, diag_pad_one=True)
+        bd = from_dense(b_aug, ctx.mesh, NB)
+        out = abft._ft_trsm_jit(
+            ad.tiles, bd.tiles, ctx.mesh, ctx.p, ctx.q, mt, True, False,
+            False, 1, resolve_bcast_impl(), fi, fv,
+        )
+        dense = to_dense(DistMatrix(
+            tiles=out, m=b_aug.shape[0], n=b_aug.shape[1], nb=NB,
+            mesh=ctx.mesh,
+        ))
+        return abft._trsm_residual(dense, NB, mt * NB, ntb * NB)
+
+    return fn, (a, b)
+
+
+@register("trsm_abft_detect", tags=("ft",))
+def _ft_trsm_detect(ctx):
+    return _ft_trsm_build(ctx, armed=False)
+
+
+@register("trsm_abft_correct", tags=("ft",))
+def _ft_trsm_correct(ctx):
+    return _ft_trsm_build(ctx, armed=True)
+
+
 @register("potrf_dist_num", tags=("num",))
 def _potrf_num(ctx):
     from ..parallel.dist_chol import potrf_dist

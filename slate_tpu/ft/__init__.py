@@ -35,7 +35,18 @@ from .policy import (  # noqa: F401
     ft_counter_values,
     resolve_policy,
 )
-from .inject import Fault, FaultPlan, fault_scope  # noqa: F401
+from .inject import (  # noqa: F401
+    Fault,
+    FaultPlan,
+    KillFault,
+    fault_scope,
+    seeded_kill,
+)
+
+# ``ft.ckpt`` (checkpointed k-loops, Preempted, Checkpoint) and
+# ``ft.elastic`` (resume/reshard) are deliberately NOT imported here:
+# they pull the whole parallel kernel stack — import them as submodules,
+# like ``ft.abft``.
 
 __all__ = [
     "FtError",
@@ -45,5 +56,7 @@ __all__ = [
     "resolve_policy",
     "Fault",
     "FaultPlan",
+    "KillFault",
     "fault_scope",
+    "seeded_kill",
 ]

@@ -441,8 +441,219 @@ def _ft_lu_jit(at, mesh, p, q, nt, la, bi, pi, fi, fv):
 
 
 # ---------------------------------------------------------------------------
-# encoders: augmented dense operands (checksum tiles become grid tiles)
+# checksum-carrying distributed triangular solve (ISSUE 12 satellite: the
+# ROADMAP's first long-tail ABFT op).  The solution-checksum invariant
+# rides the RHS: appending the weighted column sums of B as extra RHS
+# tile columns makes the solve produce X augmented with its own column
+# checksums — op(A) X_ck = B_ck and X_ck = X W by linearity — on the
+# UNCHANGED broadcast schedule (the A-panel and solved-row broadcasts of
+# dist_trsm._trsm_jit simply carry CSR more tiles).
 # ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10))
+def _ft_trsm_jit(at, bt, mesh, p, q, nt, uplo_lower, trans, unit, la, bi,
+                 fi, fv):
+    """The dist_trsm TrsmB left-solve schedule (prefetch_bcast over A's
+    read-only per-step panels) with the pure-JAX fault hooks: ``bcast``
+    corrupts one device's received A-panel copy, ``trailing`` one stored
+    B/X tile right after step k's update lands.  ``trans`` covers
+    op(A) = A^T (real); conjugation is out of scope for the f64 serving
+    path this protects."""
+    spec = P(ROW_AXIS, COL_AXIS)
+    eff_lower = bool(uplo_lower) != bool(trans)
+    forward = eff_lower
+
+    def kernel(a_loc, b_loc, fi, fv):
+        mtl, ntl, nb, _ = a_loc.shape
+        r, c, i_log, _ = local_indices(p, q, mtl, ntl)
+        slots = _slots(fi, fv)
+
+        def opt(t):
+            return jnp.swapaxes(t, -1, -2)
+
+        def fetch(s):
+            k = s if forward else nt - 1 - s
+            kr, kc = k // p, k // q
+            dtile = bcast_diag_tile(a_loc, k, p, q, nb)
+            if trans:
+                dtile = opt(dtile)
+            remaining = (i_log > k) if forward else (i_log < k)
+            if not trans:
+                acol = lax.dynamic_slice_in_dim(a_loc, kc, 1, axis=1)[:, 0]
+                mine_c = (c == k % q)
+                pan = bcast_from_col(
+                    jnp.where(remaining[:, None, None] & mine_c, acol, 0),
+                    k % q,
+                )
+            else:
+                arow = lax.dynamic_slice_in_dim(a_loc, kr, 1, axis=0)[0]
+                mine_r2 = (r == k % p)
+                arow = bcast_from_row(jnp.where(mine_r2, arow, 0), k % p)
+                allrow = all_gather_a(arow, COL_AXIS, axis=0)
+                pan = opt(allrow[i_log % q, i_log // q])
+                pan = jnp.where(remaining[:, None, None], pan, 0)
+            # bcast-phase fault: one device's RECEIVED panel copy rots
+            # before its update consumes it (propagates; recompute class)
+            for act, fk, fph, fti, ftj, fr, fc, fmode, val in slots:
+                hit = (
+                    (act == 1) & (fph == PH_BCAST) & (k == fk)
+                    & (r == fr) & (c == fc)
+                )
+                pan = _hit3(pan, hit & (r == fti % p), fti // p, fmode, val)
+            return dtile, pan
+
+        def consume(s, panels, b_loc):
+            k = s if forward else nt - 1 - s
+            kr = k // p
+            dtile, pan = panels
+            brow = lax.dynamic_slice_in_dim(b_loc, kr, 1, axis=0)[0]
+            xrow = lax.linalg.triangular_solve(
+                jnp.broadcast_to(dtile, brow.shape), brow,
+                left_side=True, lower=eff_lower, transpose_a=False,
+                unit_diagonal=bool(unit),
+            )
+            mine_r = (r == k % p)
+            b_loc = lax.dynamic_update_slice_in_dim(
+                b_loc, jnp.where(mine_r, xrow, brow)[None], kr, axis=0
+            )
+            xrow = bcast_from_row(jnp.where(mine_r, xrow, 0), k % p)
+            upd = jnp.einsum("iab,jbc->ijac", pan, xrow, precision=PRECISE)
+            b_loc = b_loc - upd.astype(b_loc.dtype)
+            # trailing-phase fault: one stored B/X tile rots right after
+            # step k's update (final for already-solved rows — exactly
+            # correctable; live for remaining rows — recompute class)
+            for act, fk, fph, fti, ftj, fr, fc, fmode, val in slots:
+                hit = (
+                    (act == 1) & ((fph == PH_TRAIL) | (fph == PH_PANEL))
+                    & (k == fk) & (r == fti % p) & (c == ftj % q)
+                )
+                b_loc = _hit4(b_loc, hit, fti // p, ftj // q, fmode, val)
+            return b_loc
+
+        return prefetch_bcast(nt, la, fetch, consume, b_loc)
+
+    with bcast_impl_scope(bi):
+        return shard_map_compat(
+            kernel, mesh=mesh, in_specs=(spec, spec, P(), P()),
+            out_specs=spec, check_vma=False,
+        )(at, bt, fi, fv)
+
+
+def _encode_trsm_rhs(a: jax.Array, b: jax.Array, nb: int, mesh):
+    """Pad B to A's padded row extent, tile-pad its columns, and append
+    the CSR weighted column-checksum tile columns (the solution-checksum
+    carrier).  Pad rows of the identity-padded A solve to exact zeros."""
+    n = a.shape[0]
+    mt = padded_tiles(n, nb, mesh)
+    N = mt * nb
+    ntb = max(1, -(-int(b.shape[1]) // nb))
+    Nc = ntb * nb
+    bp = cks.pad_dense(b, N, Nc)
+    return jnp.concatenate([bp, cks.col_checksums(bp, nb)], axis=1), mt, ntb
+
+
+def _trsm_residual(out_dense, nb: int, N: int, Nc: int):
+    """(X, carried column checksums minus recomputed X column sums)."""
+    x = out_dense[:N, :Nc]
+    dc = out_dense[:N, Nc : Nc + CSR * nb] - cks.col_checksums(x, nb)
+    return x, dc
+
+
+def trsm_ft(
+    a, b, mesh, nb: int = 256, uplo=None, op=None, diag=None,
+    policy: FtPolicy = FtPolicy.Correct, lookahead=None, bcast_impl=None,
+    _rerun: bool = False,
+):
+    """ABFT distributed triangular solve op(A) X = B (left side, TrsmB
+    schedule).  Returns (dense X, FtReport); raises FtError per policy.
+
+    Detection: the carried solution checksums X_ck (solved alongside as
+    extra RHS columns) are differenced against the recomputed column
+    sums of X.  A corrupted ALREADY-SOLVED tile is final data — the
+    unit-weight discrepancy restores it exactly (rounding included); a
+    corrupted not-yet-solved tile (or a received-panel fault) feeds
+    later substitution steps and escalates to one recompute, then
+    ``FtError`` if the rerun still verifies dirty."""
+    from ..types import Diag, Op, Uplo
+
+    uplo = uplo or Uplo.Lower
+    op = op or Op.NoTrans
+    diag = diag or Diag.NonUnit
+    if op == Op.ConjTrans:
+        raise ValueError("trsm_ft covers NoTrans/Trans (real data)")
+    a, b = jnp.asarray(a), jnp.asarray(b)
+    if a.ndim != 2 or a.shape[0] != a.shape[1] or b.shape[0] != a.shape[0]:
+        raise ValueError(f"trsm_ft shape mismatch: A {a.shape}, B {b.shape}")
+    if policy == FtPolicy.Off:
+        from ..parallel.dist import from_dense as _fd, to_dense as _td
+        from ..parallel.dist_trsm import trsm_dist
+        from ..types import MethodTrsm
+
+        ad = _fd(a, mesh, nb, diag_pad_one=True)
+        bd = _fd(b, mesh, nb)
+        x = trsm_dist(ad, bd, uplo, op, diag, method=MethodTrsm.TrsmB,
+                      lookahead=lookahead, bcast_impl=bcast_impl)
+        return _td(x)[: a.shape[0], : b.shape[1]], FtReport(op="trsm")
+    n, ncols = int(a.shape[0]), int(b.shape[1])
+    p, q = mesh_shape(mesh)
+    b_aug, mt, ntb = _encode_trsm_rhs(a, b, nb, mesh)
+    ad = from_dense(a, mesh, nb, diag_pad_one=True)
+    bd = from_dense(b_aug, mesh, nb)
+    la = la_depth(lookahead, mt)
+    ints, vals = inject.spec_arrays("trsm")
+    out_t = _ft_trsm_jit(
+        ad.tiles, bd.tiles, mesh, p, q, mt,
+        uplo == Uplo.Lower, op == Op.Trans, diag == Diag.Unit, la,
+        resolve_bcast_impl(bcast_impl),
+        jnp.asarray(ints), jnp.asarray(vals, jnp.result_type(float)),
+    )
+    inject.consume("trsm")
+    out_full = to_dense(DistMatrix(
+        tiles=out_t, m=b_aug.shape[0], n=b_aug.shape[1], nb=nb, mesh=mesh,
+    ))
+    N, Nc = mt * nb, ntb * nb
+    x, dc = _trsm_residual(out_full, nb, N, Nc)
+    x_np, dcn = np.asarray(x), np.asarray(dc)
+    fmax = max(1.0, cks.finite_max(x_np), cks.finite_max(np.asarray(b)))
+    tol1 = cks.threshold(N, x_np.dtype, ntb * fmax)
+    tol2 = cks.threshold(N, x_np.dtype, ntb * ntb * fmax)
+    verdC = _verdict_rows(dcn, nb, ntb, tol1, tol2, "X-tile")
+    report = FtReport(op="trsm")
+    if verdC.clean:
+        return jnp.asarray(x_np[:n, :ncols]), report
+    dets = verdC.detections
+    count("ft.detected", "trsm", len(dets))
+    if policy == FtPolicy.Detect:
+        raise FtError("trsm", "corruption detected (policy=detect)", dets)
+    if policy == FtPolicy.Correct and not _rerun:
+        # exact repair, valid only for damage in an ALREADY-SOLVED tile:
+        # one flagged tile row, one located column — add the unit
+        # discrepancy back and let re-verification judge it
+        if len(verdC.flagged) == 1 and verdC.located != {-1}:
+            (i_star,) = verdC.flagged
+            (j_star,) = verdC.located
+            fixed = x_np.copy()
+            _add_row_disc(fixed, dcn, nb, int(i_star), int(j_star))
+            dc2 = np.asarray(
+                out_full[:N, Nc : Nc + CSR * nb]
+                - cks.col_checksums(jnp.asarray(fixed), nb)
+            )
+            if _verdict_rows(dc2, nb, ntb, tol1, tol2, "X-tile").clean:
+                count("ft.corrected", "trsm", len(dets))
+                report.action, report.detections = "corrected", dets
+                return jnp.asarray(fixed[:n, :ncols]), report
+    if _rerun:
+        count("ft.uncorrectable", "trsm")
+        raise FtError("trsm", "recompute still fails verification", dets)
+    # live-data corruption (the fault fed later substitution steps):
+    # one full recompute — transient faults have disarmed
+    count("ft.recomputed", "trsm")
+    out2, rep2 = trsm_ft(a, b, mesh, nb, uplo, op, diag, policy, lookahead,
+                         bcast_impl, _rerun=True)
+    rep2.action = "recomputed"
+    rep2.detections = dets + rep2.detections
+    return out2, rep2
 
 
 def _encode_factor(a: jax.Array, nb: int, mesh, with_cols: bool):

@@ -1,0 +1,686 @@
+"""Checkpointed mesh k-loops: segment dispatch + carry snapshots (ISSUE 12).
+
+The fused factorization kernels (dist_chol / dist_lu) run their whole
+k-loop inside one XLA dispatch: a preemption mid-factorization loses
+everything.  This module re-expresses the three long-running factor
+loops — potrf, LU-nopiv, and partial-pivot LU — as a CHAIN OF SEGMENT
+DISPATCHES over the same module-level step helpers the flight recorder
+already exercises per step (``_chol_panel_compute``/``_nopiv_panel``/
+``_pp_panel_and_swaps``): each segment jit runs steps [k0, k1) of the
+strict (depth-0, unbucketed) schedule on the full tile view, and the
+loop carry — factored panels + trailing block in one cyclic tile stack,
+the replicated pivot permutation (pp), and the Option.NumMonitor gauge
+scalars — crosses segment boundaries as ordinary operands.
+
+Because every schedule of these loops is bitwise-identical (lookahead
+depth and trailing-view bucketing reorder only independent work — the
+invariant tests/test_lookahead.py and the flight recorder already pin),
+the chained segments produce EXACTLY the fused kernels' bytes, and a
+run resumed from any snapshot is bitwise-equal to the uninterrupted
+run (tests/test_ckpt.py asserts this per op).
+
+``Option.Checkpoint`` (int K; explicit > ``SLATE_TPU_CKPT`` env > off)
+snapshots the carry to host at every K-step boundary; ``off`` routes to
+the plain fused kernels untouched — trace-identical, zero overhead.
+Snapshots store the tile grid in LOGICAL order, so a checkpoint taken
+on a p x q mesh can resume on a p' x q' mesh (``ft/elastic.py``): the
+block-cyclic redistribution moves exact bytes, so the reshaped resume
+is bitwise too.
+
+The deterministic injector grows a *kill* class (``inject.KillFault``,
+``inject.seeded_kill``): the driver consults the active plan between
+segment dispatches and raises ``Preempted`` (carrying the last
+snapshot) before executing the segment containing the kill step —
+losing exactly the unsnapshotted steps a real preemption would.
+Recovery cost lands in the ``ft.ckpt_*`` counters (policy.py), gated in
+CI via ``python -m slate_tpu.ft.ckpt_smoke`` + ``obs.report --check``.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.tiling import cyclic_perm, inv_perm
+from ..obs import instrument
+from ..obs.numerics import resolve_num_monitor
+from ..ops.pallas_ops import panel_impl_scope, resolve_panel_impl
+from ..parallel.comm import (
+    audit_scope,
+    bcast_impl_scope,
+    local_indices,
+    num_gauge_dtype,
+    phase_scope,
+    pipelined_factor_loop,
+    resolve_bcast_impl,
+    shard_map_compat,
+)
+from ..parallel.dist import DistMatrix
+from ..parallel.dist_chol import (
+    _chol_bulk,
+    _chol_info_dist,
+    _chol_narrow,
+    _chol_panel_bcast,
+    _chol_panel_compute,
+    potrf_dist,
+)
+from ..parallel.dist_lu import (
+    _lu_info_dist,
+    _nopiv_bulk,
+    _nopiv_narrow,
+    _nopiv_panel,
+    _nopiv_step,
+    _pp_panel_and_swaps,
+    _wabs_max,
+    getrf_nopiv_dist,
+    getrf_pp_dist,
+)
+from ..parallel.mesh import COL_AXIS, ROW_AXIS, mesh_shape
+from ..types import SlateError
+from . import inject
+from .policy import count
+
+CKPT_ENV = "SLATE_TPU_CKPT"
+CKPT_OPS = ("potrf", "getrf_nopiv", "getrf_pp")
+
+
+def resolve_checkpoint(every=None) -> Optional[int]:
+    """Resolve an Option.Checkpoint value at driver level: explicit
+    argument > ``SLATE_TPU_CKPT`` environment > off.  Returns the
+    snapshot interval (int >= 1) or None (off — the plain kernels)."""
+    if every is None:
+        env = os.environ.get(CKPT_ENV, "").strip()
+        if env in ("", "0", "off"):
+            return None
+        every = env
+    if every in (None, 0, False) or str(every) in ("0", "off"):
+        return None
+    k = int(every)
+    if k < 1:
+        raise ValueError(
+            f"Option.Checkpoint must be a positive step interval or off, "
+            f"got {every!r}"
+        )
+    return k
+
+
+# ---------------------------------------------------------------------------
+# Snapshot + preemption types
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Checkpoint:
+    """One host-resident snapshot of a mesh factorization's k-loop carry.
+
+    ``tiles`` is the PADDED tile grid in LOGICAL order (mt, nt, nb, nb)
+    — layout-independent, so the snapshot resumes on any grid shape:
+    pad tiles carry the identity diagonal and receive exact-zero
+    trailing updates, hence the data region is bitwise-invariant under
+    re-padding for a different mesh lcm.  ``rowperm`` (pp only) covers
+    the padded row space; all swap activity lives below the true extent,
+    so re-basing onto a different padded length copies a prefix of
+    fixed points + data swaps exactly.  ``gauges`` are the NumMonitor
+    carry scalars, already globally reduced (min/max are exact, so
+    re-seeding every device with the global partial is bitwise)."""
+
+    op: str
+    step: int  # next logical k-step to execute on resume
+    every: int  # snapshot interval the run was using
+    m: int
+    n: int
+    nb: int
+    grid: Tuple[int, int]  # (p, q) the snapshot was taken on
+    bcast_impl: str
+    panel_impl: str
+    num_monitor: bool
+    tiles: np.ndarray  # LOGICAL-order padded tile grid
+    rowperm: Optional[np.ndarray] = None
+    gauges: Dict[str, np.ndarray] = field(default_factory=dict)
+
+    @property
+    def nbytes(self) -> int:
+        n = int(self.tiles.nbytes)
+        if self.rowperm is not None:
+            n += int(self.rowperm.nbytes)
+        return n
+
+    def save(self, path: str) -> str:
+        """Persist to disk (``np.savez``): the preemption-survival form —
+        ``Checkpoint.load(path)`` round-trips bitwise."""
+        meta = dict(
+            op=self.op, step=self.step, every=self.every, m=self.m,
+            n=self.n, nb=self.nb, grid=list(self.grid),
+            bcast_impl=self.bcast_impl, panel_impl=self.panel_impl,
+            num_monitor=self.num_monitor,
+        )
+        arrays = {
+            "tiles": self.tiles,
+            "meta": np.frombuffer(json.dumps(meta).encode(), np.uint8),
+        }
+        if self.rowperm is not None:
+            arrays["rowperm"] = self.rowperm
+        for k, v in self.gauges.items():
+            arrays[f"gauge_{k}"] = np.asarray(v)
+        with open(path, "wb") as f:  # np.savez(str) would append .npz
+            np.savez(f, **arrays)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "Checkpoint":
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["meta"]).decode())
+            gauges = {
+                k[len("gauge_"):]: z[k] for k in z.files
+                if k.startswith("gauge_")
+            }
+            return cls(
+                op=meta["op"], step=int(meta["step"]),
+                every=int(meta["every"]), m=int(meta["m"]), n=int(meta["n"]),
+                nb=int(meta["nb"]), grid=tuple(meta["grid"]),
+                bcast_impl=meta["bcast_impl"], panel_impl=meta["panel_impl"],
+                num_monitor=bool(meta["num_monitor"]), tiles=z["tiles"],
+                rowperm=(z["rowperm"] if "rowperm" in z.files else None),
+                gauges=gauges,
+            )
+
+
+class Preempted(SlateError):
+    """A (possibly injected) preemption interrupted a checkpointed
+    k-loop.  ``checkpoint`` is the last snapshot — resume it with
+    ``ft.elastic.resume`` — or None when the kill landed before the
+    first snapshot boundary (nothing to resume from: the caller decides
+    between a from-scratch restart and rejection)."""
+
+    def __init__(self, op: str, killed_at: int, checkpoint: Optional[Checkpoint]):
+        self.op = op
+        self.killed_at = int(killed_at)
+        self.checkpoint = checkpoint
+        state = (
+            f"resumable from step {checkpoint.step}"
+            if checkpoint is not None
+            else "no snapshot taken — unresumable"
+        )
+        super().__init__(f"ckpt[{op}]: preempted at step {killed_at} ({state})")
+
+
+def _cyclic_to_logical(t: np.ndarray, p: int, q: int) -> np.ndarray:
+    """Host-side ``tiling.from_cyclic`` (a pure index permutation — moves
+    exact bytes, never touches values)."""
+    rp = inv_perm(cyclic_perm(t.shape[0], p))
+    cp = inv_perm(cyclic_perm(t.shape[1], q))
+    return np.ascontiguousarray(t[rp][:, cp])
+
+
+def _logical_to_cyclic(t: np.ndarray, p: int, q: int) -> np.ndarray:
+    rp = cyclic_perm(t.shape[0], p)
+    cp = cyclic_perm(t.shape[1], q)
+    return np.ascontiguousarray(t[rp][:, cp])
+
+
+# ---------------------------------------------------------------------------
+# Segment kernels: steps [k0, k1) of the strict schedule on the full view.
+# The step bodies are the module-level dist_chol/_lu helpers — the same
+# arithmetic in the same per-element order as the fused kernels, so the
+# chained segments reproduce their results bitwise at any boundary set.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10, 11))
+def _potrf_seg_jit(at, g, mesh, p, q, nt, n_true, k0, k1, bi, pi, nm):
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(t_loc, g_in):
+        mtl, ntl, nb, _ = t_loc.shape
+        dtype = t_loc.dtype
+        cplx = jnp.issubdtype(dtype, jnp.complexfloating)
+        r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
+        lower = (i_log[:, None] >= j_log[None, :])[:, :, None, None]
+        rdt = num_gauge_dtype(dtype)
+
+        def panel(k, view):
+            view, pan_own = _chol_panel_compute(view, k, p, q, i_log, c, cplx)
+            with phase_scope("bcast", k):
+                return view, _chol_panel_bcast(pan_own, k, p, q, j_log)
+
+        def narrow(k, view, pl):
+            return _chol_narrow(view, pl, k, q, lower, cplx)
+
+        def bulk(k, view, pl):
+            if k is None:
+                return _chol_bulk(view, pl, lower, cplx)
+            return _chol_bulk(view, pl, lower, cplx, k // q)
+
+        zero_pl = (
+            jnp.zeros((mtl, nb, nb), dtype),
+            jnp.zeros((ntl, nb, nb), dtype),
+        )
+        if not nm:
+            t_loc = pipelined_factor_loop(
+                k0, k1, 0, panel, narrow, bulk, t_loc, zero_pl
+            )
+            return t_loc, jnp.zeros((1, 1), jnp.float32)
+
+        def diag_probe(k, view):
+            # dist_chol._potrf_jit's near-breakdown margin probe at panel
+            # entry (the strict-schedule Schur diagonal, true extent only)
+            dvals = jnp.einsum("ijaa->ija", jnp.real(view)).astype(rdt)
+            gidx = i_log[:, None, None] * nb + jnp.arange(nb)[None, None, :]
+            m = ((i_log[:, None] == j_log[None, :])[:, :, None]
+                 & (i_log >= k)[:, None, None] & (gidx < n_true))
+            return jnp.min(jnp.where(m, dvals, jnp.inf))
+
+        def panel_nm(k, st):
+            view, gg = st
+            gg = jnp.minimum(gg, diag_probe(k, view))
+            view, pl = panel(k, view)
+            return (view, gg), pl
+
+        def narrow_nm(k, st, pl):
+            return (narrow(k, st[0], pl), st[1])
+
+        def bulk_nm(k, st, pl):
+            return (bulk(k, st[0], pl), st[1])
+
+        t_loc, gg = pipelined_factor_loop(
+            k0, k1, 0, panel_nm, narrow_nm, bulk_nm,
+            (t_loc, g_in.astype(rdt)), zero_pl,
+        )
+        # carry the margin out globally reduced (min is exact, so seeding
+        # the next segment with the global partial is bitwise — the
+        # _lu_info_dist unaudited reduction class)
+        gg = lax.pmin(lax.pmin(gg, ROW_AXIS), COL_AXIS)
+        return t_loc, gg[None, None]
+
+    with bcast_impl_scope(bi), panel_impl_scope(pi):
+        lt, g_out = shard_map_compat(
+            kernel, mesh=mesh, in_specs=(spec, P()),
+            out_specs=(spec, P(ROW_AXIS, COL_AXIS)), check_vma=False,
+        )(at, g)
+    return lt, jnp.min(g_out)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7))
+def _potrf_fin_jit(at, g, mesh, p, q, nt, n_true, nm):
+    """info + (margin, lmin, lmax) gauges of the completed factor — the
+    exit computation of dist_chol._potrf_jit, split off so the segment
+    chain runs it exactly once."""
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(t_loc, g_in):
+        mtl, ntl, nb, _ = t_loc.shape
+        _, _, i_log, j_log = local_indices(p, q, mtl, ntl)
+        info = _chol_info_dist(t_loc, i_log, j_log, nt, nb)
+        if not nm:
+            return info[None, None], jnp.zeros((1, 1, 3), jnp.float32)
+        rdt = num_gauge_dtype(t_loc.dtype)
+        dvals = jnp.einsum("ijaa->ija", jnp.real(t_loc)).astype(rdt)
+        gidx = i_log[:, None, None] * nb + jnp.arange(nb)[None, None, :]
+        dm = (i_log[:, None] == j_log[None, :])[:, :, None] & (gidx < n_true)
+        lmin = jnp.min(jnp.where(dm, dvals, jnp.inf))
+        lmax = jnp.max(jnp.where(dm, dvals, -jnp.inf))
+
+        def allr(x, op):
+            return op(op(x, ROW_AXIS), COL_AXIS)
+
+        gz = jnp.stack([
+            g_in.astype(rdt), allr(lmin, lax.pmin), allr(lmax, lax.pmax),
+        ])
+        return info[None, None], gz[None, None]
+
+    info, gz = shard_map_compat(
+        kernel, mesh=mesh, in_specs=(spec, P()),
+        out_specs=(P(ROW_AXIS, COL_AXIS), P(ROW_AXIS, COL_AXIS)),
+        check_vma=False,
+    )(at, g)
+    return jnp.max(info), gz[0, 0]
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8, 9, 10, 11))
+def _lu_seg_jit(at, g, mesh, p, q, nt, m_true, k0, k1, bi, pi, nm):
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(t_loc, g_in):
+        mtl, ntl, nb, _ = t_loc.shape
+        dtype = t_loc.dtype
+        r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
+        rdt = num_gauge_dtype(dtype)
+
+        def panel(k, view):
+            return _nopiv_panel(view, k, p, q, i_log, j_log, r, c)
+
+        def narrow(k, view, pl):
+            return _nopiv_narrow(view, pl, k, p, q)
+
+        def bulk(k, view, pl):
+            if k is None:
+                return _nopiv_bulk(view, pl)
+            return _nopiv_bulk(view, pl, k // p, k // q)
+
+        zero_pl = (
+            jnp.zeros((mtl, nb, nb), dtype),
+            jnp.zeros((ntl, nb, nb), dtype),
+        )
+        if not nm:
+            t_loc = pipelined_factor_loop(
+                k0, k1, 0, panel, narrow, bulk, t_loc, zero_pl
+            )
+            return t_loc, jnp.zeros((1, 1), jnp.float32)
+
+        def panel_nm(k, st):
+            view, gg = st
+            gg = jnp.maximum(gg, _wabs_max(view, i_log, j_log, nb, m_true, rdt))
+            view, pl = panel(k, view)
+            return (view, gg), pl
+
+        def narrow_nm(k, st, pl):
+            return (narrow(k, st[0], pl), st[1])
+
+        def bulk_nm(k, st, pl):
+            return (bulk(k, st[0], pl), st[1])
+
+        t_loc, gg = pipelined_factor_loop(
+            k0, k1, 0, panel_nm, narrow_nm, bulk_nm,
+            (t_loc, g_in.astype(rdt)), zero_pl,
+        )
+        gg = lax.pmax(lax.pmax(gg, ROW_AXIS), COL_AXIS)
+        return t_loc, gg[None, None]
+
+    with bcast_impl_scope(bi), panel_impl_scope(pi):
+        lt, g_out = shard_map_compat(
+            kernel, mesh=mesh, in_specs=(spec, P()),
+            out_specs=(spec, P(ROW_AXIS, COL_AXIS)), check_vma=False,
+        )(at, g)
+    return lt, jnp.max(g_out)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8))
+def _lu_fin_jit(at, amax0, g, mesh, p, q, nt, m_true, nm):
+    """info + (amax0, growth-max) gauges for the LU ops (shared by the
+    nopiv and pp segment chains — the _lu_growth_out exit computation on
+    already-reduced carried scalars)."""
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(t_loc, a0, g_in):
+        mtl, ntl, nb, _ = t_loc.shape
+        _, _, i_log, j_log = local_indices(p, q, mtl, ntl)
+        info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
+        if not nm:
+            return info[None, None], jnp.zeros((1, 1, 2), jnp.float32)
+        rdt = num_gauge_dtype(t_loc.dtype)
+        gfin = _wabs_max(t_loc, i_log, j_log, nb, m_true, rdt)
+        gfin = lax.pmax(lax.pmax(gfin, ROW_AXIS), COL_AXIS)
+        gz = jnp.stack([a0.astype(rdt), jnp.maximum(g_in.astype(rdt), gfin)])
+        return info[None, None], gz[None, None]
+
+    info, gz = shard_map_compat(
+        kernel, mesh=mesh, in_specs=(spec, P(), P()),
+        out_specs=(P(ROW_AXIS, COL_AXIS), P(ROW_AXIS, COL_AXIS)),
+        check_vma=False,
+    )(at, amax0, g)
+    return jnp.max(info), gz[0, 0]
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def _wabs_init_jit(at, mesh, p, q, m_true):
+    """Globally-reduced max|A| over the true extent — the growth-gauge
+    denominator the fused LU kernels compute at loop entry."""
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(t_loc):
+        mtl, ntl, nb, _ = t_loc.shape
+        _, _, i_log, j_log = local_indices(p, q, mtl, ntl)
+        rdt = num_gauge_dtype(t_loc.dtype)
+        a0 = _wabs_max(t_loc, i_log, j_log, nb, m_true, rdt)
+        a0 = lax.pmax(lax.pmax(a0, ROW_AXIS), COL_AXIS)
+        return a0[None, None]
+
+    out = shard_map_compat(
+        kernel, mesh=mesh, in_specs=(spec,),
+        out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False,
+    )(at)
+    return jnp.max(out)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
+def _pp_seg_jit(at, rowperm, g, mesh, p, q, nt, m_true, k0, k1, bi, nm):
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(t_loc, rowperm, g_in):
+        mtl, ntl, nb, _ = t_loc.shape
+        r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
+        zero = jnp.zeros((), jnp.int32)
+        rdt = num_gauge_dtype(t_loc.dtype)
+
+        def probe(t_loc, gg):
+            return jnp.maximum(
+                gg, _wabs_max(t_loc, i_log, j_log, nb, m_true, rdt))
+
+        def step(k, carry):
+            if nm:
+                t_loc, rowperm, gg = carry
+                gg = probe(t_loc, gg)
+            else:
+                t_loc, rowperm = carry
+            t_loc, rowperm = _pp_panel_and_swaps(
+                t_loc, rowperm, k, p, q, r, c, nt, m_true,
+                zero, mtl, zero, ntl,
+            )
+            t_loc = _nopiv_step(
+                t_loc, k, p, q, i_log, j_log, r, c, panel_done=True
+            )
+            return (t_loc, rowperm, gg) if nm else (t_loc, rowperm)
+
+        init = ((t_loc, rowperm, g_in.astype(rdt)) if nm
+                else (t_loc, rowperm))
+        with audit_scope(k1 - k0):
+            out = lax.fori_loop(k0, k1, step, init)
+        if nm:
+            t_loc, rowperm, gg = out
+            gg = lax.pmax(lax.pmax(gg, ROW_AXIS), COL_AXIS)
+        else:
+            t_loc, rowperm = out
+            gg = jnp.zeros((), jnp.float32)
+        return t_loc, rowperm[None], gg[None, None]
+
+    with bcast_impl_scope(bi), panel_impl_scope("xla"):  # see _pp_jit
+        lt, perm, g_out = shard_map_compat(
+            kernel, mesh=mesh, in_specs=(spec, P(), P()),
+            out_specs=(spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS)),
+            check_vma=False,
+        )(at, rowperm, g)
+    return lt, perm[0], jnp.max(g_out)
+
+
+# ---------------------------------------------------------------------------
+# Host engine: segment chain + snapshot + kill consultation
+# ---------------------------------------------------------------------------
+
+
+def _seg_dispatch(op, st, mesh, p, q, nt, m_true, k0, k1, bi, pi, nm):
+    if op == "potrf":
+        st["tiles"], g = _potrf_seg_jit(
+            st["tiles"], st["g"], mesh, p, q, nt, m_true, k0, k1, bi, pi, nm)
+    elif op == "getrf_nopiv":
+        st["tiles"], g = _lu_seg_jit(
+            st["tiles"], st["g"], mesh, p, q, nt, m_true, k0, k1, bi, pi, nm)
+    elif op == "getrf_pp":
+        st["tiles"], st["rowperm"], g = _pp_seg_jit(
+            st["tiles"], st["rowperm"], st["g"], mesh, p, q, nt, m_true,
+            k0, k1, bi, nm)
+    else:
+        raise ValueError(f"no checkpointed driver for op {op!r}; "
+                         f"expected one of {CKPT_OPS}")
+    if nm:
+        st["g"] = g
+
+
+def _snapshot(op, d: DistMatrix, st, k, every, bi, pi, nm) -> Checkpoint:
+    p, q = mesh_shape(d.mesh)
+    gauges: Dict[str, np.ndarray] = {}
+    if nm:
+        gauges["g"] = np.asarray(st["g"])
+        if "amax0" in st:
+            gauges["amax0"] = np.asarray(st["amax0"])
+    ck = Checkpoint(
+        op=op, step=int(k), every=int(every), m=d.m, n=d.n, nb=d.nb,
+        grid=(p, q), bcast_impl=bi, panel_impl=pi, num_monitor=nm,
+        tiles=_cyclic_to_logical(np.asarray(st["tiles"]), p, q),
+        rowperm=(np.asarray(st["rowperm"]) if "rowperm" in st else None),
+        gauges=gauges,
+    )
+    count("ft.ckpt_snapshots", op)
+    count("ft.ckpt_snapshot_bytes", op, float(ck.nbytes))
+    return ck
+
+
+def _finish(op, d: DistMatrix, st, nm):
+    from ..obs import numerics as _num
+
+    mesh = d.mesh
+    p, q = mesh_shape(mesh)
+    nt = d.nt
+    m_true = d.n if op == "potrf" else d.m
+    out = DistMatrix(
+        tiles=st["tiles"], m=d.m, n=d.n, nb=d.nb, mesh=mesh, diag_pad=True
+    )
+    if op == "potrf":
+        info, gz = _potrf_fin_jit(st["tiles"], st["g"], mesh, p, q, nt,
+                                  m_true, nm)
+        if nm:
+            _num.record_chol_gauges("potrf", gz[0], gz[1], gz[2])
+        return out, info
+    amax0 = st.get("amax0", jnp.zeros((), jnp.float32))
+    info, gz = _lu_fin_jit(st["tiles"], amax0, st["g"], mesh, p, q, nt,
+                           m_true, nm)
+    if nm:
+        _num.record_lu_growth(op, gz[0], gz[1])
+    if op == "getrf_pp":
+        return out, st["rowperm"], info
+    return out, info
+
+
+def _run(op: str, d: DistMatrix, k_from: int, every: int, bi: str, pi: str,
+         nm: bool, rowperm=None, gauges=None,
+         ckpt0: Optional[Checkpoint] = None):
+    """Segment-dispatch the k-loop of ``op`` over [k_from, nt): snapshot
+    the carry at every ``every``-step boundary; raise ``Preempted`` when
+    an armed ``KillFault`` lands inside the segment about to run (the
+    work since the last snapshot is exactly what a real preemption would
+    lose — counted as ``ft.ckpt_lost_steps``)."""
+    mesh = d.mesh
+    p, q = mesh_shape(mesh)
+    nt = d.nt
+    m_true = d.n if op == "potrf" else d.m
+    st: dict = {"tiles": d.tiles}
+    if op == "getrf_pp":
+        st["rowperm"] = (
+            jnp.asarray(rowperm) if rowperm is not None
+            else jnp.arange(nt * d.nb)
+        )
+    if nm:
+        if op == "potrf":
+            st["g"] = (jnp.asarray(gauges["g"]) if gauges
+                       else jnp.asarray(jnp.inf, num_gauge_dtype(d.dtype)))
+        elif gauges:
+            st["amax0"] = jnp.asarray(gauges["amax0"])
+            st["g"] = jnp.asarray(gauges["g"])
+        else:
+            a0 = _wabs_init_jit(d.tiles, mesh, p, q, m_true)
+            st["amax0"] = a0
+            st["g"] = a0
+    else:
+        st["g"] = jnp.zeros((), jnp.float32)
+
+    last = ckpt0
+    k = int(k_from)
+    while k < nt:
+        k2 = min(k + every, nt)
+        kills = [f for f in inject.armed_kills(op) if k <= f.k < k2]
+        if kills:
+            kill = min(kills, key=lambda f: f.k)
+            plan = inject.current_plan()
+            if plan is not None:
+                plan.consume_fault(kill)
+            count("ft.ckpt_kills", op)
+            count("ft.ckpt_lost_steps", op, float(kill.k - k))
+            raise Preempted(op, kill.k, last)
+        _seg_dispatch(op, st, mesh, p, q, nt, m_true, k, k2, bi, pi, nm)
+        k = k2
+        if k < nt:
+            last = _snapshot(op, d, st, k, every, bi, pi, nm)
+    return _finish(op, d, st, nm)
+
+
+# ---------------------------------------------------------------------------
+# Public drivers (Option.Checkpoint off routes to the fused kernels:
+# trace-identical — the PanelImpl/NumMonitor off-mode contract)
+# ---------------------------------------------------------------------------
+
+
+def _check_square(a: DistMatrix, who: str) -> None:
+    if a.mt != a.nt:
+        raise ValueError(f"{who} needs a square tile grid")
+    a.require_diag_pad(who)
+
+
+@instrument("potrf_ckpt")
+def potrf_ckpt(a: DistMatrix, every=None, bcast_impl: Optional[str] = None,
+               panel_impl: Optional[str] = None,
+               num_monitor: Optional[str] = None):
+    """Checkpointed mesh Cholesky: ``potrf_dist`` results (bitwise) with
+    the carry snapshotted every ``every`` steps (Option.Checkpoint; None
+    resolves the env chain — off delegates to the fused kernel
+    untouched).  Returns (L DistMatrix, info); raises ``Preempted``
+    under an armed kill fault."""
+    ev = resolve_checkpoint(every)
+    if ev is None:
+        return potrf_dist(a, bcast_impl=bcast_impl, panel_impl=panel_impl,
+                          num_monitor=num_monitor)
+    _check_square(a, "potrf_ckpt")
+    return _run("potrf", a, 0, ev, resolve_bcast_impl(bcast_impl),
+                resolve_panel_impl(panel_impl),
+                resolve_num_monitor(num_monitor) == "on")
+
+
+@instrument("getrf_nopiv_ckpt")
+def getrf_nopiv_ckpt(a: DistMatrix, every=None,
+                     bcast_impl: Optional[str] = None,
+                     panel_impl: Optional[str] = None,
+                     num_monitor: Optional[str] = None):
+    """Checkpointed mesh LU-nopiv (getrf_nopiv_dist, bitwise).  Returns
+    (LU DistMatrix, info)."""
+    ev = resolve_checkpoint(every)
+    if ev is None:
+        return getrf_nopiv_dist(a, bcast_impl=bcast_impl,
+                                panel_impl=panel_impl,
+                                num_monitor=num_monitor)
+    _check_square(a, "getrf_nopiv_ckpt")
+    return _run("getrf_nopiv", a, 0, ev, resolve_bcast_impl(bcast_impl),
+                resolve_panel_impl(panel_impl),
+                resolve_num_monitor(num_monitor) == "on")
+
+
+@instrument("getrf_pp_ckpt")
+def getrf_pp_ckpt(a: DistMatrix, every=None,
+                  bcast_impl: Optional[str] = None,
+                  num_monitor: Optional[str] = None):
+    """Checkpointed partial-pivot mesh LU (getrf_pp_dist, bitwise): the
+    carry additionally snapshots the replicated row permutation.
+    Returns (LU DistMatrix, perm, info)."""
+    ev = resolve_checkpoint(every)
+    if ev is None:
+        return getrf_pp_dist(a, bcast_impl=bcast_impl,
+                             num_monitor=num_monitor)
+    _check_square(a, "getrf_pp_ckpt")
+    return _run("getrf_pp", a, 0, ev, resolve_bcast_impl(bcast_impl),
+                "xla", resolve_num_monitor(num_monitor) == "on")

@@ -1,0 +1,197 @@
+"""Checkpoint/restart smoke: the CI acceptance run for elastic reliability.
+
+Proves the ISSUE 12 acceptance surface on the 8-device CPU mesh:
+
+1. checkpointed-run identity — chained segment dispatches reproduce the
+   fused kernels BITWISE for potrf, LU-nopiv, and partial-pivot LU;
+2. kill → resume on the SAME mesh is bitwise-identical to the
+   uninterrupted factorization (deterministic seeded preemption);
+3. kill → resume on a RESHAPED mesh (2x4 → 4x2) lands the bitwise-same
+   solution via the shard_map block-cyclic redistribution (which itself
+   is asserted bitwise against the eager path);
+4. a snapshot survives a disk round trip (``Checkpoint.save/load``);
+5. the ``ft.ckpt_*`` recovery-cost counters (snapshots, snapshot bytes,
+   kills, lost steps, resumes, reshards, redistribute bytes) land in a
+   schema-valid RunReport, gated in CI by ``obs.report --check
+   --ignore '*_runtime_*'`` against the committed
+   artifacts/obs/ft_ckpt.report.json.
+
+Usage::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m slate_tpu.ft.ckpt_smoke [--out artifacts/ft_ckpt] \
+            [--n 64] [--nb 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+
+def run_smoke(out_dir: str, n: int = 64, nb: int = 8) -> int:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    import numpy as np
+
+    devs = jax.devices("cpu")
+    if len(devs) < 8:
+        print(f"ft.ckpt_smoke: need 8 CPU devices, have {len(devs)} — set "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+        return 2
+
+    from ..obs import report, reset
+    from ..parallel import from_dense, make_mesh, redistribute, to_dense
+    from ..parallel.dist_chol import potrf_dist
+    from ..parallel.dist_lu import getrf_nopiv_dist, getrf_pp_dist
+    from ..utils.testing import generate
+    from . import ckpt, elastic, inject
+    from .policy import ft_counter_values
+
+    reset()
+    mesh = make_mesh(2, 4, devices=devs[:8])
+    mesh42 = make_mesh(4, 2, devices=devs[:8])
+    nt = -(-n // nb)
+    every = max(2, nt // 3)
+    if nt < every + 2:
+        print(f"ft.ckpt_smoke: nt={nt} leaves no post-snapshot step to "
+              f"kill (every={every}) — use n/nb >= 4")
+        return 2
+    failures = []
+
+    def check(name, ok, detail=""):
+        if not ok:
+            failures.append(f"{name}: {detail}")
+
+    spd = jnp.asarray(n * generate("spd", n, seed=0))
+    dom = jnp.asarray(generate("dominant", n, seed=1))
+    gen = jnp.asarray(generate("randn", n, seed=2))
+    sd = from_dense(spd, mesh, nb, diag_pad_one=True)
+    dd = from_dense(dom, mesh, nb, diag_pad_one=True)
+    gd = from_dense(gen, mesh, nb, diag_pad_one=True)
+
+    cases = {
+        "potrf": (sd, lambda: potrf_dist(sd),
+                  lambda ev: ckpt.potrf_ckpt(sd, every=ev)),
+        "getrf_nopiv": (dd, lambda: getrf_nopiv_dist(dd),
+                        lambda ev: ckpt.getrf_nopiv_ckpt(dd, every=ev)),
+        "getrf_pp": (gd, lambda: getrf_pp_dist(gd),
+                     lambda ev: ckpt.getrf_pp_ckpt(gd, every=ev)),
+    }
+
+    resid = {}
+    for op, (_d, plain, ckpted) in cases.items():
+        ref = plain()
+        got = ckpted(every)
+        same = all(
+            np.array_equal(np.asarray(r), np.asarray(g))
+            for r, g in zip(jax.tree.leaves(ref), jax.tree.leaves(got))
+        )
+        check(f"{op}-uninterrupted", same,
+              "checkpointed chain != fused kernel (bitwise)")
+
+        # deterministic kill -> Preempted carrying the last snapshot
+        kill = inject.seeded_kill(20 + nt, op, nt)
+        if not (every <= kill.k < nt):  # keep the smoke resumable
+            kill = inject.KillFault(op, min(every + 1, nt - 1))
+        try:
+            with inject.fault_scope(inject.FaultPlan([kill])):
+                ckpted(every)
+            check(f"{op}-kill", False, "no Preempted raised")
+            continue
+        except ckpt.Preempted as e:
+            ck = e.checkpoint
+        check(f"{op}-snapshot", ck is not None and ck.step == (
+            kill.k // every) * every, f"checkpoint {ck and ck.step} for "
+            f"kill at {kill.k} (every={every})")
+
+        # disk round trip, then resume on the SAME mesh: bitwise
+        with tempfile.TemporaryDirectory() as td:
+            path = ck.save(os.path.join(td, "ck.npz"))
+            ck = ckpt.Checkpoint.load(path)
+        res = elastic.resume(ck, mesh)
+        same = all(
+            np.array_equal(np.asarray(r), np.asarray(g))
+            for r, g in zip(jax.tree.leaves(ref), jax.tree.leaves(res))
+        )
+        check(f"{op}-resume-same-mesh", same,
+              "resumed run != uninterrupted run (bitwise)")
+
+        # resume the SAME checkpoint on the reshaped 4x2 mesh: the
+        # solution (logical data region) must be bitwise-identical
+        res2 = elastic.resume(ck, mesh42)
+        check(f"{op}-resume-reshaped", np.array_equal(
+            np.asarray(to_dense(ref[0])), np.asarray(to_dense(res2[0]))),
+            "reshaped resume != uninterrupted run (bitwise)")
+        if op == "getrf_pp":
+            check("getrf_pp-perm-reshaped", np.array_equal(
+                np.asarray(ref[1])[:n], np.asarray(res2[1])[:n]),
+                "reshaped resume changed the pivot permutation")
+
+        info_ref = ref[-1]
+        check(f"{op}-info", int(info_ref) == int(res[-1]) == int(res2[-1]),
+              f"info mismatch {int(info_ref)} vs {int(res[-1])}/"
+              f"{int(res2[-1])}")
+        resid[op] = float(jnp.max(jnp.abs(
+            to_dense(ref[0]) - to_dense(res2[0]))))
+
+    # shard_map redistribution: bitwise vs the eager path on a ragged
+    # operand (the primitive reshaped resume rides)
+    rag = jnp.asarray(generate("randn", n, seed=3)[: n - nb // 2])
+    rd = from_dense(rag, mesh, nb)
+    ea = redistribute(rd, mesh42, impl="eager")
+    sm = redistribute(rd, mesh42, impl="shardmap")
+    check("redistribute-bitwise", np.array_equal(
+        np.asarray(ea.tiles), np.asarray(sm.tiles)),
+        "shardmap redistribute != eager (bitwise)")
+
+    ftv = ft_counter_values()
+    check("counters",
+          ftv["ckpt_snapshots"] >= 3 and ftv["ckpt_kills"] >= 3
+          and ftv["ckpt_resumes"] >= 6 and ftv["ckpt_reshards"] >= 3
+          and ftv["ckpt_snapshot_bytes"] > 0
+          and ftv["ckpt_redistribute_bytes"] > 0,
+          f"ckpt counters {ftv}")
+
+    os.makedirs(out_dir, exist_ok=True)
+    rep_path = os.path.join(out_dir, "ft_ckpt.report.json")
+    report.write_report(
+        rep_path, name="ft_ckpt_smoke",
+        config={"n": n, "nb": nb, "grid": "2x4", "regrid": "4x2",
+                "every": every},
+        values={f"ckpt_resume_max_abs_diff_{op}": v
+                for op, v in resid.items()},
+    )
+    with open(rep_path) as fh:
+        rep_doc = json.load(fh)
+    errs = report.validate_report(rep_doc)
+    check("report", not errs, f"schema: {errs}")
+    check("report-ft", rep_doc.get("ft", {}).get("ckpt_resumes", 0) >= 6,
+          f"RunReport ft section {rep_doc.get('ft')}")
+
+    if failures:
+        print(f"ft.ckpt_smoke: FAILED with {len(failures)} problem(s):")
+        for msg in failures:
+            print(f"  FAIL {msg}")
+        return 1
+    print(f"ft.ckpt_smoke: OK — 3 ops kill/resume bitwise (same + reshaped "
+          f"mesh), redistribute bitwise; counters {ftv}; report {rep_path}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m slate_tpu.ft.ckpt_smoke")
+    ap.add_argument("--out", default=os.path.join("artifacts", "ft_ckpt"))
+    ap.add_argument("--n", type=int, default=64)
+    ap.add_argument("--nb", type=int, default=8)
+    args = ap.parse_args(argv)
+    return run_smoke(args.out, args.n, args.nb)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

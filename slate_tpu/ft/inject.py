@@ -70,25 +70,68 @@ class Fault:
 
 
 @dataclass
+class KillFault:
+    """Host-level preemption fault: the machine dies at k-loop step ``k``.
+
+    Unlike ``Fault`` (a data corruption lowered into the kernel spec),
+    a kill never enters a jitted kernel — the checkpointed drivers
+    (``ft/ckpt.py``) consult the active plan between segment dispatches
+    and raise ``Preempted`` before executing the segment that contains
+    step ``k``, losing exactly the (unsnapshotted) steps a real
+    preemption would.  ``persist=False`` models a one-shot preemption:
+    the resumed run executes clean.  ``persist=True`` re-kills on every
+    resume — the give-up/graceful-rejection path."""
+
+    op: str  # "potrf" | "getrf_nopiv" | "getrf_pp"
+    k: int  # loop step the preemption lands on
+    persist: bool = False
+
+
+@dataclass
 class FaultPlan:
     """An armed set of faults plus the one-shot bookkeeping."""
 
-    faults: List[Fault] = field(default_factory=list)
+    faults: List = field(default_factory=list)  # Fault | KillFault
     _spent: set = field(default_factory=set)
 
     def armed(self, op: str) -> List[Fault]:
+        """Armed DATA faults for ``op`` (the kernel-spec class only —
+        kill faults never lower into a kernel spec)."""
         return [
             f
             for f in self.faults
-            if f.op == op and (f.persist or id(f) not in self._spent)
+            if isinstance(f, Fault)
+            and f.op == op
+            and (f.persist or id(f) not in self._spent)
+        ]
+
+    def armed_kills(self, op: str) -> List[KillFault]:
+        """Armed preemption faults for ``op`` (consumed individually by
+        the checkpointed driver when they fire, via ``consume_fault``)."""
+        return [
+            f
+            for f in self.faults
+            if isinstance(f, KillFault)
+            and f.op == op
+            and (f.persist or id(f) not in self._spent)
         ]
 
     def consume(self, op: str) -> None:
-        """Mark this op's non-persistent faults as delivered (called by
-        the ft driver right after the kernel ran with them armed)."""
+        """Mark this op's non-persistent DATA faults as delivered (called
+        by the ft driver right after the kernel ran with them armed).
+        Kill faults are consumed when they FIRE (``consume_fault``), not
+        here: arming a kill next to a data fault must not disarm it just
+        because the abft kernel ran first."""
         for f in self.faults:
-            if f.op == op and not f.persist:
+            if isinstance(f, Fault) and f.op == op and not f.persist:
                 self._spent.add(id(f))
+
+    def consume_fault(self, f) -> None:
+        """Mark ONE fault delivered (the kill-fault path: the ckpt
+        driver consumes the exact kill that fired, so resume runs clean
+        while other armed faults stay live)."""
+        if not f.persist:
+            self._spent.add(id(f))
 
 
 _tls = threading.local()
@@ -138,6 +181,24 @@ def consume(op: str) -> None:
     plan = current_plan()
     if plan is not None:
         plan.consume(op)
+
+
+def armed_kills(op: str) -> List[KillFault]:
+    """Armed preemption faults for ``op`` in the active plan (empty when
+    no plan is active — the common case: one thread-local read)."""
+    plan = current_plan()
+    return plan.armed_kills(op) if plan is not None else []
+
+
+def seeded_kill(seed: int, op: str, nt: int, persist: bool = False) -> KillFault:
+    """One deterministic preemption for ``op`` on an ``nt``-step loop:
+    the kill step is drawn in [1, nt) so at least one step of work
+    precedes it (a kill at step 0 is just 'never started').  Same seed →
+    same step, so a kill/resume test is exactly reproducible."""
+    if nt < 2:
+        raise ValueError(f"seeded_kill needs nt >= 2 (got {nt})")
+    rng = np.random.default_rng(seed)
+    return KillFault(op, int(rng.integers(1, nt)), persist)
 
 
 def seeded_fault(

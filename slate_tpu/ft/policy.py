@@ -89,7 +89,18 @@ def resolve_policy(opts: Optional[Options]) -> FtPolicy:
 
 # -- counters ----------------------------------------------------------------
 
-_COUNTERS = ("ft.detected", "ft.corrected", "ft.recomputed", "ft.uncorrectable")
+_COUNTERS = (
+    "ft.detected", "ft.corrected", "ft.recomputed", "ft.uncorrectable",
+    # checkpoint/restart recovery-cost counters (ft/ckpt.py + ft/elastic.py):
+    # snapshots taken + their host bytes, injected/observed preemptions,
+    # steps lost to the last unsnapshotted window (recomputed on resume),
+    # resumes (same mesh), reshards (resume on a different grid) + the
+    # redistribution wire bytes they moved, and resume wall time (the
+    # one machine-dependent key — *_runtime_* so CI gates --ignore it)
+    "ft.ckpt_snapshots", "ft.ckpt_snapshot_bytes", "ft.ckpt_kills",
+    "ft.ckpt_lost_steps", "ft.ckpt_resumes", "ft.ckpt_reshards",
+    "ft.ckpt_redistribute_bytes", "ft.ckpt_resume_runtime_s",
+)
 
 
 def _registry():

@@ -117,9 +117,23 @@ def run_smoke(out_dir: str, n: int = 64, nb: int = 8) -> int:
     except FtError as e:
         check("double-fault", bool(e.detections), "FtError carried no detections")
 
+    # (6) trsm: solution-checksum carrier (ISSUE 12 satellite) — a
+    # corrupted already-solved X tile is final data, exactly repaired
+    # from the unit-weight discrepancy of its checksum columns
+    tl = jnp.asarray(np.tril(np.asarray(a)) + n * np.eye(n))
+    brhs = jnp.asarray(generate("randn", n, seed=4)[:, : 2 * nb])
+    f = inject.Fault("trsm", k=nt - 1, phase="trailing", ti=1, tj=0,
+                     r=1 % 2, c=0 % 4, mode=inject.MODE_SCALE, value=3.0)
+    with inject.fault_scope(inject.FaultPlan([f])):
+        x, rep = abft.trsm_ft(tl, brhs, mesh, nb, policy=FtPolicy.Correct)
+    xref = np.linalg.solve(np.asarray(tl), np.asarray(brhs))
+    terr = np.abs(np.asarray(x) - xref).max() / np.abs(xref).max()
+    check("trsm", rep.action == "corrected" and terr < 1e-10,
+          f"action={rep.action} err={terr:.3g}")
+
     # counters + RunReport
     ftv = ft_counter_values()
-    check("counters", ftv["detected"] >= 5 and ftv["corrected"] >= 3
+    check("counters", ftv["detected"] >= 6 and ftv["corrected"] >= 4
           and ftv["recomputed"] >= 1 and ftv["uncorrectable"] >= 1,
           f"ft counters {ftv}")
 
@@ -134,7 +148,7 @@ def run_smoke(out_dir: str, n: int = 64, nb: int = 8) -> int:
         rep_doc = json.load(fh)
     errs = report.validate_report(rep_doc)
     check("report", not errs, f"schema: {errs}")
-    check("report-ft", rep_doc.get("ft", {}).get("detected", 0) >= 5,
+    check("report-ft", rep_doc.get("ft", {}).get("detected", 0) >= 6,
           f"RunReport ft section {rep_doc.get('ft')}")
 
     if failures:
@@ -142,8 +156,9 @@ def run_smoke(out_dir: str, n: int = 64, nb: int = 8) -> int:
         for msg in failures:
             print(f"  FAIL {msg}")
         return 1
-    print(f"ft.smoke: OK — 3 op classes corrected, recompute + FtError "
-          f"escalations verified; counters {ftv}; report {rep_path}")
+    print(f"ft.smoke: OK — 4 op classes corrected (gemm/potrf/LU/trsm), "
+          f"recompute + FtError escalations verified; counters {ftv}; "
+          f"report {rep_path}")
     return 0
 
 

@@ -55,7 +55,13 @@ _LOWER_BETTER = ("second", "time", "byte", "error", "err", "resid", "latency",
                  # under a fixed request stream = cache hygiene or
                  # admission coverage degrading (hits/traces/warmups
                  # stay direction-neutral counts that gate on equality)
-                 "cache_miss", "retrace", "admission_reject")
+                 "cache_miss", "retrace", "admission_reject",
+                 # elastic reliability: steps lost to an unsnapshotted
+                 # window (recovery cost) and FtError retries rising
+                 # under a fixed injection = checkpoint cadence or
+                 # resilience coverage degrading (snapshots/resumes/
+                 # reshards stay direction-neutral activity counts)
+                 "lost_steps", "retries")
 
 # metric-name prefixes that form versioned report SECTIONS: when the new
 # report carries them and the old artifact predates the section entirely

@@ -72,6 +72,16 @@ def _nm(opts: Optional[Options]):
     return get_option(opts, Option.NumMonitor)
 
 
+def _ckpt_every(opts: Optional[Options]):
+    """Resolved Option.Checkpoint snapshot interval (int) or None (off).
+    ``ft.ckpt.resolve_checkpoint`` is the single authority for the
+    explicit > SLATE_TPU_CKPT env > off chain; off keeps the drivers on
+    the fused kernels untouched (trace-identical, zero overhead)."""
+    from ..ft.ckpt import resolve_checkpoint
+
+    return resolve_checkpoint(get_option(opts, Option.Checkpoint, default=None))
+
+
 def _ft_on(opts: Optional[Options]) -> bool:
     """True when Option.FaultTolerance selects an active ABFT policy.
     Off (the default) keeps this module on the plain kernels with zero
@@ -82,6 +92,23 @@ def _ft_on(opts: Optional[Options]) -> bool:
     from ..ft.policy import FtPolicy, resolve_policy
 
     return resolve_policy(opts) != FtPolicy.Off
+
+
+def _resilience(opts: Optional[Options]):
+    """(ft_on, checkpoint_every), each resolved ONCE per driver call.
+    Arming FaultTolerance TOGETHER with Option.Checkpoint is rejected
+    loudly: the ABFT kernels are not checkpointed yet, so the
+    combination would silently drop snapshotting (and never consult
+    kill faults) — fail instead of degrading."""
+    ft_on = _ft_on(opts)
+    every = _ckpt_every(opts)
+    if ft_on and every is not None:
+        raise ValueError(
+            "Option.FaultTolerance and Option.Checkpoint cannot be "
+            "combined (the ABFT kernels are not checkpointed yet); arm "
+            "one of them"
+        )
+    return ft_on, every
 
 
 @instrument("gemm_mesh")
@@ -113,10 +140,18 @@ def potrf_mesh(
     """Distributed lower Cholesky; input is the full/lower Hermitian
     array.  Option.FaultTolerance reroutes to the checksum-carrying
     mesh loop (ft/abft.py)."""
-    if _ft_on(opts):
+    ft_on, every = _resilience(opts)
+    if ft_on:
         from ..ft.abft import potrf_mesh_ft
 
         return potrf_mesh_ft(a, mesh, nb, opts)
+    if every is not None:
+        from ..ft.ckpt import potrf_ckpt
+
+        return potrf_ckpt(
+            from_dense(a, mesh, nb, diag_pad_one=True), every=every,
+            bcast_impl=_bi(opts), panel_impl=_pi(opts), num_monitor=_nm(opts),
+        )
     return potrf_dist(
         from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts),
         bcast_impl=_bi(opts), panel_impl=_pi(opts), num_monitor=_nm(opts),
@@ -169,10 +204,18 @@ def getrf_nopiv_mesh(
 ) -> Tuple[DistMatrix, jax.Array]:
     """Option.FaultTolerance reroutes to the checksum-carrying LU-nopiv
     mesh loop (ft/abft.py)."""
-    if _ft_on(opts):
+    ft_on, every = _resilience(opts)
+    if ft_on:
         from ..ft.abft import getrf_nopiv_mesh_ft
 
         return getrf_nopiv_mesh_ft(a, mesh, nb, opts)
+    if every is not None:
+        from ..ft.ckpt import getrf_nopiv_ckpt
+
+        return getrf_nopiv_ckpt(
+            from_dense(a, mesh, nb, diag_pad_one=True), every=every,
+            bcast_impl=_bi(opts), panel_impl=_pi(opts), num_monitor=_nm(opts),
+        )
     return getrf_nopiv_dist(
         from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts),
         bcast_impl=_bi(opts), panel_impl=_pi(opts), num_monitor=_nm(opts),
@@ -522,6 +565,16 @@ def getrf_mesh(
     """Distributed partial-pivot LU — the reference's default getrf
     (src/getrf.cc:23-200): P A = L U with per-column argmax pivoting.
     Returns (LU, perm over the padded row space, info)."""
+    # no pp ABFT variant exists yet (ft_on is unconsumed), but the
+    # FaultTolerance x Checkpoint conflict must fail loudly here too
+    _ft_on_, every = _resilience(opts)
+    if every is not None:
+        from ..ft.ckpt import getrf_pp_ckpt
+
+        return getrf_pp_ckpt(
+            from_dense(a, mesh, nb, diag_pad_one=True), every=every,
+            bcast_impl=_bi(opts), num_monitor=_nm(opts),
+        )
     return getrf_pp_dist(
         from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts),
         bcast_impl=_bi(opts), num_monitor=_nm(opts),

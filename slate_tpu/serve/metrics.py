@@ -21,6 +21,10 @@ _ZEROS: Dict[str, float] = {
     "batched_solves": 0.0,     # problems solved through batch programs
     "packed_problems": 0.0,    # ragged problems packed block-diagonally
     "admission_rejects": 0.0,  # requests over the HBM admission bound
+    #   (also: preempted-and-unresumable requests rejected instead of
+    #   being served NaNs — the router's graceful-degradation endpoint)
+    "retries": 0.0,            # transient FtError -> one Recompute retry
+    "resumes": 0.0,            # preempted request resumed from checkpoint
     "class_friendly": 0.0,     # condest-keyed cheap-path dispatches
     "class_hostile": 0.0,      # condest-keyed GMRES-IR dispatches
     # executable cache

@@ -202,7 +202,12 @@ class Router:
                 raise SlateError(f"serve: n={n} exceeds the largest bin "
                                  f"{self.bins[-1]}")
             self.admit(op, m)  # the program runs at the PADDED bin size
-            klass = self.classify(op, a) if op == "gesv" else "friendly"
+            # the resilient mesh path has its own dispatch (pp for gesv)
+            # and never consumes the accuracy class — skip the condest
+            # probe instead of paying it for a discarded label
+            klass = (self.classify(op, a)
+                     if op == "gesv" and not self._mesh_resilient(op)
+                     else "friendly")
             bd = b if b.ndim == 2 else b[:, None]
             padded[i] = (pad_to_bin(a, m), pad_rhs_to_bin(bd, m))
             groups.setdefault(
@@ -213,9 +218,12 @@ class Router:
             a_stack = jnp.stack([padded[i][0] for i in idxs])
             b_stack = jnp.stack([padded[i][1] for i in idxs])
             self.admit_batch(op, m, len(idxs), a_stack.dtype.itemsize)
-            prog, _key = self._program(op, klass, (a_stack, b_stack),
-                                       batch=len(idxs))
-            xs, info = prog(a_stack, b_stack)
+            if self._mesh_resilient(op):
+                xs, info = self._solve_group_mesh(op, a_stack, b_stack)
+            else:
+                prog, _key = self._program(op, klass, (a_stack, b_stack),
+                                           batch=len(idxs))
+                xs, info = prog(a_stack, b_stack)
             serve_count("batches")
             serve_count("batched_solves", len(idxs))
             bad = [idxs[j] for j, v in enumerate(np.asarray(info)) if v != 0]
@@ -234,6 +242,180 @@ class Router:
     def solve(self, op: str, a: jax.Array, b: jax.Array) -> jax.Array:
         """One request through the full policy (a batch of one)."""
         return self.solve_batch([(op, a, b)])[0]
+
+    # -- graceful degradation (ISSUE 12 satellite) -------------------------
+    #
+    # When the router is armed with a resilience policy
+    # (Option.FaultTolerance and/or Option.Checkpoint in its opts) and a
+    # mesh, requests dispatch through the protected mesh drivers instead
+    # of the stacked single-chip programs, and the router absorbs their
+    # failure modes instead of surfacing them raw:
+    #
+    # - a transient FtError retries ONCE under FtPolicy.Recompute
+    #   (``serve.retries``) before surfacing — a one-shot SDC costs one
+    #   recompute, not a failed request;
+    # - a Preempted factorization resumes from its checkpoint on the
+    #   router's mesh (``serve.resumes``);
+    # - a preempted-and-UNRESUMABLE request (killed before the first
+    #   snapshot, or re-killed on resume) is admission-REJECTED
+    #   (``serve.admission_rejects``) with a structured error — never
+    #   served NaNs.
+
+    def _ckpt_every(self):
+        from ..ft.ckpt import resolve_checkpoint
+        from ..types import Option, get_option
+
+        # get_option, not dict.get: Options accepts string keys too
+        return resolve_checkpoint(
+            get_option(self.opts, Option.Checkpoint, default=None))
+
+    def _mesh_resilient(self, op: str) -> bool:
+        if self.mesh is None or op not in ("posv", "gesv"):
+            return False
+        from ..ft.policy import FtPolicy, resolve_policy
+
+        return (resolve_policy(self.opts) != FtPolicy.Off
+                or self._ckpt_every() is not None)
+
+    def _solve_group_mesh(self, op: str, a_stack, b_stack):
+        xs, infos = [], []
+        for i in range(a_stack.shape[0]):
+            x, info = self._solve_one_mesh(op, a_stack[i], b_stack[i])
+            xs.append(x)
+            infos.append(jnp.asarray(info, jnp.int32))
+        return jnp.stack(xs), jnp.stack(infos)
+
+    def _solve_one_mesh(self, op: str, a, b):
+        from ..ft import ckpt as _ckpt
+        from ..ft.policy import FtError, FtPolicy, resolve_policy
+
+        pol = resolve_policy(self.opts)
+        try:
+            return self._guard(op, a, b, *self._factor_solve_mesh(
+                op, a, b, pol))
+        except _ckpt.Preempted as e:
+            if e.checkpoint is None:
+                serve_count("admission_rejects")
+                raise SlateError(
+                    f"serve: {op} request preempted at step {e.killed_at} "
+                    "before its first checkpoint — rejected (unresumable), "
+                    "not served NaNs") from e
+            serve_count("resumes")
+            try:
+                return self._guard(op, a, b, *self._resume_solve(
+                    op, b, e.checkpoint))
+            except _ckpt.Preempted as e2:
+                serve_count("admission_rejects")
+                raise SlateError(
+                    f"serve: {op} request re-preempted on resume at step "
+                    f"{e2.killed_at} — rejected") from e2
+        except FtError:
+            # transient-SDC class: one retry under the recompute policy;
+            # a second FtError (persistent corruption) surfaces raw
+            serve_count("retries")
+            return self._guard(op, a, b, *self._factor_solve_mesh(
+                op, a, b, FtPolicy.Recompute))
+
+    def _guard(self, op: str, a, b, x, info):
+        """The resilient mesh path bypasses the batched drivers'
+        condest-keyed accuracy ladder (the ABFT LU is no-pivot), so no
+        solution leaves unvalidated: one residual check rejects a
+        silently-inaccurate solve instead of serving it."""
+        if int(info) != 0:
+            return x, info  # caller surfaces nonzero info itself
+        n = a.shape[0]
+        eps = float(jnp.finfo(a.dtype).eps)
+        scale = float(jnp.max(jnp.abs(a))) * max(
+            float(jnp.max(jnp.abs(x))), 1.0) * n
+        resid = float(jnp.max(jnp.abs(a @ x - b)))
+        if not np.isfinite(resid) or resid > 1e6 * n * eps * max(scale, 1.0):
+            serve_count("admission_rejects")
+            raise SlateError(
+                f"serve: {op} resilient-path solution failed the residual "
+                f"gate (|Ax-b| max {resid:.3g}) — rejected, not served")
+        return x, info
+
+    def _resil_opts(self):
+        """Raw schedule/monitor options the resilient mesh path forwards
+        (the drivers' _la/_bi/_pi/_nm idiom — armed options must thread
+        end-to-end, not silently drop to defaults)."""
+        from ..types import Option, get_option
+
+        return (get_option(self.opts, Option.Lookahead),
+                get_option(self.opts, Option.BcastImpl),
+                get_option(self.opts, Option.PanelImpl),
+                get_option(self.opts, Option.NumMonitor))
+
+    def _factor_solve_mesh(self, op: str, a, b, pol):
+        from ..ft.ckpt import getrf_pp_ckpt, potrf_ckpt
+        from ..ft.policy import FtPolicy
+        from ..parallel.dist import from_dense
+
+        every = self._ckpt_every()
+        la, bi, pi, nm = self._resil_opts()
+        if pol != FtPolicy.Off:
+            if every is not None:
+                raise SlateError(
+                    "serve: Option.FaultTolerance and Option.Checkpoint "
+                    "cannot be combined (the ABFT kernels are not "
+                    "checkpointed yet); arm one of them")
+            from ..ft import abft
+
+            if op == "posv":
+                l, info, _rep = abft.potrf_ft(
+                    a, self.mesh, self.nb, policy=pol, lookahead=la,
+                    bcast_impl=bi, panel_impl=pi)
+            else:
+                # the only ABFT LU is no-pivot — _guard validates the
+                # solution it produces
+                l, info, _rep = abft.getrf_nopiv_ft(
+                    a, self.mesh, self.nb, policy=pol, lookahead=la,
+                    bcast_impl=bi, panel_impl=pi)
+            return self._trsm_solve(op, l, b), info
+        d = from_dense(a, self.mesh, self.nb, diag_pad_one=True)
+        if op == "posv":
+            l, info = potrf_ckpt(d, every=every, bcast_impl=bi,
+                                 panel_impl=pi, num_monitor=nm)
+            return self._trsm_solve(op, l, b), info
+        # gesv keeps partial pivoting on the checkpointed path (the
+        # reference's default getrf — no accuracy class downgrade)
+        lu, perm, info = getrf_pp_ckpt(d, every=every, bcast_impl=bi,
+                                       num_monitor=nm)
+        return self._trsm_solve(op, lu, b, perm=perm), info
+
+    def _resume_solve(self, op: str, b, checkpoint):
+        from ..ft import elastic
+
+        _la, bi, pi, _nm = self._resil_opts()
+        out = elastic.resume(checkpoint, self.mesh, bcast_impl=bi,
+                             panel_impl=pi)
+        if len(out) == 3:  # getrf_pp: (LU, perm, info)
+            lu, perm, info = out
+            return self._trsm_solve(op, lu, b, perm=perm), info
+        l, info = out
+        return self._trsm_solve(op, l, b), info
+
+    def _trsm_solve(self, op: str, l, b, perm=None):
+        from ..parallel.dist import from_dense, to_dense
+        from ..parallel.dist_lu import permute_rows_dist
+        from ..parallel.dist_trsm import trsm_dist
+        from ..types import Diag, Op, Uplo
+
+        la, bi, _pi, _nm = self._resil_opts()
+        bd = from_dense(b, self.mesh, self.nb)
+        if perm is not None:
+            bd = permute_rows_dist(bd, perm)
+        if op == "posv":
+            y = trsm_dist(l, bd, Uplo.Lower, Op.NoTrans, lookahead=la,
+                          bcast_impl=bi)
+            x = trsm_dist(l, y, Uplo.Lower, Op.ConjTrans, lookahead=la,
+                          bcast_impl=bi)
+        else:
+            y = trsm_dist(l, bd, Uplo.Lower, Op.NoTrans, Diag.Unit,
+                          lookahead=la, bcast_impl=bi)
+            x = trsm_dist(l, y, Uplo.Upper, Op.NoTrans, lookahead=la,
+                          bcast_impl=bi)
+        return to_dense(x)[: b.shape[0]]
 
 
 def _build_batched(op: str, variant: str):

@@ -294,6 +294,19 @@ class Option(enum.Enum):
     # own measurements).  Only the serve dispatch path consults this —
     # direct driver calls never read the table.
     AutoTune = "auto_tune"
+    # Checkpoint interval for the mesh factorization k-loops (ft/ckpt.py):
+    # an int K snapshots the k-loop carry (factored panels + trailing
+    # block + NumMonitor gauges + pivot permutation) to host every K
+    # steps, so a preempted multi-minute factorization resumes from the
+    # last snapshot — on the SAME mesh bitwise-identically, or on a
+    # RESHAPED p' x q' mesh via block-cyclic redistribution
+    # (ft/elastic.py) — instead of restarting from zero.  Off / absent /
+    # 0 (the default) routes to the plain fused kernels untouched:
+    # trace-identical, zero overhead.  Resolution order: explicit option
+    # > SLATE_TPU_CKPT environment > off.  No reference analogue: SLATE
+    # delegates preemption survival to the MPI checkpoint layer; under
+    # XLA/SPMD the natural snapshot unit is the k-loop carry itself.
+    Checkpoint = "checkpoint"
     # Residual lowering for the mixed-precision refinement loop: "f64"
     # (plain SUMMA at the data dtype — XLA's emulated-f64 pairs on TPU),
     # "ozaki" (the int8 split-integer SUMMA: digit planes of A and X ride

@@ -1,0 +1,209 @@
+"""Checkpointed k-loops + elastic resume (ISSUE 12).
+
+Acceptance surface, kept LEAN (one shared n=64/nb=8 shape set, segment
+jits reused across tests via the process jit cache, no clear_caches):
+kill at step k → resume on the SAME mesh is bitwise-identical to the
+uninterrupted factorization for potrf, LU-nopiv, and partial-pivot LU;
+resume on a RESHAPED mesh lands the bitwise-same solution; checkpoint
+off is jaxpr-identical to the current driver path; the kill injector is
+seeded-deterministic and one-shot; recovery-cost counters reach the
+RunReport ft section.  The multi-op reshaped sweep is ``-m slow``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from slate_tpu.ft import ckpt, elastic, inject
+from slate_tpu.ft.policy import ft_counter_values
+from slate_tpu.parallel import from_dense, make_mesh, to_dense
+from slate_tpu.parallel.dist_chol import potrf_dist
+from slate_tpu.parallel.dist_lu import getrf_nopiv_dist, getrf_pp_dist
+from slate_tpu.types import Option
+
+from conftest import cpu_devices
+
+N, NB = 64, 8
+NT = N // NB
+EVERY = 3  # segment boundaries 3, 6 — kill at 4 loses exactly 1 step
+
+
+def mesh24():
+    return make_mesh(2, 4, devices=cpu_devices(8))
+
+
+def mesh42():
+    return make_mesh(4, 2, devices=cpu_devices(8))
+
+
+def _operand(kind, seed=7):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((N, N))
+    if kind == "spd":
+        a = a @ a.T / N + 2 * np.eye(N)
+    elif kind == "dom":
+        a = np.tril(a) + N * np.eye(N) + np.triu(
+            rng.standard_normal((N, N)), 1)
+    return jnp.asarray(a)
+
+
+_CASES = {
+    "potrf": ("spd", potrf_dist, ckpt.potrf_ckpt),
+    "getrf_nopiv": ("dom", getrf_nopiv_dist, ckpt.getrf_nopiv_ckpt),
+    "getrf_pp": ("general", getrf_pp_dist, ckpt.getrf_pp_ckpt),
+}
+
+
+def _run_case(op, mesh):
+    kind, plain, ckpted = _CASES[op]
+    d = from_dense(_operand(kind), mesh, NB, diag_pad_one=True)
+    return d, plain(d), ckpted
+
+
+def _assert_tree_bitwise(ref, got, what):
+    for r, g in zip(jax.tree.leaves(ref), jax.tree.leaves(got)):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g),
+                                      err_msg=what)
+
+
+@pytest.mark.parametrize("op", list(_CASES))
+def test_kill_resume_bitwise_same_mesh(op):
+    mesh = mesh24()
+    d, ref, ckpted = _run_case(op, mesh)
+    # uninterrupted checkpointed chain == fused kernel, bitwise
+    _assert_tree_bitwise(ref, ckpted(d, every=EVERY), f"{op} ckpt vs fused")
+    # seeded kill inside the second segment -> Preempted with the step-3
+    # snapshot; resume must reproduce the fused result bitwise
+    with inject.fault_scope(inject.FaultPlan([inject.KillFault(op, 4)])):
+        with pytest.raises(ckpt.Preempted) as ei:
+            ckpted(d, every=EVERY)
+    ck = ei.value.checkpoint
+    assert ck is not None and ck.step == 3 and ck.op == op
+    _assert_tree_bitwise(ref, elastic.resume(ck, mesh), f"{op} resume")
+
+
+def test_resume_reshaped_mesh_potrf():
+    mesh = mesh24()
+    d, ref, ckpted = _run_case("potrf", mesh)
+    with inject.fault_scope(
+        inject.FaultPlan([inject.KillFault("potrf", 4)])
+    ), pytest.raises(ckpt.Preempted) as ei:
+        ckpted(d, every=EVERY)
+    res, info = elastic.resume(ei.value.checkpoint, mesh42())
+    # the redistribution moves exact bytes: the solution is bitwise
+    np.testing.assert_array_equal(
+        np.asarray(to_dense(ref[0])), np.asarray(to_dense(res)))
+    assert int(info) == int(ref[1])
+    assert ft_counter_values()["ckpt_reshards"] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("op", ["getrf_nopiv", "getrf_pp"])
+def test_resume_reshaped_mesh_lu(op):
+    mesh = mesh24()
+    d, ref, ckpted = _run_case(op, mesh)
+    with inject.fault_scope(
+        inject.FaultPlan([inject.KillFault(op, 5)])
+    ), pytest.raises(ckpt.Preempted) as ei:
+        ckpted(d, every=EVERY)
+    res = elastic.resume(ei.value.checkpoint, mesh42())
+    np.testing.assert_array_equal(
+        np.asarray(to_dense(ref[0])), np.asarray(to_dense(res[0])))
+    if op == "getrf_pp":
+        # pivot choices are data-driven: the permutation's data prefix
+        # must survive the re-based padded row space exactly
+        np.testing.assert_array_equal(
+            np.asarray(ref[1])[:N], np.asarray(res[1])[:N])
+
+
+def test_checkpoint_disk_roundtrip(tmp_path):
+    mesh = mesh24()
+    d, ref, ckpted = _run_case("potrf", mesh)
+    with inject.fault_scope(
+        inject.FaultPlan([inject.KillFault("potrf", 4)])
+    ), pytest.raises(ckpt.Preempted) as ei:
+        ckpted(d, every=EVERY)
+    ck = ei.value.checkpoint
+    ck2 = ckpt.Checkpoint.load(ck.save(str(tmp_path / "ck.npz")))
+    assert (ck2.op, ck2.step, ck2.every, ck2.grid) == (
+        ck.op, ck.step, ck.every, ck.grid)
+    np.testing.assert_array_equal(ck.tiles, ck2.tiles)
+    _assert_tree_bitwise(ref, elastic.resume(ck2, mesh), "disk resume")
+
+
+def test_ckpt_off_is_driver_jaxpr_identical():
+    """Option.Checkpoint off/absent routes potrf_mesh through the exact
+    pre-checkpoint path — same jaxpr, not merely same numbers."""
+    from slate_tpu.parallel import potrf_mesh
+
+    mesh = mesh24()
+    a = _operand("spd")
+
+    def jx(opts):
+        return str(jax.make_jaxpr(
+            lambda x: potrf_mesh(x, mesh, NB, opts))(a))
+
+    base = jx(None)
+    assert jx({Option.Checkpoint: "off"}) == base
+    assert jx({Option.Checkpoint: 0}) == base
+
+
+def test_kill_injector_deterministic_and_one_shot():
+    k1 = inject.seeded_kill(5, "potrf", NT)
+    k2 = inject.seeded_kill(5, "potrf", NT)
+    assert (k1.op, k1.k) == (k2.op, k2.k) and 1 <= k1.k < NT
+    plan = inject.FaultPlan([inject.KillFault("potrf", 4)])
+    with inject.fault_scope(plan):
+        (kf,) = inject.armed_kills("potrf")
+        plan.consume_fault(kf)
+        assert inject.armed_kills("potrf") == []  # one-shot: resume clean
+    persist = inject.FaultPlan([inject.KillFault("potrf", 4, persist=True)])
+    with inject.fault_scope(persist):
+        (kf,) = inject.armed_kills("potrf")
+        persist.consume_fault(kf)
+        assert len(inject.armed_kills("potrf")) == 1  # re-kills on resume
+    # kills never leak into the kernel fault spec
+    with inject.fault_scope(plan):
+        ints, _ = inject.spec_arrays("potrf")
+        assert not ints[:, 0].any()
+
+
+def test_ckpt_counters_reach_runreport():
+    from slate_tpu.obs import report
+
+    mesh = mesh24()
+    d, _ref, ckpted = _run_case("potrf", mesh)
+    before = ft_counter_values()
+    with inject.fault_scope(
+        inject.FaultPlan([inject.KillFault("potrf", 4)])
+    ), pytest.raises(ckpt.Preempted) as ei:
+        ckpted(d, every=EVERY)
+    elastic.resume(ei.value.checkpoint, mesh)
+    after = ft_counter_values()
+    assert after["ckpt_kills"] == before["ckpt_kills"] + 1
+    assert after["ckpt_lost_steps"] == before["ckpt_lost_steps"] + 1
+    assert after["ckpt_resumes"] == before["ckpt_resumes"] + 1
+    assert after["ckpt_snapshots"] > before["ckpt_snapshots"]
+    assert after["ckpt_snapshot_bytes"] > before["ckpt_snapshot_bytes"]
+    rep = report.make_report("ckpt_counters_probe")
+    assert rep["ft"]["ckpt_resumes"] >= after["ckpt_resumes"]
+    assert report.validate_report(rep) == []
+
+
+def test_ckpt_num_monitor_gauges_match_fused():
+    """The NumMonitor gauges ride the segment carry: a checkpointed run
+    records the same growth/margin values as the fused kernel."""
+    from slate_tpu.obs import numerics as num
+
+    mesh = mesh24()
+    d = from_dense(_operand("spd"), mesh, NB, diag_pad_one=True)
+    num.clear_last("potrf")
+    potrf_dist(d, num_monitor="on")
+    fused = num.last_gauges("potrf")
+    num.clear_last("potrf")
+    ckpt.potrf_ckpt(d, every=EVERY, num_monitor="on")
+    segd = num.last_gauges("potrf")
+    assert fused and segd
+    for key in fused:
+        assert segd[key] == fused[key], (key, fused, segd)

@@ -436,3 +436,38 @@ def test_ppermute_a_records_link_bytes():
     with comm_audit() as recs:
         jax.make_jaxpr(jax.vmap(fn, axis_name="i"))(jnp.zeros((2, 4)))
     assert recs == [("ppermute[i]", 2 * 4 * 8, 5)]  # 2 pairs x 4 f64 lanes
+
+
+def test_redistribute_shardmap_wire_volume():
+    """ISSUE 12: the shardmap redistribution's audited ppermute link
+    bytes equal the analytic ``redistribute_wire_bytes`` formula — the
+    ring schedule moves each device's source block through p*(q-1)
+    column rotations (q link pairs each) and p-1 row rotations (p
+    pairs), at one source-block payload per hop."""
+    from conftest import cpu_devices
+    from slate_tpu.parallel import dist, from_dense
+    from slate_tpu.parallel.mesh import make_mesh
+
+    p, q = 2, 4
+    mesh = make_mesh(p, q, devices=cpu_devices(8))
+    mesh2 = make_mesh(4, 2, devices=cpu_devices(8))
+    d = from_dense(jnp.zeros((96, 96)), mesh, 8)
+    cmap = dist._shardmap_coord_map(mesh, mesh2)
+    mt2 = dist.padded_tiles(d.m, d.nb, mesh2)
+    nt2 = dist.padded_tiles(d.n, d.nb, mesh2)
+    dims = (4, 2, d.tiles.shape[0], d.tiles.shape[1], mt2, nt2, d.nb)
+    with comm_audit() as recs:
+        jax.make_jaxpr(lambda t: dist._redist_shardmap_fn(
+            t, mesh, p, q, dims, cmap, False))(d.tiles)
+    got = sum(nb_ * m for op, nb_, m in recs if op.startswith("ppermute"))
+    want = dist.redistribute_wire_bytes(
+        d.tiles.shape, p, q, d.tiles.dtype.itemsize)
+    # per-device block = (12/2)*(12/4) = 18 tiles of 8x8 f64 = 9216 B;
+    # hops: 2*(4-1) col rotations x 4 pairs + 1 row rotation x 2 pairs
+    assert want == 9216 * (2 * 3 * 4 + 1 * 2)
+    assert got == want
+    # memory contract: the exchange holds ONE circulating source block +
+    # ONE destination block per device — 1/(p q)-class residency, not
+    # the eager path's full replicated grid
+    n_pp = sum(1 for op, _, _ in recs if op.startswith("ppermute"))
+    assert n_pp == p * q - 1

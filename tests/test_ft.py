@@ -337,3 +337,46 @@ def test_non_spd_keeps_info_semantics(rng):
     # and under detect too: breakdown is not a detection
     l, info, rep = abft.potrf_ft(bad, mesh, 8, policy=FtPolicy.Detect)
     assert int(info) != 0
+
+
+# ---------------------------------------------------------------------------
+# (h) trsm ABFT (ISSUE 12 satellite): the solution-checksum carrier
+# ---------------------------------------------------------------------------
+
+
+def test_trsm_abft_detect_correct_recompute(rng):
+    """The checksum columns ride the RHS through the unchanged TrsmB
+    schedule: clean runs are quiet; a corrupted ALREADY-SOLVED X tile
+    (final data) repairs exactly from the unit discrepancy; a corrupted
+    not-yet-solved tile propagates and escalates to one recompute; the
+    detect policy fail-stops."""
+    mesh = mesh24()
+    tl = jnp.asarray(np.tril(np.asarray(_rand(rng, N, N))) + N * np.eye(N))
+    b = _rand(rng, N, 2 * NB)
+    ref = np.linalg.solve(np.asarray(tl), np.asarray(b))
+
+    def err(x):
+        return np.abs(np.asarray(x) - ref).max() / np.abs(ref).max()
+
+    x, rep = abft.trsm_ft(tl, b, mesh, NB, policy=FtPolicy.Correct)
+    assert rep.clean and err(x) < 1e-10
+
+    final = Fault("trsm", k=NT - 1, phase="trailing", ti=1, tj=0,
+                  r=1 % GRID[0], c=0, mode=inject.MODE_SCALE, value=3.0)
+    with fault_scope(FaultPlan([final])):
+        x2, rep2 = abft.trsm_ft(tl, b, mesh, NB, policy=FtPolicy.Correct)
+    assert rep2.action == "corrected" and err(x2) < 1e-10
+
+    live = Fault("trsm", k=1, phase="trailing", ti=5, tj=1,
+                 r=5 % GRID[0], c=1 % GRID[1], mode=inject.MODE_SCALE,
+                 value=3.0)
+    with fault_scope(FaultPlan([live])):
+        x3, rep3 = abft.trsm_ft(tl, b, mesh, NB, policy=FtPolicy.Correct)
+    assert rep3.action == "recomputed" and err(x3) < 1e-10
+
+    with fault_scope(FaultPlan([Fault(
+        "trsm", k=NT - 1, phase="trailing", ti=2, tj=0, r=0, c=0,
+        mode=inject.MODE_SCALE, value=2.0,
+    )])):
+        with pytest.raises(FtError):
+            abft.trsm_ft(tl, b, mesh, NB, policy=FtPolicy.Detect)

@@ -381,3 +381,74 @@ def test_serve_report_section():
     old["serve_cache_misses"] = old.get("serve_cache_misses", 0) + 1
     failures, _ = report.check_regression(new, old, threshold=1.5)
     assert any("serve_cache_misses" in f for f in failures)
+
+# ---------------------------------------------------------------------------
+# graceful degradation (ISSUE 12 satellite): retry / resume / reject
+# ---------------------------------------------------------------------------
+
+
+def _resilient_router(opts):
+    from slate_tpu.serve.router import Router
+
+    return Router(mesh=mesh24(), nb=8, bins=(64,), opts=opts)
+
+
+def _spd_one(rng, n=64):
+    g = rng.standard_normal((n, n))
+    return jnp.asarray(g @ g.T / n + 2 * np.eye(n))
+
+
+def test_router_retries_transient_fterror(rng):
+    """A transient SDC under a fail-stop FT policy costs ONE Recompute
+    retry (serve.retries), not a failed request."""
+    from slate_tpu.ft import FtPolicy, inject
+
+    router = _resilient_router({Option.FaultTolerance: FtPolicy.Detect})
+    a = _spd_one(rng)
+    b = jnp.asarray(rng.standard_normal((64, 2)))
+    before = serve_metrics.serve_counter_values()["retries"]
+    f = inject.seeded_fault(12, "potrf", 8, (2, 4), phase="panel")
+    with inject.fault_scope(inject.FaultPlan([f])):
+        x = router.solve("posv", a, b)
+    after = serve_metrics.serve_counter_values()["retries"]
+    assert after == before + 1
+    resid = np.abs(np.asarray(a) @ np.asarray(x) - np.asarray(b)).max()
+    assert resid < 1e-8
+
+
+def test_router_resumes_preempted_request(rng):
+    """A preempted checkpointed factorization resumes from its snapshot
+    (serve.resumes) and the request completes."""
+    from slate_tpu.ft import inject
+
+    router = _resilient_router({Option.Checkpoint: 3})
+    a = _spd_one(rng)
+    b = jnp.asarray(rng.standard_normal((64, 2)))
+    before = serve_metrics.serve_counter_values()["resumes"]
+    with inject.fault_scope(inject.FaultPlan([inject.KillFault("potrf", 4)])):
+        x = router.solve("posv", a, b)
+    after = serve_metrics.serve_counter_values()["resumes"]
+    assert after == before + 1
+    resid = np.abs(np.asarray(a) @ np.asarray(x) - np.asarray(b)).max()
+    assert resid < 1e-8
+
+
+def test_router_rejects_unresumable_preemption(rng):
+    """A kill BEFORE the first snapshot (and a re-kill on resume) is
+    admission-rejected with a structured error — never served NaNs."""
+    from slate_tpu.ft import inject
+
+    router = _resilient_router({Option.Checkpoint: 3})
+    a = _spd_one(rng)
+    b = jnp.asarray(rng.standard_normal((64, 2)))
+    before = serve_metrics.serve_counter_values()["admission_rejects"]
+    with inject.fault_scope(inject.FaultPlan([inject.KillFault("potrf", 1)])):
+        with pytest.raises(SlateError, match="unresumable"):
+            router.solve("posv", a, b)
+    with inject.fault_scope(inject.FaultPlan(
+        [inject.KillFault("potrf", 4, persist=True)]
+    )):
+        with pytest.raises(SlateError, match="re-preempted"):
+            router.solve("posv", a, b)
+    after = serve_metrics.serve_counter_values()["admission_rejects"]
+    assert after == before + 2
