@@ -137,30 +137,37 @@ python tools/scaling_report.py --out artifacts/obs_flight/scaling.report.json
 python -m slate_tpu.obs.report artifacts/obs_flight/scaling.report.json > /dev/null
 
 # ft smoke: the ABFT acceptance run — one injected single-tile fault per
-# op class (SUMMA gemm / mesh potrf / LU-nopiv / trsm) must be detected
+# op class (SUMMA gemm / mesh potrf / LU-nopiv / trsm / her2k) must be detected
 # and corrected on the 8-device mesh, the recompute + FtError escalations
 # must fire, and the ft.* counters must land in a schema-valid RunReport
 # so detection-coverage regressions gate like perf (slate_tpu/ft/smoke.py)
 python -m slate_tpu.ft.smoke --out artifacts/ft
 
-# checkpoint/restart smoke (ISSUE 12): the elastic-reliability
+# checkpoint/restart smoke (ISSUE 12 + 13): the elastic-reliability
 # acceptance run — seeded kill -> resume on the SAME mesh must be
 # BITWISE-identical to the uninterrupted factorization for potrf,
-# LU-nopiv, and partial-pivot LU; kill -> resume on a RESHAPED 4x2 mesh
-# must land the bitwise-same solution through the shard_map block-cyclic
-# redistribution (itself asserted bitwise vs the eager path); snapshots
-# survive a disk round trip; and the ft.ckpt_* recovery-cost counters
+# LU-nopiv, partial-pivot LU, the distributed CAQR, and the two-stage
+# eig stage-1 reduction (the last two over MULTI-ARRAY carries);
+# kill -> resume on a RESHAPED 4x2 mesh must land the bitwise-same
+# solution for the tile-stack ops through the shard_map block-cyclic
+# redistribution (itself asserted bitwise vs the eager path) while the
+# grid-locked multi-array carries REFUSE the reshaped grid with a
+# structured error; snapshots survive a disk round trip; an in-segment
+# kill loses exactly the steps since the last snapshot; async snapshots
+# are bitwise-equal to sync; and the ft.ckpt_* recovery-cost counters
 # land in a schema-valid RunReport.  The ring re-run proves the segment
-# chain threads Option.BcastImpl end-to-end; the fresh report gates
+# chains thread Option.BcastImpl end-to-end; the fresh report gates
 # against the committed reference on the deterministic keys (snapshot /
 # redistribute bytes, lost steps, bitwise-diff zeros) — resume wall time
-# is machine-dependent and carries the *_runtime_* infix.
+# and the async-copy overlap are machine-dependent and carry the
+# *_runtime_* / *_overlap_s infixes.
 python -m slate_tpu.ft.ckpt_smoke --out artifacts/ft_ckpt
 SLATE_TPU_BCAST_IMPL=ring python -m slate_tpu.ft.ckpt_smoke \
     --out artifacts/ft_ckpt_ring
 python -m slate_tpu.obs.report --check \
     artifacts/ft_ckpt/ft_ckpt.report.json \
-    artifacts/obs/ft_ckpt.report.json --ignore '*_runtime_*'
+    artifacts/obs/ft_ckpt.report.json --ignore '*_runtime_*' \
+    --ignore '*_overlap_s'
 
 # broadcast-engine cross-impl pass (ISSUE 5): re-run both smokes under the
 # explicit ring lowering so the non-default Option.BcastImpl path is

@@ -1120,6 +1120,83 @@ def _getrf_pp_ckpt_seg(ctx):
         False)), (a.tiles, perm)
 
 
+@register("geqrf_ckpt_seg", tags=("ckpt",))
+def _geqrf_ckpt_seg(ctx):
+    """One interior checkpoint segment of the distributed CAQR (steps
+    [1, nt) over the MULTI-ARRAY carry: tile stack + T_loc stack + tree
+    V/T stacks — ISSUE 13).  Carry shapes come from ckpt._multi_init,
+    the one authority the drivers themselves use."""
+    from ..ft import ckpt
+
+    a = ctx.dist()
+    st = {}
+    ckpt._multi_init("geqrf", a, st, a.nt)
+    return (lambda t, x, y, z: ckpt._qr_seg_jit(
+        t, x, y, z, ctx.mesh, ctx.p, ctx.q, N, 1, a.nt, "auto")), \
+        (a.tiles, st["tls"], st["tvs"], st["tts"])
+
+
+@register("he2hb_ckpt_seg", tags=("ckpt",))
+def _he2hb_ckpt_seg(ctx):
+    """One interior checkpoint segment of the two-stage eig stage-1
+    reduction (he2hb) over its multi-array carry (ISSUE 13)."""
+    from ..ft import ckpt
+    from ..linalg.eig import _he2hb_panel_count
+
+    a = ctx.dist(kind="spd")
+    nsteps = _he2hb_panel_count(a.n, a.nb)
+    st = {}
+    ckpt._multi_init("he2hb", a, st, nsteps)
+    return (lambda t, v, s: ckpt._he2hb_seg_jit(
+        t, v, s, ctx.mesh, ctx.p, ctx.q, a.n, a.nb, 1, max(nsteps, 2),
+        "auto")), (a.tiles, st["vqs"], st["tqs"])
+
+
+def _ft_her2k_build(ctx, armed):
+    """The checksum-carrying her2k under the gate: encode -> augmented
+    rank-2k kernel (the shared dist_blas3 panel schedule) -> checksum
+    residual — disarmed and armed fault specs, like the gemm pair."""
+    import jax.numpy as jnp
+
+    from ..ft import abft, inject
+    from ..parallel.comm import resolve_bcast_impl
+    from ..parallel.dist import DistMatrix, from_dense, to_dense
+
+    a, b = ctx.dense(), ctx.dense()
+    ints, vals = inject.spec_arrays("her2k")
+    if armed:
+        ints[0] = (1, N // NB - 1, 3, 3, 1, 3 % GRID[0], 1 % GRID[1], 2)
+        vals[0] = 3.0
+    fi, fv = jnp.asarray(ints), jnp.asarray(vals)
+
+    def fn(x, y):
+        a_aug, b_aug, _c, mt, kt = abft._encode_her2k(x, y, None, NB,
+                                                      ctx.mesh)
+        ad = from_dense(a_aug, ctx.mesh, NB)
+        bd = from_dense(b_aug, ctx.mesh, NB)
+        out = abft._ft_her2k_jit(
+            ad.tiles, bd.tiles, None, 1.0, 0.0, ctx.mesh, ctx.p, ctx.q,
+            kt, N, True, 1, resolve_bcast_impl(), fi, fv,
+        )
+        dense = to_dense(DistMatrix(
+            tiles=out, m=a_aug.shape[0], n=a_aug.shape[0], nb=NB,
+            mesh=ctx.mesh,
+        ))
+        return abft._gemm_residual(dense, NB, mt, mt)
+
+    return fn, (a, b)
+
+
+@register("her2k_abft_detect", tags=("ft",))
+def _ft_her2k_detect(ctx):
+    return _ft_her2k_build(ctx, armed=False)
+
+
+@register("her2k_abft_correct", tags=("ft",))
+def _ft_her2k_correct(ctx):
+    return _ft_her2k_build(ctx, armed=True)
+
+
 def _ft_trsm_build(ctx, armed):
     import jax.numpy as jnp
 

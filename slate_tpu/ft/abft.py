@@ -656,6 +656,190 @@ def trsm_ft(
     return out2, rep2
 
 
+# ---------------------------------------------------------------------------
+# checksum-carrying her2k/syr2k (ISSUE 13: the eig chain's dominant
+# trailing-update op).  Augmenting BOTH rank-2k operands with checksum
+# tile ROWS makes the product carry checksums on BOTH sides for free:
+#
+#   [A; WA][B; WB]^H + [B; WB][A; WA]^H
+#     = [ C       C W^H ]      with C = A B^H + B A^H,
+#       [ W C   W C W^H ]
+#
+# i.e. the augmented her2k of the augmented operands IS the her2k of the
+# data block wearing its own row (WC) and column (C W^H) checksums plus
+# the cross block — the exact structure _gemm_verify/_gemm_try_repair
+# already judge and repair.  The kernel is dist_blas3's her2k SUMMA
+# schedule verbatim (the shared ``_her2k_panels`` fetch — two rooted
+# column-panel broadcasts + two transposed gathers per step; checksum
+# tiles are just more tiles of the augmented grid), computed FULL so the
+# mirrored checksum columns materialize.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
+def _ft_her2k_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, k_true, conj,
+                  la, bi, fi, fv):
+    """Checksum-carrying her2k/syr2k over row-augmented operands (the
+    checksum tile rows need no in-kernel special-casing: they are
+    ordinary tiles of the full rank-2k accumulation).  Fault hooks:
+    ``bcast`` corrupts one device's RECEIVED copy
+    of A's column panel before its updates consume it (propagates into
+    one tile row of that device's accumulator — the single-row repair
+    class), ``trailing`` one accumulator tile right after step k's
+    update lands (final data for the rank-2k accumulation — exactly
+    correctable, the GEMM class)."""
+    from ..parallel.dist_blas3 import _her2k_panels
+
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(a_loc, b_loc, fi, fv):
+        mtl, _ktl, nb, _ = a_loc.shape
+        dtype = a_loc.dtype
+        r, c, i_log, _ = local_indices(p, q, mtl, mtl)
+        slots = _slots(fi, fv)
+
+        def fetch(k):
+            acol, aT = _her2k_panels(a_loc, k, p, q, k_true, conj)
+            bcol, bT = _her2k_panels(b_loc, k, p, q, k_true, conj)
+            # bcast-phase fault: one device's RECEIVED copy of the A
+            # column panel rots before its MXU updates consume it
+            for act, fk, fph, fti, ftj, fr, fc, fmode, val in slots:
+                hit = (
+                    (act == 1) & (fph == PH_BCAST) & (k == fk)
+                    & (r == fr) & (c == fc)
+                )
+                acol = _hit3(acol, hit & (r == fti % p), fti // p, fmode, val)
+            return (acol, aT), (bcol, bT)
+
+        def consume(k, prefetched, acc):
+            (acol, aT), (bcol, bT) = prefetched
+            u1 = jnp.einsum("iab,jcb->ijac", acol, bT, precision=PRECISE)
+            u2 = jnp.einsum("iab,jcb->ijac", bcol, aT, precision=PRECISE)
+            al2 = jnp.conj(alpha) if conj else alpha
+            acc = acc + (alpha * u1 + al2 * u2).astype(dtype)
+            # trailing-phase fault: one accumulator tile rots right after
+            # step k's update lands (final data — correctable)
+            for act, fk, fph, fti, ftj, fr, fc, fmode, val in slots:
+                hit = (
+                    (act == 1) & ((fph == PH_TRAIL) | (fph == PH_PANEL))
+                    & (k == fk) & (r == fti % p) & (c == ftj % q)
+                )
+                acc = _hit4(acc, hit, fti // p, ftj // q, fmode, val)
+            return acc
+
+        ntl_c = -(-at.shape[0] // q)
+        acc0 = jnp.zeros((mtl, ntl_c, nb, nb), dtype)
+        # FULL accumulation: the checksum rows live below the data block
+        # and their mirrored columns right of it — no triangle mask
+        return prefetch_bcast(kt, la, fetch, consume, acc0)
+
+    with bcast_impl_scope(bi):
+        prod = shard_map_compat(
+            kernel, mesh=mesh, in_specs=(spec, spec, P(), P()),
+            out_specs=spec, check_vma=False,
+        )(at, bt, fi, fv)
+    if ct is None:
+        return prod.astype(at.dtype)
+    return (prod + beta * ct).astype(at.dtype)
+
+
+def _encode_her2k(a: jax.Array, b: jax.Array, c, nb: int, mesh):
+    """Rank-2k operands gain checksum tile ROWS; an optional C gains the
+    full GEMM-output augmentation (row + column checksums + cross), so
+    beta C folds consistently into the carried checksums (linearity)."""
+    n, kdim = int(a.shape[0]), int(a.shape[1])
+    mt = padded_tiles(n, nb, mesh)
+    kt = padded_tiles(kdim, nb, mesh)
+    Nm, Kp = mt * nb, kt * nb
+    ap = cks.pad_dense(a, Nm, Kp)
+    bp = cks.pad_dense(b, Nm, Kp)
+    a_aug = jnp.concatenate([ap, cks.row_checksums(ap, nb)], axis=0)
+    b_aug = jnp.concatenate([bp, cks.row_checksums(bp, nb)], axis=0)
+    c_aug = None
+    if c is not None:
+        cp = cks.pad_dense(jnp.asarray(c), Nm, Nm)
+        crow = cks.row_checksums(cp, nb)
+        c_aug = jnp.concatenate(
+            [
+                jnp.concatenate([cp, cks.col_checksums(cp, nb)], axis=1),
+                jnp.concatenate([crow, cks.col_checksums(crow, nb)], axis=1),
+            ],
+            axis=0,
+        )
+    return a_aug, b_aug, c_aug, mt, kt
+
+
+def her2k_ft(
+    alpha, a, b, mesh, nb: int = 256, beta=0.0, c=None, conj: bool = True,
+    policy: FtPolicy = FtPolicy.Correct, lookahead=None, bcast_impl=None,
+    _rerun: bool = False,
+):
+    """ABFT distributed rank-2k update C = alpha A op(B) + op(alpha) B
+    op(A) + beta C (conj=True: her2k, op = ^H; conj=False: syr2k).
+    Returns (dense FULL C — both triangles, n x n — and FtReport);
+    raises FtError per policy.  Detection/location/repair reuse the GEMM
+    machinery: the augmented output has exactly the GEMM checksum
+    structure (see the module-section comment), and accumulator damage
+    is always final data, so single-row/column/tile patterns repair
+    exactly and received-panel corruption escalates to one recompute."""
+    a, b = jnp.asarray(a), jnp.asarray(b)
+    if a.shape != b.shape:
+        raise ValueError(f"her2k_ft: A and B must be same-shape, got "
+                         f"{a.shape} vs {b.shape}")
+    n = int(a.shape[0])  # rank-2k output is square: C is n x n
+    p, q = mesh_shape(mesh)
+    if policy == FtPolicy.Off:
+        from ..parallel.dist_blas3 import her2k_dist
+
+        ad = from_dense(a, mesh, nb)
+        bd = from_dense(b, mesh, nb)
+        cd = from_dense(jnp.asarray(c), mesh, nb) if c is not None else None
+        out = her2k_dist(alpha, ad, bd, beta, cd, conj=conj, full=True,
+                         lookahead=lookahead, bcast_impl=bcast_impl)
+        return to_dense(out)[:n, :n], FtReport(op="her2k")
+    a_aug, b_aug, c_aug, mt, kt = _encode_her2k(a, b, c, nb, mesh)
+    ad = from_dense(a_aug, mesh, nb)
+    bd = from_dense(b_aug, mesh, nb)
+    cd = from_dense(c_aug, mesh, nb) if c_aug is not None else None
+    la = la_depth(lookahead, kt)
+    ints, vals = inject.spec_arrays("her2k")
+    out_t = _ft_her2k_jit(
+        ad.tiles, bd.tiles, (None if cd is None else cd.tiles), alpha, beta,
+        mesh, p, q, kt, int(a.shape[1]), conj, la,
+        resolve_bcast_impl(bcast_impl),
+        jnp.asarray(ints), jnp.asarray(vals, jnp.result_type(float)),
+    )
+    inject.consume("her2k")
+    out_np = np.asarray(to_dense(DistMatrix(
+        tiles=out_t, m=a_aug.shape[0], n=a_aug.shape[0], nb=nb, mesh=mesh,
+    )))
+    verdR, verdC, drn, dcn = _gemm_verify(out_np, nb, mt, mt, kt)
+    report = FtReport(op="her2k")
+    if verdR.clean and verdC.clean:
+        return jnp.asarray(out_np[:n, :n]), report
+    dets = verdR.detections + verdC.detections
+    count("ft.detected", "her2k", len(dets))
+    if policy == FtPolicy.Detect:
+        raise FtError("her2k", "corruption detected (policy=detect)", dets)
+    if policy == FtPolicy.Correct and not _rerun:
+        fixed = _gemm_try_repair(out_np, drn, dcn, verdR, verdC, nb, mt, mt)
+        if fixed is not None:
+            v2R, v2C, _, _ = _gemm_verify(fixed, nb, mt, mt, kt)
+            if v2R.clean and v2C.clean:
+                count("ft.corrected", "her2k", len(dets))
+                report.action, report.detections = "corrected", dets
+                return jnp.asarray(fixed[:n, :n]), report
+    if _rerun:
+        count("ft.uncorrectable", "her2k")
+        raise FtError("her2k", "recompute still fails verification", dets)
+    count("ft.recomputed", "her2k")
+    out2, rep2 = her2k_ft(alpha, a, b, mesh, nb, beta, c, conj, policy,
+                          lookahead, bcast_impl, _rerun=True)
+    rep2.action = "recomputed"
+    rep2.detections = dets + rep2.detections
+    return out2, rep2
+
+
 def _encode_factor(a: jax.Array, nb: int, mesh, with_cols: bool):
     """Square factorization input -> checksum-augmented dense, with the
     grid padding + identity pad diagonal applied BEFORE encoding so the
@@ -1187,6 +1371,16 @@ def gemm_mesh_ft(alpha, a, b, mesh, nb=256, beta=0.0, c=None,
     out, _ = gemm_ft(alpha, a, b, mesh, nb, beta, c,
                      policy=resolve_policy(opts), lookahead=_la_opt(opts),
                      bcast_impl=_bi_opt(opts), panel_impl=_pi_opt(opts))
+    return out
+
+
+@instrument("her2k_mesh_ft")
+def her2k_mesh_ft(alpha, a, b, mesh, nb=256, beta=0.0, c=None,
+                  conj: bool = True,
+                  opts: Optional[Options] = None) -> jax.Array:
+    out, _ = her2k_ft(alpha, a, b, mesh, nb, beta, c, conj=conj,
+                      policy=resolve_policy(opts), lookahead=_la_opt(opts),
+                      bcast_impl=_bi_opt(opts))
     return out
 
 

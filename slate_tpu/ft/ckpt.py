@@ -32,8 +32,34 @@ The deterministic injector grows a *kill* class (``inject.KillFault``,
 segment dispatches and raises ``Preempted`` (carrying the last
 snapshot) before executing the segment containing the kill step —
 losing exactly the unsnapshotted steps a real preemption would.
+``KillFault(in_segment=True)`` sharpens the granularity to the STEP
+level: the driver dispatches a partial segment running the strict-
+schedule step helpers up to the kill step (real work, then lost) before
+raising, so the injected timeline matches a machine dying mid-segment.
 Recovery cost lands in the ``ft.ckpt_*`` counters (policy.py), gated in
 CI via ``python -m slate_tpu.ft.ckpt_smoke`` + ``obs.report --check``.
+
+ISSUE 13 extends the carry model from single-tile-stack to MULTI-ARRAY:
+``geqrf`` (tile stack + per-(mesh-row, panel) T_loc stack + replicated
+tree-merge V/T stacks) and the two-stage eig reduction ``he2hb`` (tile
+stack evolving toward the band + sharded reflector stack + replicated
+compact-WY accumulators) checkpoint as segment chains over the same
+module-level step helpers their fused kernels run
+(``dist_qr._qr_panel_step`` / ``dist_twostage._he2hb_step``), so
+kill→resume is BITWISE on the same mesh.  The auxiliary carries are
+GRID-LOCKED (a mesh row's local panel QR depends on the row partition),
+so a reshaped-grid resume raises a structured error instead of
+producing silently different reflectors — the tile-stack-only ops keep
+their reshard-on-resume path untouched.
+
+Snapshots have an ASYNC form (``SLATE_TPU_CKPT_ASYNC=1`` or the
+drivers' ``async_snapshots=True``): the device→host carry copy is
+issued non-blocking (``jax.Array.copy_to_host_async``) and fenced only
+at the NEXT snapshot point (or kill/finish), overlapping the DMA with
+the next segment's dispatch — the segment jits do not donate their
+operands, so the copied buffers stay immutable and async snapshots are
+bitwise-equal to sync ones (tier-1-asserted).  The overlap lands as the
+``ft.ckpt_async_overlap_s`` counter.
 """
 
 from __future__ import annotations
@@ -41,6 +67,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
 
@@ -84,13 +111,27 @@ from ..parallel.dist_lu import (
     getrf_nopiv_dist,
     getrf_pp_dist,
 )
+from ..linalg.eig import _he2hb_panel_count
+from ..obs.numerics import GROWTH_THRESHOLD, GrowthAbort, record_growth_abort
+from ..parallel.dist_qr import DistQR, _qr_pad_identity, _qr_panel_step, geqrf_dist
+from ..parallel.dist_twostage import DistTwoStage, _he2hb_step, he2hb_dist
 from ..parallel.mesh import COL_AXIS, ROW_AXIS, mesh_shape
 from ..types import SlateError
 from . import inject
 from .policy import count
 
 CKPT_ENV = "SLATE_TPU_CKPT"
-CKPT_OPS = ("potrf", "getrf_nopiv", "getrf_pp")
+CKPT_ASYNC_ENV = "SLATE_TPU_CKPT_ASYNC"
+CKPT_OPS = ("potrf", "getrf_nopiv", "getrf_pp", "geqrf", "he2hb")
+# auxiliary carry arrays per multi-array op, in snapshot order.  These
+# carries are GRID-LOCKED: their per-device layout (and the arithmetic
+# that produced them — a mesh row's local panel QR factors exactly the
+# rows that row owns) depends on the (p, q) grid shape, so a reshaped
+# resume cannot be bitwise and elastic.resume refuses it loudly.
+_MULTI_KEYS: Dict[str, Tuple[str, ...]] = {
+    "geqrf": ("tls", "tvs", "tts"),
+    "he2hb": ("vqs", "tqs"),
+}
 
 
 def resolve_checkpoint(every=None) -> Optional[int]:
@@ -113,6 +154,16 @@ def resolve_checkpoint(every=None) -> Optional[int]:
     return k
 
 
+def resolve_ckpt_async(flag=None) -> bool:
+    """Async-snapshot switch: explicit argument > ``SLATE_TPU_CKPT_ASYNC``
+    environment > off (sync).  Sync and async snapshots are bitwise-
+    equal; async overlaps the device→host copy with the next segment."""
+    if flag is None:
+        return os.environ.get(CKPT_ASYNC_ENV, "").strip().lower() in (
+            "1", "on", "true", "async")
+    return bool(flag)
+
+
 # ---------------------------------------------------------------------------
 # Snapshot + preemption types
 # ---------------------------------------------------------------------------
@@ -131,7 +182,13 @@ class Checkpoint:
     so re-basing onto a different padded length copies a prefix of
     fixed points + data swaps exactly.  ``gauges`` are the NumMonitor
     carry scalars, already globally reduced (min/max are exact, so
-    re-seeding every device with the global partial is bitwise)."""
+    re-seeding every device with the global partial is bitwise).
+
+    ``arrays`` (ISSUE 13) holds the MULTI-ARRAY ops' auxiliary carries
+    (``_MULTI_KEYS``): the geqrf T_loc/tree stacks, the he2hb reflector
+    and compact-WY stacks — stored in their GLOBAL device layout, which
+    is grid-locked (see the module docstring), so a resume requires the
+    snapshot's own (p, q) grid shape for these ops."""
 
     op: str
     step: int  # next logical k-step to execute on resume
@@ -146,12 +203,25 @@ class Checkpoint:
     tiles: np.ndarray  # LOGICAL-order padded tile grid
     rowperm: Optional[np.ndarray] = None
     gauges: Dict[str, np.ndarray] = field(default_factory=dict)
+    arrays: Dict[str, np.ndarray] = field(default_factory=dict)
+    # whether the interrupted run had the mid-loop growth-abort gate
+    # armed (monitored no-pivot LU): resume must keep policing the
+    # gauge, or a preemption would smuggle a garbage factor past the
+    # abort the uninterrupted run would have raised
+    growth_abort: bool = False
+    # whether the interrupted run snapshotted asynchronously: resume
+    # keeps the caller's overlap preference (results are bitwise either
+    # way; this is the one resilience knob that would otherwise be
+    # silently dropped across the resume boundary)
+    async_snapshots: bool = False
 
     @property
     def nbytes(self) -> int:
         n = int(self.tiles.nbytes)
         if self.rowperm is not None:
             n += int(self.rowperm.nbytes)
+        for v in self.arrays.values():
+            n += int(v.nbytes)
         return n
 
     def save(self, path: str) -> str:
@@ -161,7 +231,8 @@ class Checkpoint:
             op=self.op, step=self.step, every=self.every, m=self.m,
             n=self.n, nb=self.nb, grid=list(self.grid),
             bcast_impl=self.bcast_impl, panel_impl=self.panel_impl,
-            num_monitor=self.num_monitor,
+            num_monitor=self.num_monitor, growth_abort=self.growth_abort,
+            async_snapshots=self.async_snapshots,
         )
         arrays = {
             "tiles": self.tiles,
@@ -171,6 +242,8 @@ class Checkpoint:
             arrays["rowperm"] = self.rowperm
         for k, v in self.gauges.items():
             arrays[f"gauge_{k}"] = np.asarray(v)
+        for k, v in self.arrays.items():
+            arrays[f"arr_{k}"] = np.asarray(v)
         with open(path, "wb") as f:  # np.savez(str) would append .npz
             np.savez(f, **arrays)
         return path
@@ -183,6 +256,10 @@ class Checkpoint:
                 k[len("gauge_"):]: z[k] for k in z.files
                 if k.startswith("gauge_")
             }
+            arrs = {
+                k[len("arr_"):]: z[k] for k in z.files
+                if k.startswith("arr_")
+            }
             return cls(
                 op=meta["op"], step=int(meta["step"]),
                 every=int(meta["every"]), m=int(meta["m"]), n=int(meta["n"]),
@@ -190,7 +267,9 @@ class Checkpoint:
                 bcast_impl=meta["bcast_impl"], panel_impl=meta["panel_impl"],
                 num_monitor=bool(meta["num_monitor"]), tiles=z["tiles"],
                 rowperm=(z["rowperm"] if "rowperm" in z.files else None),
-                gauges=gauges,
+                gauges=gauges, arrays=arrs,
+                growth_abort=bool(meta.get("growth_abort", False)),
+                async_snapshots=bool(meta.get("async_snapshots", False)),
             )
 
 
@@ -502,6 +581,80 @@ def _pp_seg_jit(at, rowperm, g, mesh, p, q, nt, m_true, k0, k1, bi, nm):
 
 
 # ---------------------------------------------------------------------------
+# Multi-array segment kernels (ISSUE 13): steps [k0, k1) of the CAQR and
+# he2hb strict schedules, the whole multi-array carry crossing segment
+# boundaries as ordinary operands.  The step bodies are the same
+# module-level helpers the fused kernels loop over, so the chains are
+# bitwise at any boundary set.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _qr_seg_jit(at, tls, tvs, tts, mesh, p, q, m_true, k0, k1, bi):
+    """Steps [k0, k1) of the CAQR panel loop (dist_qr._qr_panel_step)
+    over the carry (tile stack, T_loc stack sharded over 'p', replicated
+    tree V/T stacks)."""
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(t_loc, tl_loc, tv, tt):
+        def step(k, carry):
+            return _qr_panel_step(k, carry, p, q, m_true)
+
+        with audit_scope(k1 - k0):
+            return lax.fori_loop(k0, k1, step, (t_loc, tl_loc, tv, tt))
+
+    with bcast_impl_scope(bi):
+        return shard_map_compat(
+            kernel, mesh=mesh,
+            in_specs=(spec, P(ROW_AXIS), P(), P()),
+            out_specs=(spec, P(ROW_AXIS), P(), P()), check_vma=False,
+        )(at, tls, tvs, tts)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def _qr_fin_jit(at, mesh, p, q, n_true):
+    """The fused CAQR kernel's exit computation (identity on the padded
+    diagonal), split off so the segment chain runs it exactly once."""
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(t_loc):
+        return _qr_pad_identity(t_loc, p, q, n_true, t_loc.dtype)
+
+    return shard_map_compat(
+        kernel, mesh=mesh, in_specs=(spec,), out_specs=spec, check_vma=False,
+    )(at)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def _he2hb_seg_jit(at, vqs, tqs, mesh, p, q, n_true, nb, k0, k1, bi):
+    """Steps [k0, k1) of the he2hb panel + two-sided trailing loop
+    (dist_twostage._he2hb_step) over the carry (tile stack, reflector
+    stack sharded over 'p', replicated compact-WY accumulators).  The
+    tile<->flat transposes at the segment boundary are exact byte moves,
+    so the chain stays bitwise with the fused kernel."""
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(t_loc, vq_loc, tq):
+        mtl, ntl, _, _ = t_loc.shape
+        a = jnp.transpose(t_loc, (0, 2, 1, 3)).reshape(mtl * nb, ntl * nb)
+
+        def step(k, carry):
+            return _he2hb_step(k, carry, p, q, n_true, nb)
+
+        with audit_scope(k1 - k0):
+            a, vq_loc, tq = lax.fori_loop(k0, k1, step, (a, vq_loc, tq))
+        t_out = jnp.transpose(a.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
+        return t_out, vq_loc, tq
+
+    with bcast_impl_scope(bi):
+        return shard_map_compat(
+            kernel, mesh=mesh,
+            in_specs=(spec, P(None, ROW_AXIS), P()),
+            out_specs=(spec, P(None, ROW_AXIS), P()), check_vma=False,
+        )(at, vqs, tqs)
+
+
+# ---------------------------------------------------------------------------
 # Host engine: segment chain + snapshot + kill consultation
 # ---------------------------------------------------------------------------
 
@@ -517,30 +670,70 @@ def _seg_dispatch(op, st, mesh, p, q, nt, m_true, k0, k1, bi, pi, nm):
         st["tiles"], st["rowperm"], g = _pp_seg_jit(
             st["tiles"], st["rowperm"], st["g"], mesh, p, q, nt, m_true,
             k0, k1, bi, nm)
+    elif op == "geqrf":
+        st["tiles"], st["tls"], st["tvs"], st["tts"] = _qr_seg_jit(
+            st["tiles"], st["tls"], st["tvs"], st["tts"], mesh, p, q,
+            m_true, k0, k1, bi)
+        g = None
+    elif op == "he2hb":
+        nb = st["tiles"].shape[-1]
+        st["tiles"], st["vqs"], st["tqs"] = _he2hb_seg_jit(
+            st["tiles"], st["vqs"], st["tqs"], mesh, p, q, m_true, nb,
+            k0, k1, bi)
+        g = None
     else:
         raise ValueError(f"no checkpointed driver for op {op!r}; "
                          f"expected one of {CKPT_OPS}")
-    if nm:
+    if nm and g is not None:
         st["g"] = g
 
 
-def _snapshot(op, d: DistMatrix, st, k, every, bi, pi, nm) -> Checkpoint:
+def _snapshot(op, d: DistMatrix, st, k, every, bi, pi, nm,
+              ga: bool = False, asnap: bool = False) -> Checkpoint:
     p, q = mesh_shape(d.mesh)
     gauges: Dict[str, np.ndarray] = {}
     if nm:
         gauges["g"] = np.asarray(st["g"])
         if "amax0" in st:
             gauges["amax0"] = np.asarray(st["amax0"])
+    arrays = {kk: np.asarray(st[kk]) for kk in _MULTI_KEYS.get(op, ())}
     ck = Checkpoint(
         op=op, step=int(k), every=int(every), m=d.m, n=d.n, nb=d.nb,
         grid=(p, q), bcast_impl=bi, panel_impl=pi, num_monitor=nm,
         tiles=_cyclic_to_logical(np.asarray(st["tiles"]), p, q),
         rowperm=(np.asarray(st["rowperm"]) if "rowperm" in st else None),
-        gauges=gauges,
+        gauges=gauges, arrays=arrays, growth_abort=ga,
+        async_snapshots=asnap,
     )
     count("ft.ckpt_snapshots", op)
     count("ft.ckpt_snapshot_bytes", op, float(ck.nbytes))
     return ck
+
+
+class _PendingSnapshot:
+    """An in-flight ASYNC snapshot: non-blocking device→host copies of
+    the whole carry (``jax.Array.copy_to_host_async``), issued at the
+    segment boundary so the DMA overlaps the NEXT segment's dispatch,
+    fenced only at the next snapshot point (or at a kill / loop exit).
+    The segment jits do not donate their operands, so the copied buffers
+    stay immutable while the next segment computes — the materialized
+    Checkpoint is bitwise-equal to the sync path's."""
+
+    def __init__(self, op, d, st, k, every, bi, pi, nm, ga=False):
+        # shallow copy: _seg_dispatch REBINDS st entries (functional
+        # updates), so the captured references keep the boundary values
+        self._args = (op, d, dict(st), k, every, bi, pi, nm, ga, True)
+        for v in self._args[2].values():
+            start = getattr(v, "copy_to_host_async", None)
+            if start is not None:
+                start()
+        self.issued = time.perf_counter()
+
+    def materialize(self) -> Checkpoint:
+        op = self._args[0]
+        count("ft.ckpt_async_overlap_s", op,
+              max(0.0, time.perf_counter() - self.issued))
+        return _snapshot(*self._args)
 
 
 def _finish(op, d: DistMatrix, st, nm):
@@ -550,6 +743,15 @@ def _finish(op, d: DistMatrix, st, nm):
     p, q = mesh_shape(mesh)
     nt = d.nt
     m_true = d.n if op == "potrf" else d.m
+    if op == "geqrf":
+        t = _qr_fin_jit(st["tiles"], mesh, p, q, d.n)
+        fd = DistMatrix(tiles=t, m=d.m, n=d.n, nb=d.nb, mesh=mesh,
+                        diag_pad=True)
+        return DistQR(fd, st["tls"], st["tvs"], st["tts"])
+    if op == "he2hb":
+        band = DistMatrix(tiles=st["tiles"], m=d.m, n=d.n, nb=d.nb, mesh=mesh)
+        return DistTwoStage(band, st["vqs"], st["tqs"],
+                            st["vqs"][:0], st["tqs"][:0])
     out = DistMatrix(
         tiles=st["tiles"], m=d.m, n=d.n, nb=d.nb, mesh=mesh, diag_pad=True
     )
@@ -569,24 +771,55 @@ def _finish(op, d: DistMatrix, st, nm):
     return out, info
 
 
+def _multi_init(op: str, d: DistMatrix, st: dict, nsteps: int) -> None:
+    """Zero-initialize the multi-array ops' auxiliary carries in their
+    GLOBAL layout (the fused kernels' in-kernel zeros, hoisted to
+    operands — identical values, so the chain stays bitwise)."""
+    nb = d.nb
+    p, _q = mesh_shape(d.mesh)
+    dtype = d.dtype
+    if op == "geqrf":
+        nmerge = max(1, p)
+        st["tls"] = jnp.zeros((p * d.nt, nb, nb), dtype)
+        st["tvs"] = jnp.zeros((d.nt, nmerge, 2 * nb, nb), dtype)
+        st["tts"] = jnp.zeros((d.nt, nmerge, nb, nb), dtype)
+    elif op == "he2hb":
+        st["vqs"] = jnp.zeros((max(nsteps, 1), d.mt * nb, nb), dtype)
+        st["tqs"] = jnp.zeros((max(nsteps, 1), nb, nb), dtype)
+
+
 def _run(op: str, d: DistMatrix, k_from: int, every: int, bi: str, pi: str,
          nm: bool, rowperm=None, gauges=None,
-         ckpt0: Optional[Checkpoint] = None):
-    """Segment-dispatch the k-loop of ``op`` over [k_from, nt): snapshot
-    the carry at every ``every``-step boundary; raise ``Preempted`` when
-    an armed ``KillFault`` lands inside the segment about to run (the
-    work since the last snapshot is exactly what a real preemption would
-    lose — counted as ``ft.ckpt_lost_steps``)."""
+         ckpt0: Optional[Checkpoint] = None, arrays=None,
+         async_snap: bool = False, growth_abort: bool = False):
+    """Segment-dispatch the k-loop of ``op`` over [k_from, nsteps):
+    snapshot the carry at every ``every``-step boundary (async when
+    ``async_snap`` — the copy overlaps the next dispatch and fences at
+    the next boundary); raise ``Preempted`` when an armed ``KillFault``
+    lands inside the segment about to run (a step-level ``in_segment``
+    kill first dispatches the partial segment up to the kill step — real
+    work, then lost).  Either way the work since the last snapshot is
+    exactly what the resume re-executes — ``ft.ckpt_lost_steps``.  With
+    ``growth_abort`` (monitored no-pivot LU), a running-growth gauge
+    crossing GROWTH_THRESHOLD at a segment boundary raises
+    ``GrowthAbort`` instead of completing a garbage factor."""
     mesh = d.mesh
     p, q = mesh_shape(mesh)
-    nt = d.nt
-    m_true = d.n if op == "potrf" else d.m
+    nt = _he2hb_panel_count(d.n, d.nb) if op == "he2hb" else d.nt
+    m_true = d.n if op in ("potrf", "he2hb") else d.m
     st: dict = {"tiles": d.tiles}
     if op == "getrf_pp":
         st["rowperm"] = (
             jnp.asarray(rowperm) if rowperm is not None
             else jnp.arange(nt * d.nb)
         )
+    if op in _MULTI_KEYS:
+        nm = False  # no NumMonitor gauges thread these loops (yet)
+        if arrays:
+            for kk in _MULTI_KEYS[op]:
+                st[kk] = jnp.asarray(arrays[kk])
+        else:
+            _multi_init(op, d, st, nt)
     if nm:
         if op == "potrf":
             st["g"] = (jnp.asarray(gauges["g"]) if gauges
@@ -598,10 +831,18 @@ def _run(op: str, d: DistMatrix, k_from: int, every: int, bi: str, pi: str,
             a0 = _wabs_init_jit(d.tiles, mesh, p, q, m_true)
             st["amax0"] = a0
             st["g"] = a0
-    else:
+    elif op not in _MULTI_KEYS:
         st["g"] = jnp.zeros((), jnp.float32)
 
     last = ckpt0
+    pending: Optional[_PendingSnapshot] = None
+
+    def fence():
+        nonlocal last, pending
+        if pending is not None:
+            last = pending.materialize()
+            pending = None
+
     k = int(k_from)
     while k < nt:
         k2 = min(k + every, nt)
@@ -611,13 +852,36 @@ def _run(op: str, d: DistMatrix, k_from: int, every: int, bi: str, pi: str,
             plan = inject.current_plan()
             if plan is not None:
                 plan.consume_fault(kill)
+            if getattr(kill, "in_segment", False) and kill.k > k:
+                # step-level arm: the machine really runs [k, kill.k) —
+                # the strict-schedule step helpers stop early — and dies
+                # there; the partial carry is discarded with it
+                _seg_dispatch(op, dict(st), mesh, p, q, nt, m_true,
+                              k, kill.k, bi, pi, nm)
+                count("ft.ckpt_inseg_kills", op)
             count("ft.ckpt_kills", op)
             count("ft.ckpt_lost_steps", op, float(kill.k - k))
+            fence()  # an in-flight host copy survives the preemption
             raise Preempted(op, kill.k, last)
         _seg_dispatch(op, st, mesh, p, q, nt, m_true, k, k2, bi, pi, nm)
+        if growth_abort and nm and "amax0" in st:
+            a0 = float(st["amax0"])
+            growth = float(st["g"]) / a0 if a0 > 0 else 0.0
+            if growth > GROWTH_THRESHOLD:
+                record_growth_abort(op, growth)
+                fence()
+                raise GrowthAbort(op, growth, k2, GROWTH_THRESHOLD)
         k = k2
         if k < nt:
-            last = _snapshot(op, d, st, k, every, bi, pi, nm)
+            if async_snap:
+                fence()  # previous copy fences only now, one interval late
+                pending = _PendingSnapshot(op, d, st, k, every, bi, pi, nm,
+                                           growth_abort)
+                count("ft.ckpt_async_snapshots", op)
+            else:
+                last = _snapshot(op, d, st, k, every, bi, pi, nm,
+                                 growth_abort)
+    fence()  # account the final interior snapshot's overlap + bytes
     return _finish(op, d, st, nm)
 
 
@@ -636,12 +900,14 @@ def _check_square(a: DistMatrix, who: str) -> None:
 @instrument("potrf_ckpt")
 def potrf_ckpt(a: DistMatrix, every=None, bcast_impl: Optional[str] = None,
                panel_impl: Optional[str] = None,
-               num_monitor: Optional[str] = None):
+               num_monitor: Optional[str] = None, async_snapshots=None):
     """Checkpointed mesh Cholesky: ``potrf_dist`` results (bitwise) with
     the carry snapshotted every ``every`` steps (Option.Checkpoint; None
     resolves the env chain — off delegates to the fused kernel
     untouched).  Returns (L DistMatrix, info); raises ``Preempted``
-    under an armed kill fault."""
+    under an armed kill fault.  ``async_snapshots`` resolves the
+    SLATE_TPU_CKPT_ASYNC chain: overlap the snapshot copy with the next
+    segment (bitwise-equal either way)."""
     ev = resolve_checkpoint(every)
     if ev is None:
         return potrf_dist(a, bcast_impl=bcast_impl, panel_impl=panel_impl,
@@ -649,16 +915,23 @@ def potrf_ckpt(a: DistMatrix, every=None, bcast_impl: Optional[str] = None,
     _check_square(a, "potrf_ckpt")
     return _run("potrf", a, 0, ev, resolve_bcast_impl(bcast_impl),
                 resolve_panel_impl(panel_impl),
-                resolve_num_monitor(num_monitor) == "on")
+                resolve_num_monitor(num_monitor) == "on",
+                async_snap=resolve_ckpt_async(async_snapshots))
 
 
 @instrument("getrf_nopiv_ckpt")
 def getrf_nopiv_ckpt(a: DistMatrix, every=None,
                      bcast_impl: Optional[str] = None,
                      panel_impl: Optional[str] = None,
-                     num_monitor: Optional[str] = None):
+                     num_monitor: Optional[str] = None,
+                     async_snapshots=None, growth_abort: bool = True):
     """Checkpointed mesh LU-nopiv (getrf_nopiv_dist, bitwise).  Returns
-    (LU DistMatrix, info)."""
+    (LU DistMatrix, info).  When monitored (Option.NumMonitor=on) the
+    in-carry running-growth gauge is checked at every segment boundary:
+    crossing GROWTH_THRESHOLD raises ``obs.numerics.GrowthAbort``
+    mid-k-loop instead of completing a garbage factor (the ROADMAP
+    "close the control loop" escalation — callers retry with tntpiv/pp;
+    ``growth_abort=False`` opts out)."""
     ev = resolve_checkpoint(every)
     if ev is None:
         return getrf_nopiv_dist(a, bcast_impl=bcast_impl,
@@ -667,13 +940,15 @@ def getrf_nopiv_ckpt(a: DistMatrix, every=None,
     _check_square(a, "getrf_nopiv_ckpt")
     return _run("getrf_nopiv", a, 0, ev, resolve_bcast_impl(bcast_impl),
                 resolve_panel_impl(panel_impl),
-                resolve_num_monitor(num_monitor) == "on")
+                resolve_num_monitor(num_monitor) == "on",
+                async_snap=resolve_ckpt_async(async_snapshots),
+                growth_abort=growth_abort)
 
 
 @instrument("getrf_pp_ckpt")
 def getrf_pp_ckpt(a: DistMatrix, every=None,
                   bcast_impl: Optional[str] = None,
-                  num_monitor: Optional[str] = None):
+                  num_monitor: Optional[str] = None, async_snapshots=None):
     """Checkpointed partial-pivot mesh LU (getrf_pp_dist, bitwise): the
     carry additionally snapshots the replicated row permutation.
     Returns (LU DistMatrix, perm, info)."""
@@ -683,4 +958,41 @@ def getrf_pp_ckpt(a: DistMatrix, every=None,
                              num_monitor=num_monitor)
     _check_square(a, "getrf_pp_ckpt")
     return _run("getrf_pp", a, 0, ev, resolve_bcast_impl(bcast_impl),
-                "xla", resolve_num_monitor(num_monitor) == "on")
+                "xla", resolve_num_monitor(num_monitor) == "on",
+                async_snap=resolve_ckpt_async(async_snapshots))
+
+
+@instrument("geqrf_ckpt")
+def geqrf_ckpt(a: DistMatrix, every=None, bcast_impl: Optional[str] = None,
+               async_snapshots=None):
+    """Checkpointed distributed CAQR (ISSUE 13): ``geqrf_dist`` results
+    (bitwise) with the MULTI-ARRAY carry — tile stack, per-(mesh-row,
+    panel) T_loc stack, replicated tree V/T stacks — snapshotted every
+    ``every`` panel steps.  Returns DistQR; raises ``Preempted`` under
+    an armed kill fault.  The auxiliary carries are grid-locked: resume
+    requires the snapshot's own (p, q) grid shape."""
+    ev = resolve_checkpoint(every)
+    if ev is None:
+        return geqrf_dist(a, bcast_impl=bcast_impl)
+    if a.m < a.n:
+        raise ValueError(f"geqrf_ckpt requires m >= n, got {a.m}x{a.n}")
+    return _run("geqrf", a, 0, ev, resolve_bcast_impl(bcast_impl), "xla",
+                False, async_snap=resolve_ckpt_async(async_snapshots))
+
+
+@instrument("he2hb_ckpt")
+def he2hb_ckpt(a: DistMatrix, every=None, bcast_impl: Optional[str] = None,
+               async_snapshots=None):
+    """Checkpointed two-stage eig stage-1 reduction (ISSUE 13):
+    ``he2hb_dist`` results (bitwise) with the multi-array carry — tile
+    stack evolving toward the band, sharded reflector stack, replicated
+    compact-WY accumulators — snapshotted every ``every`` panel steps.
+    Returns DistTwoStage; raises ``Preempted`` under an armed kill
+    fault.  Grid-locked carry, as geqrf_ckpt."""
+    ev = resolve_checkpoint(every)
+    if a.m != a.n:
+        raise ValueError("he2hb_ckpt needs a square matrix")
+    if ev is None or _he2hb_panel_count(a.n, a.nb) == 0:
+        return he2hb_dist(a)
+    return _run("he2hb", a, 0, ev, resolve_bcast_impl(bcast_impl), "xla",
+                False, async_snap=resolve_ckpt_async(async_snapshots))

@@ -1,19 +1,29 @@
 """Checkpoint/restart smoke: the CI acceptance run for elastic reliability.
 
-Proves the ISSUE 12 acceptance surface on the 8-device CPU mesh:
+Proves the ISSUE 12 + 13 acceptance surface on the 8-device CPU mesh:
 
 1. checkpointed-run identity — chained segment dispatches reproduce the
-   fused kernels BITWISE for potrf, LU-nopiv, and partial-pivot LU;
+   fused kernels BITWISE for potrf, LU-nopiv, partial-pivot LU, the
+   distributed CAQR (geqrf: MULTI-ARRAY carry), and the two-stage eig
+   stage-1 reduction (he2hb: multi-array carry);
 2. kill → resume on the SAME mesh is bitwise-identical to the
-   uninterrupted factorization (deterministic seeded preemption);
+   uninterrupted factorization (deterministic seeded preemption) for
+   all five ops;
 3. kill → resume on a RESHAPED mesh (2x4 → 4x2) lands the bitwise-same
-   solution via the shard_map block-cyclic redistribution (which itself
-   is asserted bitwise against the eager path);
-4. a snapshot survives a disk round trip (``Checkpoint.save/load``);
-5. the ``ft.ckpt_*`` recovery-cost counters (snapshots, snapshot bytes,
-   kills, lost steps, resumes, reshards, redistribute bytes) land in a
-   schema-valid RunReport, gated in CI by ``obs.report --check
-   --ignore '*_runtime_*'`` against the committed
+   solution via the shard_map block-cyclic redistribution for the
+   tile-stack ops; the multi-array ops REFUSE the reshaped grid with a
+   structured error (their aux carries are grid-locked);
+4. a snapshot survives a disk round trip (``Checkpoint.save/load``),
+   multi-array forms included;
+5. an IN-SEGMENT kill (step-level arm) executes then loses exactly the
+   steps since the last snapshot (``ft.ckpt_lost_steps``), and the
+   ASYNC snapshot path (copy overlapped with the next dispatch) is
+   bitwise-equal to sync;
+6. the ``ft.ckpt_*`` recovery-cost counters (snapshots, snapshot bytes,
+   kills, lost steps, in-segment kills, async snapshots + overlap,
+   resumes, reshards, redistribute bytes) land in a schema-valid
+   RunReport, gated in CI by ``obs.report --check --ignore
+   '*_runtime_*' --ignore '*_overlap_s'`` against the committed
    artifacts/obs/ft_ckpt.report.json.
 
 Usage::
@@ -45,10 +55,14 @@ def run_smoke(out_dir: str, n: int = 64, nb: int = 8) -> int:
               "XLA_FLAGS=--xla_force_host_platform_device_count=8")
         return 2
 
+    from ..linalg.eig import _he2hb_panel_count
     from ..obs import report, reset
     from ..parallel import from_dense, make_mesh, redistribute, to_dense
     from ..parallel.dist_chol import potrf_dist
     from ..parallel.dist_lu import getrf_nopiv_dist, getrf_pp_dist
+    from ..parallel.dist_qr import geqrf_dist
+    from ..parallel.dist_twostage import he2hb_dist
+    from ..types import SlateError
     from ..utils.testing import generate
     from . import ckpt, elastic, inject
     from .policy import ft_counter_values
@@ -74,18 +88,28 @@ def run_smoke(out_dir: str, n: int = 64, nb: int = 8) -> int:
     sd = from_dense(spd, mesh, nb, diag_pad_one=True)
     dd = from_dense(dom, mesh, nb, diag_pad_one=True)
     gd = from_dense(gen, mesh, nb, diag_pad_one=True)
+    qd = from_dense(gen, mesh, nb)
+    hd = from_dense(jnp.asarray(generate("spd", n, seed=4)), mesh, nb)
+    he_steps = _he2hb_panel_count(n, nb)
 
+    # (op, steps, multi): multi ops carry grid-locked aux arrays —
+    # same-mesh resume bitwise, reshaped grid refused (ISSUE 13)
     cases = {
         "potrf": (sd, lambda: potrf_dist(sd),
-                  lambda ev: ckpt.potrf_ckpt(sd, every=ev)),
+                  lambda ev: ckpt.potrf_ckpt(sd, every=ev), nt, False),
         "getrf_nopiv": (dd, lambda: getrf_nopiv_dist(dd),
-                        lambda ev: ckpt.getrf_nopiv_ckpt(dd, every=ev)),
+                        lambda ev: ckpt.getrf_nopiv_ckpt(dd, every=ev),
+                        nt, False),
         "getrf_pp": (gd, lambda: getrf_pp_dist(gd),
-                     lambda ev: ckpt.getrf_pp_ckpt(gd, every=ev)),
+                     lambda ev: ckpt.getrf_pp_ckpt(gd, every=ev), nt, False),
+        "geqrf": (qd, lambda: geqrf_dist(qd),
+                  lambda ev: ckpt.geqrf_ckpt(qd, every=ev), nt, True),
+        "he2hb": (hd, lambda: he2hb_dist(hd),
+                  lambda ev: ckpt.he2hb_ckpt(hd, every=ev), he_steps, True),
     }
 
     resid = {}
-    for op, (_d, plain, ckpted) in cases.items():
+    for op, (_d, plain, ckpted, steps, multi) in cases.items():
         ref = plain()
         got = ckpted(every)
         same = all(
@@ -96,9 +120,9 @@ def run_smoke(out_dir: str, n: int = 64, nb: int = 8) -> int:
               "checkpointed chain != fused kernel (bitwise)")
 
         # deterministic kill -> Preempted carrying the last snapshot
-        kill = inject.seeded_kill(20 + nt, op, nt)
-        if not (every <= kill.k < nt):  # keep the smoke resumable
-            kill = inject.KillFault(op, min(every + 1, nt - 1))
+        kill = inject.seeded_kill(20 + steps, op, steps)
+        if not (every <= kill.k < steps):  # keep the smoke resumable
+            kill = inject.KillFault(op, min(every + 1, steps - 1))
         try:
             with inject.fault_scope(inject.FaultPlan([kill])):
                 ckpted(every)
@@ -122,6 +146,19 @@ def run_smoke(out_dir: str, n: int = 64, nb: int = 8) -> int:
         check(f"{op}-resume-same-mesh", same,
               "resumed run != uninterrupted run (bitwise)")
 
+        if multi:
+            # grid-locked aux carries: the reshaped grid must be REFUSED
+            # with a structured error, never silently-different factors
+            try:
+                elastic.resume(ck, mesh42)
+                check(f"{op}-reshaped-refused", False,
+                      "reshaped resume of a grid-locked carry succeeded")
+            except SlateError:
+                pass
+            resid[op] = float(jnp.max(jnp.abs(
+                to_dense(ref[0]) - to_dense(res[0]))))
+            continue
+
         # resume the SAME checkpoint on the reshaped 4x2 mesh: the
         # solution (logical data region) must be bitwise-identical
         res2 = elastic.resume(ck, mesh42)
@@ -140,6 +177,47 @@ def run_smoke(out_dir: str, n: int = 64, nb: int = 8) -> int:
         resid[op] = float(jnp.max(jnp.abs(
             to_dense(ref[0]) - to_dense(res2[0]))))
 
+    # in-segment kill (step-level arm): the partial segment executes,
+    # the loss is exactly kill.k - last_snapshot, and resume is bitwise
+    ref_p = potrf_dist(sd)
+    k_in = every + 1
+    before = ft_counter_values()
+    try:
+        with inject.fault_scope(inject.FaultPlan(
+            [inject.KillFault("potrf", k_in, in_segment=True)]
+        )):
+            ckpt.potrf_ckpt(sd, every=every)
+        check("inseg-kill", False, "no Preempted raised")
+        ck_in = None
+    except ckpt.Preempted as e:
+        ck_in = e.checkpoint
+    after = ft_counter_values()
+    check("inseg-lost-steps",
+          after["ckpt_lost_steps"] - before["ckpt_lost_steps"]
+          == k_in - every
+          and after["ckpt_inseg_kills"] - before["ckpt_inseg_kills"] == 1,
+          f"lost {after['ckpt_lost_steps'] - before['ckpt_lost_steps']} "
+          f"want {k_in - every}")
+    if ck_in is not None:
+        res_in = elastic.resume(ck_in, mesh)
+        check("inseg-resume", all(
+            np.array_equal(np.asarray(r), np.asarray(g))
+            for r, g in zip(jax.tree.leaves(ref_p), jax.tree.leaves(res_in))
+        ), "in-segment kill resume != uninterrupted (bitwise)")
+
+    # async snapshots: bitwise-equal to sync, overlap counter moves
+    before = ft_counter_values()
+    got_async = ckpt.potrf_ckpt(sd, every=every, async_snapshots=True)
+    after = ft_counter_values()
+    check("async-bitwise", all(
+        np.array_equal(np.asarray(r), np.asarray(g))
+        for r, g in zip(jax.tree.leaves(ref_p), jax.tree.leaves(got_async))
+    ), "async-snapshot run != fused kernel (bitwise)")
+    check("async-counters",
+          after["ckpt_async_snapshots"] > before["ckpt_async_snapshots"]
+          and after["ckpt_snapshots"] > before["ckpt_snapshots"],
+          f"async counters {after}")
+
     # shard_map redistribution: bitwise vs the eager path on a ragged
     # operand (the primitive reshaped resume rides)
     rag = jnp.asarray(generate("randn", n, seed=3)[: n - nb // 2])
@@ -152,10 +230,12 @@ def run_smoke(out_dir: str, n: int = 64, nb: int = 8) -> int:
 
     ftv = ft_counter_values()
     check("counters",
-          ftv["ckpt_snapshots"] >= 3 and ftv["ckpt_kills"] >= 3
-          and ftv["ckpt_resumes"] >= 6 and ftv["ckpt_reshards"] >= 3
+          ftv["ckpt_snapshots"] >= 5 and ftv["ckpt_kills"] >= 6
+          and ftv["ckpt_resumes"] >= 9 and ftv["ckpt_reshards"] >= 3
           and ftv["ckpt_snapshot_bytes"] > 0
-          and ftv["ckpt_redistribute_bytes"] > 0,
+          and ftv["ckpt_redistribute_bytes"] > 0
+          and ftv["ckpt_inseg_kills"] >= 1
+          and ftv["ckpt_async_snapshots"] >= 1,
           f"ckpt counters {ftv}")
 
     os.makedirs(out_dir, exist_ok=True)
@@ -171,7 +251,7 @@ def run_smoke(out_dir: str, n: int = 64, nb: int = 8) -> int:
         rep_doc = json.load(fh)
     errs = report.validate_report(rep_doc)
     check("report", not errs, f"schema: {errs}")
-    check("report-ft", rep_doc.get("ft", {}).get("ckpt_resumes", 0) >= 6,
+    check("report-ft", rep_doc.get("ft", {}).get("ckpt_resumes", 0) >= 9,
           f"RunReport ft section {rep_doc.get('ft')}")
 
     if failures:
@@ -179,8 +259,10 @@ def run_smoke(out_dir: str, n: int = 64, nb: int = 8) -> int:
         for msg in failures:
             print(f"  FAIL {msg}")
         return 1
-    print(f"ft.ckpt_smoke: OK — 3 ops kill/resume bitwise (same + reshaped "
-          f"mesh), redistribute bitwise; counters {ftv}; report {rep_path}")
+    print(f"ft.ckpt_smoke: OK — 5 ops kill/resume bitwise (potrf/LU x2 "
+          f"also reshaped; geqrf/he2hb multi-array carries grid-locked), "
+          f"in-segment kill + async snapshots verified, redistribute "
+          f"bitwise; counters {ftv}; report {rep_path}")
     return 0
 
 

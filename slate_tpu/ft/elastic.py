@@ -136,17 +136,32 @@ def resume(ck: Checkpoint, mesh: Mesh, bcast_impl: Optional[str] = None,
            panel_impl: Optional[str] = None):
     """Continue a checkpointed factorization from its snapshot on
     ``mesh`` and return exactly what the checkpointed driver would have
-    ((L|LU, info) or (LU, perm, info) for pp).  BITWISE-identical to the
-    uninterrupted run on the same grid AND on a reshaped grid (the
-    redistribution moves exact bytes; the remaining segments compute the
-    same per-element arithmetic).  Raises ``Preempted`` again if a
-    persistent kill fault is still armed."""
+    ((L|LU, info), (LU, perm, info) for pp, DistQR for geqrf,
+    DistTwoStage for he2hb).  BITWISE-identical to the uninterrupted run
+    on the same grid AND — for the tile-stack-only ops — on a reshaped
+    grid (the redistribution moves exact bytes; the remaining segments
+    compute the same per-element arithmetic).  The MULTI-ARRAY ops
+    (geqrf/he2hb) carry grid-locked auxiliary state: a mesh row's local
+    panel QR factors exactly the rows that row owns, so a reshaped-grid
+    resume could not be bitwise (nor even consistent with the stored
+    T factors) and raises a structured error instead; a same-shape grid
+    over DIFFERENT devices resumes fine (the carry lands by device_put).
+    Raises ``Preempted`` again if a persistent kill fault is still
+    armed."""
     if not resumable(ck):
         raise SlateError(
             "elastic.resume: checkpoint is missing or names an unknown op"
         )
     t0 = time.perf_counter()
     p2, q2 = mesh_shape(mesh)
+    multi = ck.op in _ckpt._MULTI_KEYS
+    if multi and (p2, q2) != tuple(ck.grid):
+        raise SlateError(
+            f"elastic.resume: {ck.op} carries grid-locked auxiliary "
+            f"arrays (per-mesh-row panel factors); its {ck.grid[0]}x"
+            f"{ck.grid[1]} snapshot cannot resume on a {p2}x{q2} grid — "
+            "restart from scratch or grant a same-shape grid"
+        )
     mt2 = padded_tiles(ck.m, ck.nb, mesh)
     nt2 = padded_tiles(ck.n, ck.nb, mesh)
     if (p2, q2) != tuple(ck.grid):
@@ -159,6 +174,14 @@ def resume(ck: Checkpoint, mesh: Mesh, bcast_impl: Optional[str] = None,
     out = _ckpt._run(
         ck.op, d, ck.step, ck.every, bi, pi, ck.num_monitor,
         rowperm=rowperm, gauges=(ck.gauges or None), ckpt0=ck,
+        arrays=(ck.arrays or None),
+        # keep the interrupted run's async preference (persisted in the
+        # snapshot) unless the environment re-arms it explicitly
+        async_snap=(ck.async_snapshots or _ckpt.resolve_ckpt_async(None)),
+        # keep policing the growth gauge: a preemption must not smuggle
+        # a garbage no-pivot factor past the abort the uninterrupted
+        # run would have raised
+        growth_abort=ck.growth_abort,
     )
     count("ft.ckpt_resume_runtime_s", ck.op, time.perf_counter() - t0)
     return out

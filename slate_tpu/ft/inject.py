@@ -54,7 +54,7 @@ _ICOLS = 8
 
 @dataclass
 class Fault:
-    op: str  # "gemm" | "potrf" | "getrf_nopiv"
+    op: str  # "gemm" | "potrf" | "getrf_nopiv" | "trsm" | "her2k"
     k: int  # loop step the fault fires at
     phase: str  # "panel" | "bcast" | "trailing"
     ti: int  # logical tile row of the target
@@ -76,15 +76,23 @@ class KillFault:
     Unlike ``Fault`` (a data corruption lowered into the kernel spec),
     a kill never enters a jitted kernel — the checkpointed drivers
     (``ft/ckpt.py``) consult the active plan between segment dispatches
-    and raise ``Preempted`` before executing the segment that contains
-    step ``k``, losing exactly the (unsnapshotted) steps a real
-    preemption would.  ``persist=False`` models a one-shot preemption:
-    the resumed run executes clean.  ``persist=True`` re-kills on every
-    resume — the give-up/graceful-rejection path."""
+    and raise ``Preempted``, losing exactly the (unsnapshotted) steps a
+    real preemption would.  ``persist=False`` models a one-shot
+    preemption: the resumed run executes clean.  ``persist=True``
+    re-kills on every resume — the give-up/graceful-rejection path.
 
-    op: str  # "potrf" | "getrf_nopiv" | "getrf_pp"
+    ``in_segment`` (ISSUE 13) is the step-level arm: instead of dying at
+    the segment boundary (the segment containing step ``k`` never
+    dispatches), the driver dispatches a PARTIAL segment running the
+    strict-schedule step helpers up to — but excluding — step ``k`` and
+    dies there, exactly as a machine preempted mid-segment would: the
+    partial work is real, then lost, and a resume re-executes only the
+    steps since the last snapshot (``ft.ckpt_lost_steps``)."""
+
+    op: str  # "potrf" | "getrf_nopiv" | "getrf_pp" | "geqrf" | "he2hb"
     k: int  # loop step the preemption lands on
     persist: bool = False
+    in_segment: bool = False  # die mid-segment (partial dispatch) vs at entry
 
 
 @dataclass
@@ -190,15 +198,17 @@ def armed_kills(op: str) -> List[KillFault]:
     return plan.armed_kills(op) if plan is not None else []
 
 
-def seeded_kill(seed: int, op: str, nt: int, persist: bool = False) -> KillFault:
+def seeded_kill(seed: int, op: str, nt: int, persist: bool = False,
+                in_segment: bool = False) -> KillFault:
     """One deterministic preemption for ``op`` on an ``nt``-step loop:
     the kill step is drawn in [1, nt) so at least one step of work
     precedes it (a kill at step 0 is just 'never started').  Same seed →
-    same step, so a kill/resume test is exactly reproducible."""
+    same step, so a kill/resume test is exactly reproducible.
+    ``in_segment`` arms the step-level (mid-segment) form."""
     if nt < 2:
         raise ValueError(f"seeded_kill needs nt >= 2 (got {nt})")
     rng = np.random.default_rng(seed)
-    return KillFault(op, int(rng.integers(1, nt)), persist)
+    return KillFault(op, int(rng.integers(1, nt)), persist, in_segment)
 
 
 def seeded_fault(

@@ -100,6 +100,14 @@ _COUNTERS = (
     "ft.ckpt_snapshots", "ft.ckpt_snapshot_bytes", "ft.ckpt_kills",
     "ft.ckpt_lost_steps", "ft.ckpt_resumes", "ft.ckpt_reshards",
     "ft.ckpt_redistribute_bytes", "ft.ckpt_resume_runtime_s",
+    # async snapshot path (ISSUE 13): snapshots whose device->host carry
+    # copy overlapped the next segment's dispatch, and the wall time that
+    # overlap bought (issue -> fence; machine-dependent, so the CI gate
+    # adds --ignore '*_overlap_s' next to '*_runtime_*'), plus in-segment
+    # (mid-segment) kills — the step-level preemption arm that executes
+    # and then loses partial work
+    "ft.ckpt_async_snapshots", "ft.ckpt_async_overlap_s",
+    "ft.ckpt_inseg_kills",
 )
 
 

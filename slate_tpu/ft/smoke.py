@@ -131,9 +131,22 @@ def run_smoke(out_dir: str, n: int = 64, nb: int = 8) -> int:
     check("trsm", rep.action == "corrected" and terr < 1e-10,
           f"action={rep.action} err={terr:.3g}")
 
+    # (7) her2k (ISSUE 13): the eig chain's dominant trailing-update op
+    # — an injected accumulator fault is final data, exactly repaired
+    # from the dual-sided carried checksums (the GEMM repair class)
+    f = inject.Fault("her2k", k=nt - 1, phase="trailing", ti=3, tj=1,
+                     r=3 % 2, c=1 % 4, mode=inject.MODE_SCALE, value=3.0)
+    with inject.fault_scope(inject.FaultPlan([f])):
+        c2k, rep = abft.her2k_ft(1.0, a, b, mesh, nb,
+                                 policy=FtPolicy.Correct)
+    r2k = np.asarray(a) @ np.asarray(b).T + np.asarray(b) @ np.asarray(a).T
+    herr = np.abs(np.asarray(c2k) - r2k).max() / np.abs(r2k).max()
+    check("her2k", rep.action == "corrected" and herr < 1e-12,
+          f"action={rep.action} err={herr:.3g}")
+
     # counters + RunReport
     ftv = ft_counter_values()
-    check("counters", ftv["detected"] >= 6 and ftv["corrected"] >= 4
+    check("counters", ftv["detected"] >= 7 and ftv["corrected"] >= 5
           and ftv["recomputed"] >= 1 and ftv["uncorrectable"] >= 1,
           f"ft counters {ftv}")
 
@@ -148,7 +161,7 @@ def run_smoke(out_dir: str, n: int = 64, nb: int = 8) -> int:
         rep_doc = json.load(fh)
     errs = report.validate_report(rep_doc)
     check("report", not errs, f"schema: {errs}")
-    check("report-ft", rep_doc.get("ft", {}).get("detected", 0) >= 6,
+    check("report-ft", rep_doc.get("ft", {}).get("detected", 0) >= 7,
           f"RunReport ft section {rep_doc.get('ft')}")
 
     if failures:
@@ -156,9 +169,9 @@ def run_smoke(out_dir: str, n: int = 64, nb: int = 8) -> int:
         for msg in failures:
             print(f"  FAIL {msg}")
         return 1
-    print(f"ft.smoke: OK — 4 op classes corrected (gemm/potrf/LU/trsm), "
-          f"recompute + FtError escalations verified; counters {ftv}; "
-          f"report {rep_path}")
+    print(f"ft.smoke: OK — 5 op classes corrected "
+          f"(gemm/potrf/LU/trsm/her2k), recompute + FtError escalations "
+          f"verified; counters {ftv}; report {rep_path}")
     return 0
 
 

@@ -61,6 +61,38 @@ _NUM_DEFAULT: List[Optional[str]] = [None]
 GROWTH_THRESHOLD = float(os.environ.get("SLATE_TPU_NUM_GROWTH_MAX", 2.0**20))
 CONDEST_THRESHOLD = float(os.environ.get("SLATE_TPU_NUM_COND_MAX", 1e7))
 
+
+class GrowthAbort(Exception):
+    """Structured mid-k-loop escalation (ROADMAP "close the control
+    loop", ISSUE 13 satellite): a monitored no-pivot LU's in-carry
+    running-growth gauge crossed ``GROWTH_THRESHOLD`` at a segment
+    boundary, so the checkpointed driver STOPPED the k-loop instead of
+    completing a garbage factor and discovering it at refinement time.
+    The caller retries with a pivoted factorization (tntpiv/pp);
+    ``serve.Router`` consumes it as exactly one retry
+    (``serve.retries``)."""
+
+    def __init__(self, op: str, growth: float, step: int, threshold: float):
+        self.op = op
+        self.growth = float(growth)
+        self.step = int(step)
+        self.threshold = float(threshold)
+        super().__init__(
+            f"num[{op}]: element growth {growth:.3g} crossed "
+            f"GROWTH_THRESHOLD {threshold:.3g} at k-loop step {step} — "
+            "factor aborted; retry with a pivoted method (tntpiv/pp)"
+        )
+
+
+def record_growth_abort(op: str, growth: float) -> None:
+    """Count one mid-loop growth abort (an alarm that ACTED — distinct
+    from ``num.growth_alarms``, which records post-hoc observations)."""
+    REGISTRY.counter_add("num.growth_aborts", 1.0, op=op)
+    with _lock:
+        _STATE["growth_aborts"] += 1
+        _STATE["lu_growth_max"] = max(_STATE["lu_growth_max"], float(growth))
+
+
 _lock = threading.Lock()
 # last recorded gauges per op — the routing ladder's read side
 _LAST: Dict[str, Dict[str, float]] = {}
@@ -75,6 +107,7 @@ _LAST_HISTORY: Dict[str, List] = {}
 _STATE = {
     "monitored": 0.0,          # monitored kernel executions
     "growth_alarms": 0.0,      # lu growth above GROWTH_THRESHOLD
+    "growth_aborts": 0.0,      # mid-k-loop aborts acted on the alarm
     "condest_alarms": 0.0,     # condest above CONDEST_THRESHOLD
     "routed_gmres": 0.0,       # auto-ladder entries routed past IR
     "condest_solves": 0.0,     # distributed condition estimates run

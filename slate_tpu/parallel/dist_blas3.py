@@ -442,6 +442,26 @@ def syr2k_dist(alpha, a, b, beta=0.0, c=None, uplo: Uplo = Uplo.Lower, full=Fals
                       lookahead=lookahead, bcast_impl=bcast_impl)
 
 
+def _her2k_panels(x_loc, k, p, q, k_true, conj):
+    """Step-k operand panels of the her2k/syr2k SUMMA schedule: the
+    stored column panel (rooted broadcast along 'q', true-k masked) and
+    its transposed gather along 'p'.  Module-level so the plain
+    ``_her2k_jit`` and the checksum-carrying ``ft/abft._ft_her2k_jit``
+    run the IDENTICAL broadcast schedule — the checksum tiles are just
+    more tiles of the augmented grid riding the same two collectives."""
+    mtl, _ktl, nb, _ = x_loc.shape
+    dtype = x_loc.dtype
+    xcol_own = lax.dynamic_slice_in_dim(x_loc, k // q, 1, axis=1)[:, 0]
+    xcol = bcast_from_col(xcol_own, k % q)
+    kmask = (k * nb + jnp.arange(nb)) < k_true
+    xcol = xcol * kmask[None, None, :].astype(dtype)
+    allpan = all_gather_a(xcol, ROW_AXIS, axis=0)
+    ntl_c = -(-(mtl * p) // q)
+    jc = lax.axis_index(COL_AXIS) + jnp.arange(ntl_c) * q
+    panT = allpan[jc % p, jc // p]
+    return xcol, (jnp.conj(panT) if conj else panT)
+
+
 @functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13, 14))
 def _her2k_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, k_true, uplo, conj,
                full, la=0, bi="psum"):
@@ -453,15 +473,7 @@ def _her2k_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, k_true, uplo, conj,
         r, c_, i_log, _ = local_indices(p, q, mtl, mtl)
 
         def panels(x_loc, k):
-            xcol_own = lax.dynamic_slice_in_dim(x_loc, k // q, 1, axis=1)[:, 0]
-            xcol = bcast_from_col(xcol_own, k % q)
-            kmask = (k * nb + jnp.arange(nb)) < k_true
-            xcol = xcol * kmask[None, None, :].astype(dtype)
-            allpan = all_gather_a(xcol, ROW_AXIS, axis=0)
-            ntl_c = -(-at.shape[0] // q)
-            jc = lax.axis_index(COL_AXIS) + jnp.arange(ntl_c) * q
-            panT = allpan[jc % p, jc // p]
-            return xcol, (jnp.conj(panT) if conj else panT)
+            return _her2k_panels(x_loc, k, p, q, k_true, conj)
 
         def fetch(k):
             return panels(a_loc, k), panels(b_loc, k)

@@ -152,6 +152,113 @@ def _apply_tree_tops(tops, treev_k, treet_k, k, p, nb, adjoint: bool):
     return tops[jnp.argsort(rot)]
 
 
+def _qr_panel_step(k, carry, p, q, m_true):
+    """One CAQR panel step of the strict schedule on the full local view
+    (carry = (tile stack, T_loc stack, tree-V stack, tree-T stack)).
+
+    Module-level so the fused ``_geqrf_jit`` loop and the checkpointed
+    segment chain (``ft/ckpt._qr_seg_jit``) run the IDENTICAL per-element
+    arithmetic — chained segments reproduce the fused kernel bitwise at
+    any boundary set (the dist_chol/_lu step-helper contract)."""
+    t_loc, tls, tvs, tts = carry
+    mtl, ntl, nb, _ = t_loc.shape
+    dtype = t_loc.dtype
+    nmerge = tvs.shape[1]
+    r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
+    mfl = mtl * nb
+    flat_gids = (i_log[:, None] * nb + jnp.arange(nb)[None, :]).reshape(-1)
+    kc = k // q
+    mine_c = c == k % q
+    row0, has_rows = _local_panel_geometry(k, r, p, mtl, nb)
+
+    # ---- local panel QR on my stacked valid rows ----
+    pcol = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)[:, 0]
+    flat = pcol.reshape(mfl, nb)
+    valid = (flat_gids >= k * nb) & (flat_gids < m_true)
+    masked = jnp.where((valid & mine_c)[:, None], flat, 0)
+    r_a, v, tau = _panel_qr_offset(masked, row0)
+    tl = _larft_v(v, tau)
+    # share the panel factors across 'q' so every column updates
+    r_a = bcast_from_col(jnp.where(mine_c, r_a, 0), k % q)
+    v = bcast_from_col(jnp.where(mine_c, v, 0), k % q)
+    tl = bcast_from_col(jnp.where(mine_c, tl, 0), k % q)
+
+    # ---- write packed V\R into the panel column ----
+    fr = jnp.arange(mfl)[:, None]
+    cj = jnp.arange(nb)[None, :]
+    packed = r_a + jnp.where(fr > row0 + cj, v, 0)
+    packed = jnp.where(valid[:, None], packed, flat)
+    t_loc = lax.dynamic_update_slice_in_dim(
+        t_loc,
+        jnp.where(mine_c, packed, flat).reshape(mtl, 1, nb, nb),
+        kc,
+        axis=1,
+    )
+
+    # ---- local trailing update: C -= V T^H (V^H C), cols > k ----
+    cflat = jnp.transpose(t_loc, (0, 2, 1, 3)).reshape(mfl, ntl * nb)
+    w1 = jnp.einsum("ri,rw->iw", jnp.conj(v), cflat, precision=PRECISE)
+    upd = jnp.einsum(
+        "ri,ij,jw->rw", v, jnp.conj(tl).T, w1, precision=PRECISE
+    ).astype(dtype)
+    colmask = jnp.repeat(j_log > k, nb)[None, :]
+    cflat = cflat - jnp.where(colmask, upd, 0)
+
+    # ---- tree merge of the per-row local R factors, in rotated
+    # participant order (diag owner = tree root) ----
+    rblk = lax.dynamic_slice(r_a, (row0, jnp.zeros_like(row0)), (nb, nb))
+    rblk = jnp.where(has_rows, jnp.triu(rblk), 0)
+    rs = all_gather_a(rblk, ROW_AXIS, axis=0)[_rot(k, p)]
+    tv = jnp.zeros((nmerge, 2 * nb, nb), dtype)
+    tt = jnp.zeros((nmerge, nb, nb), dtype)
+    for rnd, midl in zip(_tree_rounds(p), _merge_ids(p)):
+        for (root, partner), mid in zip(rnd, midl):
+            stack = jnp.concatenate([rs[root], rs[partner]], axis=0)
+            vr2, tau2 = _panel_qr(stack)
+            t2 = _larft(vr2, tau2)
+            tv = tv.at[mid].set(_v_of(vr2))
+            tt = tt.at[mid].set(t2)
+            rs = rs.at[root].set(jnp.triu(vr2[:nb]))
+
+    # ---- tree update on the gathered R-row slices of C (cols > k
+    # only: earlier columns hold finished R/V history) ----
+    myrow = lax.dynamic_slice(cflat, (row0, jnp.zeros_like(row0)), (nb, ntl * nb))
+    myrow0 = jnp.where(has_rows, myrow, 0)
+    tops = all_gather_a(myrow0, ROW_AXIS, axis=0)  # (p, nb, w)
+    tops = _apply_tree_tops(tops, tv, tt, k, p, nb, adjoint=True)
+    newrow = jnp.where(has_rows & colmask, tops[r], myrow)
+    cflat = lax.dynamic_update_slice(cflat, newrow, (row0, jnp.zeros_like(row0)))
+    t_loc = jnp.transpose(cflat.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
+    # the diag-owner row overwrites its R slot's upper triangle
+    # with the tree-final R (its V entries below stay)
+    final_r = rs[0]
+    mine_diag = (r == k % p) & mine_c
+    pcol2 = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)[:, 0]
+    pflat = pcol2.reshape(mfl, nb)
+    cur = lax.dynamic_slice(pflat, (row0, jnp.zeros_like(row0)), (nb, nb))
+    tri = jnp.arange(nb)[:, None] <= jnp.arange(nb)[None, :]
+    newblk = jnp.where(tri & mine_diag, final_r, cur)
+    pflat = lax.dynamic_update_slice(pflat, newblk, (row0, jnp.zeros_like(row0)))
+    t_loc = lax.dynamic_update_slice_in_dim(
+        t_loc, pflat.reshape(mtl, 1, nb, nb), kc, axis=1
+    )
+    return t_loc, tls.at[k].set(tl), tvs.at[k].set(tv), tts.at[k].set(tt)
+
+
+def _qr_pad_identity(t_loc, p, q, n_true, dtype):
+    """Identity on the padded diagonal so R solves stay nonsingular —
+    the fused kernel's exit computation, shared with the segment chain's
+    finalize jit (elementwise, hence bitwise at any boundary set)."""
+    mtl, ntl, nb, _ = t_loc.shape
+    _, _, i_log, j_log = local_indices(p, q, mtl, ntl)
+    diag_tiles = (i_log[:, None] == j_log[None, :])[:, :, None]
+    gd = i_log[:, None, None] * nb + jnp.arange(nb)[None, None, :]
+    padd = diag_tiles & (gd >= n_true)  # (mtl, ntl, nb)
+    ondiag = jnp.arange(nb)[:, None] == jnp.arange(nb)[None, :]
+    dmask = padd[:, :, :, None] & ondiag[None, None]
+    return jnp.where(dmask, jnp.ones((), dtype), t_loc)
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
 def _geqrf_jit(at, mesh, p, q, nt, m_true, n_true, bi):
     spec = P(ROW_AXIS, COL_AXIS)
@@ -160,88 +267,9 @@ def _geqrf_jit(at, mesh, p, q, nt, m_true, n_true, bi):
     def kernel(t_loc):
         mtl, ntl, nb, _ = t_loc.shape
         dtype = t_loc.dtype
-        r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
-        mfl = mtl * nb
-        flat_gids = (i_log[:, None] * nb + jnp.arange(nb)[None, :]).reshape(-1)
 
         def panel_step(k, carry):
-            t_loc, tls, tvs, tts = carry
-            kc = k // q
-            mine_c = c == k % q
-            row0, has_rows = _local_panel_geometry(k, r, p, mtl, nb)
-
-            # ---- local panel QR on my stacked valid rows ----
-            pcol = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)[:, 0]
-            flat = pcol.reshape(mfl, nb)
-            valid = (flat_gids >= k * nb) & (flat_gids < m_true)
-            masked = jnp.where((valid & mine_c)[:, None], flat, 0)
-            r_a, v, tau = _panel_qr_offset(masked, row0)
-            tl = _larft_v(v, tau)
-            # share the panel factors across 'q' so every column updates
-            r_a = bcast_from_col(jnp.where(mine_c, r_a, 0), k % q)
-            v = bcast_from_col(jnp.where(mine_c, v, 0), k % q)
-            tl = bcast_from_col(jnp.where(mine_c, tl, 0), k % q)
-
-            # ---- write packed V\R into the panel column ----
-            fr = jnp.arange(mfl)[:, None]
-            cj = jnp.arange(nb)[None, :]
-            packed = r_a + jnp.where(fr > row0 + cj, v, 0)
-            packed = jnp.where(valid[:, None], packed, flat)
-            t_loc = lax.dynamic_update_slice_in_dim(
-                t_loc,
-                jnp.where(mine_c, packed, flat).reshape(mtl, 1, nb, nb),
-                kc,
-                axis=1,
-            )
-
-            # ---- local trailing update: C -= V T^H (V^H C), cols > k ----
-            cflat = jnp.transpose(t_loc, (0, 2, 1, 3)).reshape(mfl, ntl * nb)
-            w1 = jnp.einsum("ri,rw->iw", jnp.conj(v), cflat, precision=PRECISE)
-            upd = jnp.einsum(
-                "ri,ij,jw->rw", v, jnp.conj(tl).T, w1, precision=PRECISE
-            ).astype(dtype)
-            colmask = jnp.repeat(j_log > k, nb)[None, :]
-            cflat = cflat - jnp.where(colmask, upd, 0)
-
-            # ---- tree merge of the per-row local R factors, in rotated
-            # participant order (diag owner = tree root) ----
-            rblk = lax.dynamic_slice(r_a, (row0, jnp.zeros_like(row0)), (nb, nb))
-            rblk = jnp.where(has_rows, jnp.triu(rblk), 0)
-            rs = all_gather_a(rblk, ROW_AXIS, axis=0)[_rot(k, p)]
-            tv = jnp.zeros((nmerge, 2 * nb, nb), dtype)
-            tt = jnp.zeros((nmerge, nb, nb), dtype)
-            for rnd, midl in zip(_tree_rounds(p), _merge_ids(p)):
-                for (root, partner), mid in zip(rnd, midl):
-                    stack = jnp.concatenate([rs[root], rs[partner]], axis=0)
-                    vr2, tau2 = _panel_qr(stack)
-                    t2 = _larft(vr2, tau2)
-                    tv = tv.at[mid].set(_v_of(vr2))
-                    tt = tt.at[mid].set(t2)
-                    rs = rs.at[root].set(jnp.triu(vr2[:nb]))
-
-            # ---- tree update on the gathered R-row slices of C (cols > k
-            # only: earlier columns hold finished R/V history) ----
-            myrow = lax.dynamic_slice(cflat, (row0, jnp.zeros_like(row0)), (nb, ntl * nb))
-            myrow0 = jnp.where(has_rows, myrow, 0)
-            tops = all_gather_a(myrow0, ROW_AXIS, axis=0)  # (p, nb, w)
-            tops = _apply_tree_tops(tops, tv, tt, k, p, nb, adjoint=True)
-            newrow = jnp.where(has_rows & colmask, tops[r], myrow)
-            cflat = lax.dynamic_update_slice(cflat, newrow, (row0, jnp.zeros_like(row0)))
-            t_loc = jnp.transpose(cflat.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
-            # the diag-owner row overwrites its R slot's upper triangle
-            # with the tree-final R (its V entries below stay)
-            final_r = rs[0]
-            mine_diag = (r == k % p) & mine_c
-            pcol2 = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)[:, 0]
-            pflat = pcol2.reshape(mfl, nb)
-            cur = lax.dynamic_slice(pflat, (row0, jnp.zeros_like(row0)), (nb, nb))
-            tri = jnp.arange(nb)[:, None] <= jnp.arange(nb)[None, :]
-            newblk = jnp.where(tri & mine_diag, final_r, cur)
-            pflat = lax.dynamic_update_slice(pflat, newblk, (row0, jnp.zeros_like(row0)))
-            t_loc = lax.dynamic_update_slice_in_dim(
-                t_loc, pflat.reshape(mtl, 1, nb, nb), kc, axis=1
-            )
-            return t_loc, tls.at[k].set(tl), tvs.at[k].set(tv), tts.at[k].set(tt)
+            return _qr_panel_step(k, carry, p, q, m_true)
 
         tls0 = jnp.zeros((nt, nb, nb), dtype)
         tvs0 = jnp.zeros((nt, nmerge, 2 * nb, nb), dtype)
@@ -250,13 +278,7 @@ def _geqrf_jit(at, mesh, p, q, nt, m_true, n_true, bi):
             t_loc, tls, tvs, tts = lax.fori_loop(
                 0, nt, panel_step, (t_loc, tls0, tvs0, tts0)
             )
-        # identity on the padded diagonal so R solves stay nonsingular
-        diag_tiles = (i_log[:, None] == j_log[None, :])[:, :, None]
-        gd = i_log[:, None, None] * nb + jnp.arange(nb)[None, None, :]
-        padd = diag_tiles & (gd >= n_true)  # (mtl, ntl, nb)
-        ondiag = jnp.arange(nb)[:, None] == jnp.arange(nb)[None, :]
-        dmask = padd[:, :, :, None] & ondiag[None, None]
-        t_loc = jnp.where(dmask, jnp.ones((), at.dtype), t_loc)
+        t_loc = _qr_pad_identity(t_loc, p, q, n_true, at.dtype)
         return t_loc, tls, tvs[None, None], tts[None, None]
 
     with bcast_impl_scope(bi):

@@ -85,6 +85,83 @@ def he2hb_dist(a: DistMatrix) -> DistTwoStage:
     return DistTwoStage(band, vs, ts, vs[:0], ts[:0])
 
 
+def _he2hb_step(k, carry, p, q, n_true, nb):
+    """One he2hb panel + two-sided trailing update of the strict schedule
+    on the full local FLAT view (carry = (a_flat, vq stack, tq stack)).
+
+    Module-level so the fused ``_he2hb_jit`` loop and the checkpointed
+    segment chain (``ft/ckpt._he2hb_seg_jit``) run the IDENTICAL
+    per-element arithmetic — chained segments reproduce the fused kernel
+    bitwise at any boundary set (the dist_chol/_lu step-helper
+    contract)."""
+    a, vqs, tqs = carry
+    mfl, nfl = a.shape
+    mtl, ntl = mfl // nb, nfl // nb
+    dtype = a.dtype
+    r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
+    rg = (i_log[:, None] * nb + jnp.arange(nb)[None, :]).reshape(-1)
+    cg = (j_log[:, None] * nb + jnp.arange(nb)[None, :]).reshape(-1)
+    mglob = mtl * p * nb
+    grows = jnp.arange(mglob)
+    j0 = k * nb
+    c0 = j0 + nb
+    kc, kr = k // q, k // p
+    mine_c, mine_r = c == k % q, r == k % p
+
+    # full panel column, global row order, replicated
+    pcol = lax.dynamic_slice(a, (0, kc * nb), (mfl, nb))
+    pcol = bcast_from_col(jnp.where(mine_c, pcol, 0), k % q)
+    gpan = _to_global_rows(pcol, p, nb, ROW_AXIS)
+    masked = jnp.where(((grows >= c0) & (grows < n_true))[:, None], gpan, 0)
+    r_a, v, tau = _panel_qr_offset(masked, c0)
+    t = _larft_v(v, tau)
+
+    # write [history above c0 | R; 0] into the panel column + mirror
+    newpan = jnp.where((grows >= c0)[:, None], r_a, gpan)
+    a = jnp.where(
+        mine_c,
+        lax.dynamic_update_slice(a, newpan[rg], (0, kc * nb)),
+        a,
+    )
+    rowblk = lax.dynamic_slice(a, (kr * nb, 0), (nb, nfl))
+    # mask the cg gather explicitly: on meshes where padded global
+    # cols exceed padded global rows, cg indexes past newpan's rows
+    # and JAX clamps silently — zero those tiles so pad stays zero
+    cg_ok = (cg < mglob)[:, None]
+    mirr = jnp.conj(jnp.where(cg_ok, newpan[jnp.minimum(cg, mglob - 1)], 0)).T
+    rowblk_new = jnp.where((cg >= c0)[None, :], mirr, rowblk)
+    a = jnp.where(
+        mine_r,
+        lax.dynamic_update_slice(a, rowblk_new, (kr * nb, 0)),
+        a,
+    )
+
+    # two-sided trailing update (he2hb.cc:207-604 algebra):
+    # Y = A V (local gemm + psum over 'q'), W~ replicated, then
+    # A -= W~ V^H + V W~^H on the local stack
+    v_rows = v[rg]
+    v_cols = jnp.where(cg_ok, v[jnp.minimum(cg, mglob - 1)], 0)
+    y_part = jnp.einsum("rc,ci->ri", a, v_cols, precision=PRECISE)
+    y = psum_a(y_part, COL_AXIS)
+    y = jnp.where((rg >= c0)[:, None], y, 0).astype(dtype)
+    yg = _to_global_rows(y, p, nb, ROW_AXIS)
+    wmat = jnp.einsum("ri,ij->rj", yg, t, precision=PRECISE)
+    x = jnp.einsum(
+        "ji,jk->ik", jnp.conj(t),
+        jnp.einsum("ri,rj->ij", jnp.conj(v), wmat, precision=PRECISE),
+        precision=PRECISE,
+    )
+    wt = (wmat - 0.5 * jnp.einsum("ri,ij->rj", v, x, precision=PRECISE)).astype(dtype)
+    wt_rows = wt[rg]
+    wt_cols = jnp.where(cg_ok, wt[jnp.minimum(cg, mglob - 1)], 0)
+    upd = jnp.einsum("ri,ci->rc", wt_rows, jnp.conj(v_cols), precision=PRECISE)
+    upd = upd + jnp.einsum(
+        "ri,ci->rc", v_rows, jnp.conj(wt_cols), precision=PRECISE
+    )
+    a = a - upd.astype(dtype)
+    return a, vqs.at[k].set(v[rg]), tqs.at[k].set(t)
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
 def _he2hb_jit(at, mesh, p, q, n_true, nb, nsteps):
     spec = P(ROW_AXIS, COL_AXIS)
@@ -92,73 +169,11 @@ def _he2hb_jit(at, mesh, p, q, n_true, nb, nsteps):
     def kernel(t_loc):
         mtl, ntl, _, _ = t_loc.shape
         dtype = t_loc.dtype
-        r, c, i_log, j_log = local_indices(p, q, mtl, ntl)
         mfl, nfl = mtl * nb, ntl * nb
-        rg = (i_log[:, None] * nb + jnp.arange(nb)[None, :]).reshape(-1)
-        cg = (j_log[:, None] * nb + jnp.arange(nb)[None, :]).reshape(-1)
         a = jnp.transpose(t_loc, (0, 2, 1, 3)).reshape(mfl, nfl)
-        mglob = mtl * p * nb
-        grows = jnp.arange(mglob)
 
         def step(k, carry):
-            a, vqs, tqs = carry
-            j0 = k * nb
-            c0 = j0 + nb
-            kc, kr = k // q, k // p
-            mine_c, mine_r = c == k % q, r == k % p
-
-            # full panel column, global row order, replicated
-            pcol = lax.dynamic_slice(a, (0, kc * nb), (mfl, nb))
-            pcol = bcast_from_col(jnp.where(mine_c, pcol, 0), k % q)
-            gpan = _to_global_rows(pcol, p, nb, ROW_AXIS)
-            masked = jnp.where(((grows >= c0) & (grows < n_true))[:, None], gpan, 0)
-            r_a, v, tau = _panel_qr_offset(masked, c0)
-            t = _larft_v(v, tau)
-
-            # write [history above c0 | R; 0] into the panel column + mirror
-            newpan = jnp.where((grows >= c0)[:, None], r_a, gpan)
-            a = jnp.where(
-                mine_c,
-                lax.dynamic_update_slice(a, newpan[rg], (0, kc * nb)),
-                a,
-            )
-            rowblk = lax.dynamic_slice(a, (kr * nb, 0), (nb, nfl))
-            # mask the cg gather explicitly: on meshes where padded global
-            # cols exceed padded global rows, cg indexes past newpan's rows
-            # and JAX clamps silently — zero those tiles so pad stays zero
-            cg_ok = (cg < mglob)[:, None]
-            mirr = jnp.conj(jnp.where(cg_ok, newpan[jnp.minimum(cg, mglob - 1)], 0)).T
-            rowblk_new = jnp.where((cg >= c0)[None, :], mirr, rowblk)
-            a = jnp.where(
-                mine_r,
-                lax.dynamic_update_slice(a, rowblk_new, (kr * nb, 0)),
-                a,
-            )
-
-            # two-sided trailing update (he2hb.cc:207-604 algebra):
-            # Y = A V (local gemm + psum over 'q'), W~ replicated, then
-            # A -= W~ V^H + V W~^H on the local stack
-            v_rows = v[rg]
-            v_cols = jnp.where(cg_ok, v[jnp.minimum(cg, mglob - 1)], 0)
-            y_part = jnp.einsum("rc,ci->ri", a, v_cols, precision=PRECISE)
-            y = psum_a(y_part, COL_AXIS)
-            y = jnp.where((rg >= c0)[:, None], y, 0).astype(dtype)
-            yg = _to_global_rows(y, p, nb, ROW_AXIS)
-            wmat = jnp.einsum("ri,ij->rj", yg, t, precision=PRECISE)
-            x = jnp.einsum(
-                "ji,jk->ik", jnp.conj(t),
-                jnp.einsum("ri,rj->ij", jnp.conj(v), wmat, precision=PRECISE),
-                precision=PRECISE,
-            )
-            wt = (wmat - 0.5 * jnp.einsum("ri,ij->rj", v, x, precision=PRECISE)).astype(dtype)
-            wt_rows = wt[rg]
-            wt_cols = jnp.where(cg_ok, wt[jnp.minimum(cg, mglob - 1)], 0)
-            upd = jnp.einsum("ri,ci->rc", wt_rows, jnp.conj(v_cols), precision=PRECISE)
-            upd = upd + jnp.einsum(
-                "ri,ci->rc", v_rows, jnp.conj(wt_cols), precision=PRECISE
-            )
-            a = a - upd.astype(dtype)
-            return a, vqs.at[k].set(v[rg]), tqs.at[k].set(t)
+            return _he2hb_step(k, carry, p, q, n_true, nb)
 
         vqs0 = jnp.zeros((max(nsteps, 1), mfl, nb), dtype)
         tqs0 = jnp.zeros((max(nsteps, 1), nb, nb), dtype)

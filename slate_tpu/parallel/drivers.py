@@ -247,7 +247,16 @@ def geqrf_mesh(
     opts: Optional[Options] = None,
 ):
     """Distributed CAQR factorization (src/geqrf.cc). Returns DistQR.
-    ``opts`` carries Option.BcastImpl (panel-broadcast lowering)."""
+    ``opts`` carries Option.BcastImpl (panel-broadcast lowering) and
+    Option.Checkpoint (ISSUE 13: the multi-array carry — tile stack +
+    T_loc stack + tree V/T stacks — snapshots every K panel steps; off
+    keeps the fused kernel untouched, trace-identical)."""
+    every = _ckpt_every(opts)
+    if every is not None:
+        from ..ft.ckpt import geqrf_ckpt
+
+        return geqrf_ckpt(from_dense(a, mesh, nb), every=every,
+                          bcast_impl=_bi(opts))
     return geqrf_dist(from_dense(a, mesh, nb), bcast_impl=_bi(opts))
 
 
@@ -309,7 +318,17 @@ def heev_mesh(
 
     n = a.shape[0]
     cplx = jnp.issubdtype(a.dtype, jnp.complexfloating)
-    f = he2hb_dist(from_dense(a, mesh, nb))
+    every = _ckpt_every(opts)
+    if every is not None:
+        # Option.Checkpoint covers the O(n^3) stage-1 reduction — the
+        # eig chain's preemption exposure; the later stages are O(n^2 nb)
+        # or run on an O(n nb) frame (ISSUE 13)
+        from ..ft.ckpt import he2hb_ckpt
+
+        f = he2hb_ckpt(from_dense(a, mesh, nb), every=every,
+                       bcast_impl=_bi(opts))
+    else:
+        f = he2hb_dist(from_dense(a, mesh, nb))
     bandd = gather_diagband(f.band, nb)  # (n, 4nb) replicated, O(n nb)
     # the distributed two-sided update is Hermitian in exact arithmetic;
     # shave the O(eps * nsteps) rounding asymmetry before the band chase
@@ -367,6 +386,32 @@ def svd_mesh(
     v = chase_apply_dist(f2.rvs, f2.rtaus, pv[:, None] * vb.astype(dtype), n, nb, mesh)
     vd = unmbr_ge2tb_v_dist(f, from_dense(v, mesh, nb))
     return to_dense(ud), s, jnp.conj(to_dense(vd)).T
+
+
+@instrument("her2k_mesh")
+def her2k_mesh(
+    alpha, a: jax.Array, b: jax.Array, mesh: Mesh, nb: int = _DEFAULT_NB,
+    beta=0.0, c: Optional[jax.Array] = None, conj: bool = True,
+    opts: Optional[Options] = None,
+) -> jax.Array:
+    """Distributed rank-2k update C = alpha A op(B) + op(alpha) B op(A)
+    + beta C (conj=True: her2k, src/her2k.cc; conj=False: syr2k),
+    returned FULL (both triangles).  Option.FaultTolerance reroutes to
+    the checksum-carrying her2k (ft/abft.py, ISSUE 13) — the eig
+    chain's dominant trailing-update op gains the same inject→detect→
+    repair coverage as gemm/potrf/LU/trsm."""
+    from .dist_blas3 import her2k_dist
+
+    if _ft_on(opts):
+        from ..ft.abft import her2k_mesh_ft
+
+        return her2k_mesh_ft(alpha, a, b, mesh, nb, beta, c, conj, opts)
+    ad = from_dense(a, mesh, nb)
+    bd = from_dense(b, mesh, nb)
+    cd = from_dense(c, mesh, nb) if c is not None else None
+    out = her2k_dist(alpha, ad, bd, beta, cd, conj=conj, full=True,
+                     lookahead=_la(opts), bcast_impl=_bi(opts))
+    return to_dense(out)[: a.shape[0], : a.shape[0]]
 
 
 @instrument("getrf_tntpiv_mesh")

@@ -289,6 +289,8 @@ class Router:
         from ..ft import ckpt as _ckpt
         from ..ft.policy import FtError, FtPolicy, resolve_policy
 
+        from ..obs.numerics import GrowthAbort
+
         pol = resolve_policy(self.opts)
         try:
             return self._guard(op, a, b, *self._factor_solve_mesh(
@@ -309,6 +311,12 @@ class Router:
                 raise SlateError(
                     f"serve: {op} request re-preempted on resume at step "
                     f"{e2.killed_at} — rejected") from e2
+            except GrowthAbort:
+                # the RESUMED no-pivot factor kept policing the gauge
+                # (Checkpoint.growth_abort) and aborted: same escalation
+                # as the uninterrupted abort — one pivoted retry
+                serve_count("retries")
+                return self._guard(op, a, b, *self._factor_solve_pp(op, a, b))
         except FtError:
             # transient-SDC class: one retry under the recompute policy;
             # a second FtError (persistent corruption) surfaces raw
@@ -377,11 +385,51 @@ class Router:
             l, info = potrf_ckpt(d, every=every, bcast_impl=bi,
                                  panel_impl=pi, num_monitor=nm)
             return self._trsm_solve(op, l, b), info
-        # gesv keeps partial pivoting on the checkpointed path (the
-        # reference's default getrf — no accuracy class downgrade)
-        lu, perm, info = getrf_pp_ckpt(d, every=every, bcast_impl=bi,
-                                       num_monitor=nm)
-        return self._trsm_solve(op, lu, b, perm=perm), info
+        # gesv on the checkpointed path: with NumMonitor armed, try the
+        # cheap no-pivot factor first — the FRIENDLY accuracy class the
+        # batched router already serves (PR 11's condest-keyed nopiv+IR
+        # dispatch), here policed by the segment chain's in-carry growth
+        # gauge instead of a condest probe: element growth crossing
+        # GROWTH_THRESHOLD ABORTS the factor mid-k-loop
+        # (obs.numerics.GrowthAbort, ISSUE 13 satellite: never complete
+        # a garbage factor) and the router consumes that as exactly one
+        # retry with partial pivoting (``serve.retries``).  Served
+        # growth below the threshold bounds the nopiv backward error at
+        # ~GROWTH_THRESHOLD·eps64 ≈ 2e-10 — the friendly-class bar —
+        # and _guard's residual gate backstops every served solution.
+        # The class mix is observable: gauge-policed nopiv serves count
+        # ``serve.class_friendly``, pp serves ``serve.class_hostile``.
+        # Unmonitored requests keep partial pivoting outright — no
+        # class downgrade without the gauge that polices it.
+        from ..obs.numerics import GrowthAbort, resolve_num_monitor
+
+        if resolve_num_monitor(nm) == "on":
+            from ..ft.ckpt import getrf_nopiv_ckpt
+
+            try:
+                lu, info = getrf_nopiv_ckpt(
+                    d, every=every, bcast_impl=bi, panel_impl=pi,
+                    num_monitor=nm)
+                serve_count("class_friendly")
+                return self._trsm_solve(op, lu, b), info
+            except GrowthAbort:
+                serve_count("retries")
+        return self._factor_solve_pp(op, b_dense=b, d=d)
+
+    def _factor_solve_pp(self, op: str, a=None, b_dense=None, d=None):
+        """The pivoted gesv tier (shared by the growth-abort retry paths:
+        the initial attempt hands over its DistMatrix, the resumed-abort
+        path re-encodes from the dense operand)."""
+        from ..ft.ckpt import getrf_pp_ckpt
+        from ..parallel.dist import from_dense
+
+        _la, bi, _pi, nm = self._resil_opts()
+        if d is None:
+            d = from_dense(a, self.mesh, self.nb, diag_pad_one=True)
+        lu, perm, info = getrf_pp_ckpt(d, every=self._ckpt_every(),
+                                       bcast_impl=bi, num_monitor=nm)
+        serve_count("class_hostile")
+        return self._trsm_solve(op, lu, b_dense, perm=perm), info
 
     def _resume_solve(self, op: str, b, checkpoint):
         from ..ft import elastic

@@ -295,17 +295,26 @@ class Option(enum.Enum):
     # direct driver calls never read the table.
     AutoTune = "auto_tune"
     # Checkpoint interval for the mesh factorization k-loops (ft/ckpt.py):
-    # an int K snapshots the k-loop carry (factored panels + trailing
-    # block + NumMonitor gauges + pivot permutation) to host every K
-    # steps, so a preempted multi-minute factorization resumes from the
-    # last snapshot — on the SAME mesh bitwise-identically, or on a
-    # RESHAPED p' x q' mesh via block-cyclic redistribution
-    # (ft/elastic.py) — instead of restarting from zero.  Off / absent /
-    # 0 (the default) routes to the plain fused kernels untouched:
-    # trace-identical, zero overhead.  Resolution order: explicit option
-    # > SLATE_TPU_CKPT environment > off.  No reference analogue: SLATE
-    # delegates preemption survival to the MPI checkpoint layer; under
-    # XLA/SPMD the natural snapshot unit is the k-loop carry itself.
+    # an int K snapshots the k-loop carry to host every K steps, so a
+    # preempted multi-minute factorization resumes from the last
+    # snapshot instead of restarting from zero.  Covered loops: potrf /
+    # LU-nopiv / partial-pivot LU (single tile-stack carry + NumMonitor
+    # gauges + pivot permutation; resume bitwise on the SAME mesh or a
+    # RESHAPED p' x q' mesh via block-cyclic redistribution,
+    # ft/elastic.py) and — ISSUE 13 — the distributed CAQR (geqrf) and
+    # two-stage eig stage-1 reduction (he2hb), whose MULTI-ARRAY carries
+    # (tile stack + T-factor / reflector / tree stacks) resume bitwise
+    # on the same (p, q) grid shape only: the auxiliary arrays are
+    # grid-locked and a reshaped resume is refused with a structured
+    # error.  Snapshots are sync by default; SLATE_TPU_CKPT_ASYNC=1 (or
+    # the drivers' async_snapshots=True) overlaps the device->host carry
+    # copy with the next segment's dispatch, bitwise-equal either way.
+    # Off / absent / 0 (the default) routes to the plain fused kernels
+    # untouched: trace-identical, zero overhead.  Resolution order:
+    # explicit option > SLATE_TPU_CKPT environment > off.  No reference
+    # analogue: SLATE delegates preemption survival to the MPI
+    # checkpoint layer; under XLA/SPMD the natural snapshot unit is the
+    # k-loop carry itself.
     Checkpoint = "checkpoint"
     # Residual lowering for the mixed-precision refinement loop: "f64"
     # (plain SUMMA at the data dtype — XLA's emulated-f64 pairs on TPU),

@@ -471,3 +471,73 @@ def test_redistribute_shardmap_wire_volume():
     # the eager path's full replicated grid
     n_pp = sum(1 for op, _, _ in recs if op.startswith("ppermute"))
     assert n_pp == p * q - 1
+
+
+@pytest.mark.parametrize("impl", ["psum", "ring"])
+def test_ft_her2k_checksum_broadcast_volume(impl):
+    """ISSUE 13 satellite: her2k_ft's checksum traffic is proven, not
+    estimated.  The checksum-carrying her2k runs dist_blas3's schedule
+    verbatim (the shared ``_her2k_panels`` fetch: per step, per operand,
+    one rooted column-panel broadcast along 'q' + one transposed
+    all_gather along 'p') — the checksum tiles are just more tiles of
+    the row-augmented operands, so the audited delta against the plain
+    kernel is EXACTLY the augmentation rows (2 checksum + lcm pad) on
+    both collectives, for both operands, under either lowering.  Traces
+    only (make_jaxpr): audit records are a trace-time surface, so no
+    kernels execute and no jit caches are cleared."""
+    import math
+
+    import jax.numpy as jnp
+
+    from slate_tpu.ft import abft, inject
+    from slate_tpu.parallel import make_mesh
+    from slate_tpu.parallel.dist import from_dense
+    from slate_tpu.parallel.dist_blas3 import _her2k_jit
+    from slate_tpu.types import Uplo
+
+    p, q, n, nb = 2, 4, 64, 8
+    mesh = make_mesh(p, q, devices=jax.devices("cpu")[:8])
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((n, n)))
+    b = jnp.asarray(rng.standard_normal((n, n)))
+    mt = kt = n // nb
+    lcm = math.lcm(p, q)
+    aug = ((mt + 2 + lcm - 1) // lcm) * lcm  # +2 checksum rows, re-padded
+    mtl, mtl_aug = mt // p, aug // p
+    itemsize = 8  # f64
+    pan = nb * nb * itemsize  # one tile of a column panel
+
+    def totals(recs):
+        by_op = {}
+        for op, nbytes, m in recs:
+            by_op[op] = by_op.get(op, 0) + nbytes * m
+        return by_op
+
+    ad, bd = from_dense(a, mesh, nb), from_dense(b, mesh, nb)
+    with comm_audit() as plain_recs:
+        jax.make_jaxpr(lambda x, y: _her2k_jit(
+            x, y, None, 1.0, 0.0, mesh, p, q, kt, n, Uplo.Lower, True,
+            True, 0, impl))(ad.tiles, bd.tiles)
+    a_aug, b_aug, _c, mt_, kt_ = abft._encode_her2k(a, b, None, nb, mesh)
+    assert (mt_, kt_) == (mt, kt)
+    fi, fv = inject.spec_arrays("her2k")
+    adx, bdx = from_dense(a_aug, mesh, nb), from_dense(b_aug, mesh, nb)
+    with comm_audit() as ft_recs:
+        jax.make_jaxpr(lambda x, y, i, v: abft._ft_her2k_jit(
+            x, y, None, 1.0, 0.0, mesh, p, q, kt, n, True, 0, impl,
+            i, v))(adx.tiles, bdx.tiles, jnp.asarray(fi), jnp.asarray(fv))
+
+    plain, ft = totals(plain_recs), totals(ft_recs)
+    # the transposed gather along 'p' is impl-independent payload bytes
+    delta_rows = mtl_aug - mtl
+    assert ft["all_gather[p]"] - plain["all_gather[p]"] == \
+        kt * 2 * delta_rows * pan
+    if impl == "psum":
+        assert set(ft) == {"psum[q]", "all_gather[p]"}
+        assert ft["psum[q]"] == kt * 2 * mtl_aug * pan
+        assert ft["psum[q]"] - plain["psum[q]"] == kt * 2 * delta_rows * pan
+    else:
+        assert set(ft) == {"ppermute[q]", "all_gather[p]"}
+        assert ft["ppermute[q]"] == kt * 2 * (q - 1) * mtl_aug * pan
+        assert ft["ppermute[q]"] - plain["ppermute[q]"] == \
+            kt * 2 * (q - 1) * delta_rows * pan

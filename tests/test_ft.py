@@ -380,3 +380,71 @@ def test_trsm_abft_detect_correct_recompute(rng):
     )])):
         with pytest.raises(FtError):
             abft.trsm_ft(tl, b, mesh, NB, policy=FtPolicy.Detect)
+
+
+def test_her2k_abft_off_bitwise_and_clean(rng):
+    """her2k_ft (ISSUE 13): policy Off is bitwise the plain full her2k;
+    a clean protected run is quiet and matches the dense reference."""
+    from slate_tpu.parallel import from_dense
+    from slate_tpu.parallel.dist_blas3 import her2k_dist
+
+    mesh = mesh24()
+    a, b = _rand(rng, N, N), _rand(rng, N, N)
+    off, rep0 = abft.her2k_ft(1.0, a, b, mesh, NB, policy=FtPolicy.Off)
+    plain = to_dense(her2k_dist(
+        1.0, from_dense(a, mesh, NB), from_dense(b, mesh, NB), full=True
+    ))[:N, :N]
+    assert rep0.clean
+    np.testing.assert_array_equal(np.asarray(off), np.asarray(plain))
+
+    c, rep = abft.her2k_ft(1.0, a, b, mesh, NB, policy=FtPolicy.Detect)
+    ref = np.asarray(a) @ np.asarray(b).T + np.asarray(b) @ np.asarray(a).T
+    assert rep.clean
+    assert np.abs(np.asarray(c) - ref).max() / np.abs(ref).max() < 1e-12
+
+
+def test_her2k_abft_inject_detect_repair(rng):
+    """Injected accumulator damage is final data — exactly correctable
+    from the carried checksums (the GEMM repair class); a received-panel
+    (bcast) fault lands clean through repair-or-recompute; the detect
+    policy fail-stops; counters move."""
+    mesh = mesh24()
+    a, b = _rand(rng, N, N), _rand(rng, N, N)
+    ref = np.asarray(a) @ np.asarray(b).T + np.asarray(b) @ np.asarray(a).T
+
+    def err(x):
+        return np.abs(np.asarray(x) - ref).max() / np.abs(ref).max()
+
+    before = ft_counter_values()
+    trail = Fault("her2k", k=NT - 1, phase="trailing", ti=3, tj=1,
+                  r=3 % GRID[0], c=1 % GRID[1], mode=inject.MODE_SCALE,
+                  value=3.0)
+    with fault_scope(FaultPlan([trail])):
+        c1, rep1 = abft.her2k_ft(1.0, a, b, mesh, NB,
+                                 policy=FtPolicy.Correct)
+    assert rep1.action == "corrected" and err(c1) < 1e-12
+
+    bc = Fault("her2k", k=2, phase="bcast", ti=4, tj=2, r=4 % GRID[0],
+               c=1, mode=inject.MODE_SCALE, value=3.0)
+    with fault_scope(FaultPlan([bc])):
+        c2, rep2 = abft.her2k_ft(1.0, a, b, mesh, NB,
+                                 policy=FtPolicy.Correct)
+    assert rep2.action in ("corrected", "recomputed") and err(c2) < 1e-12
+
+    with fault_scope(FaultPlan([Fault(
+        "her2k", k=1, phase="trailing", ti=5, tj=2, r=5 % GRID[0],
+        c=2 % GRID[1], mode=inject.MODE_SCALE, value=2.0,
+    )])):
+        with pytest.raises(FtError):
+            abft.her2k_ft(1.0, a, b, mesh, NB, policy=FtPolicy.Detect)
+    after = ft_counter_values()
+    assert after["detected"] >= before["detected"] + 3
+    assert after["corrected"] > before["corrected"]
+
+    # beta C rides the augmented accumulator consistently (linearity)
+    c0 = _spd(rng, N)
+    cc, repc = abft.her2k_ft(1.0, a, b, mesh, NB, beta=0.5, c=c0,
+                             policy=FtPolicy.Detect)
+    refc = ref + 0.5 * np.asarray(c0)
+    assert repc.clean
+    assert np.abs(np.asarray(cc) - refc).max() / np.abs(refc).max() < 1e-12

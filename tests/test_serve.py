@@ -452,3 +452,32 @@ def test_router_rejects_unresumable_preemption(rng):
             router.solve("posv", a, b)
     after = serve_metrics.serve_counter_values()["admission_rejects"]
     assert after == before + 2
+
+
+def test_router_growth_abort_retries_with_pivoting(rng):
+    """ISSUE 13 satellite (ROADMAP "close the control loop"): on the
+    monitored checkpointed path, gesv tries the cheap no-pivot factor
+    first; a mid-k-loop GrowthAbort escalates to partial pivoting as
+    exactly one retry (serve.retries), and a healthy operand stays on
+    the no-pivot fast path with zero retries."""
+    router = _resilient_router({Option.Checkpoint: 3,
+                                Option.NumMonitor: "on"})
+    n = 64
+    g = rng.standard_normal((n, n)) + n * np.eye(n)
+    g[0, 0] = 1e-9  # tiny leading pivot: nopiv growth explodes; pp swaps
+    a = jnp.asarray(g)
+    b = jnp.asarray(rng.standard_normal((n, 2)))
+    before = serve_metrics.serve_counter_values()["retries"]
+    x = router.solve("gesv", a, b)
+    after = serve_metrics.serve_counter_values()["retries"]
+    assert after == before + 1
+    resid = np.abs(np.asarray(a) @ np.asarray(x) - np.asarray(b)).max()
+    assert resid < 1e-8
+
+    good = jnp.asarray(rng.standard_normal((n, n)) + n * np.eye(n))
+    before = serve_metrics.serve_counter_values()["retries"]
+    x2 = router.solve("gesv", good, b)
+    after = serve_metrics.serve_counter_values()["retries"]
+    assert after == before
+    resid2 = np.abs(np.asarray(good) @ np.asarray(x2) - np.asarray(b)).max()
+    assert resid2 < 1e-8
