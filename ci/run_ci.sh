@@ -124,10 +124,34 @@ python -m slate_tpu.obs.report --check \
 python -m slate_tpu.serve.smoke --out artifacts/serve_ci
 SLATE_TPU_BCAST_IMPL=ring python -m slate_tpu.serve.smoke \
     --out artifacts/serve_ci_ring
+# (the serve section now carries the SLA latency quantiles too — wall
+# clock, so this gate ignores them exactly like the SLA gate below and
+# keeps only the machine-independent counts tight)
 python -m slate_tpu.obs.report --check \
     artifacts/serve_ci/serve.report.json \
     artifacts/obs/serve.report.json \
-    --ignore 'serve.*_runtime_*'
+    --ignore 'serve.*_runtime_*' --ignore '*latency*_s'
+
+# request-level SLA gate (ISSUE 14): the smoke's SLA phase drove a
+# deterministic meshless request stream through the Router; its
+# serve_sla.report.json carries the latency histogram reductions +
+# outcome-attribution totals/rates.  The quantiles are wall clock
+# (--ignore '*latency*_s'); the shape/count/rate keys — per-class
+# histogram counts, outcome counts, outcome rates — are
+# machine-independent under the fixed stream and gate tight against the
+# committed reference under BOTH lowerings (the stream is meshless, so
+# ring must reproduce the counts exactly).  serve.stats then formats
+# the fresh artifact as Prometheus text — the export-surface smoke.
+python -m slate_tpu.obs.report --check \
+    artifacts/serve_ci/serve_sla.report.json \
+    artifacts/obs/serve_sla.report.json \
+    --ignore '*latency*_s'
+python -m slate_tpu.obs.report --check \
+    artifacts/serve_ci_ring/serve_sla.report.json \
+    artifacts/obs/serve_sla.report.json \
+    --ignore '*latency*_s'
+python -m slate_tpu.serve.stats artifacts/serve_ci/serve_sla.report.json \
+    > /dev/null
 
 # scaling-curve artifact (ISSUE 7 satellite): fold the MULTICHIP round
 # artifacts into one RunReport-schema curve and schema-validate it

@@ -1042,6 +1042,32 @@ def _posv_packed(ctx):
     return fn, (a1, a2)
 
 
+@register("posv_batched_traced", tags=("serve",))
+def _posv_batched_traced(ctx):
+    """The Router's stacked dispatch under an ARMED RequestTrace (ISSUE
+    14): the request tracer is host-side only — phase spans, outcome
+    accounting and the latency histogram live outside the jaxpr — so
+    the traced program must be the plain batched driver with NO new
+    collectives (the NumMonitor zero-extra-bytes contract's serving
+    sibling; tests/test_serve.py additionally asserts jaxpr identity
+    traced-vs-untraced)."""
+    from .. import obs
+    from ..serve import trace as serve_trace
+    from ..serve.batch import posv_batched
+
+    a, b = _serve_stack(ctx, "spd"), _serve_rhs(ctx)
+
+    def fn(x, y):
+        with obs.force_enabled():
+            tr = serve_trace.new_trace("posv", x.shape[1], NB, str(x.dtype))
+            with serve_trace.phase(tr, "solve"):
+                out = posv_batched(x, y)
+            serve_trace.finish(tr, "served")
+        return out
+
+    return fn, (a, b)
+
+
 @register("gemm_summa_ozaki_presplit", tags=("serve", "mixed"))
 def _gemm_ozaki_presplit(ctx):
     """The stationary-A Ozaki SUMMA: digit planes enter as operands
@@ -1134,6 +1160,27 @@ def _geqrf_ckpt_seg(ctx):
     return (lambda t, x, y, z: ckpt._qr_seg_jit(
         t, x, y, z, ctx.mesh, ctx.p, ctx.q, N, 1, a.nt, "auto")), \
         (a.tiles, st["tls"], st["tvs"], st["tts"])
+
+
+@register("geqrf_ckpt_seg_num", tags=("ckpt", "num"))
+def _geqrf_ckpt_seg_num(ctx):
+    """The MONITORED CAQR segment (ISSUE 14 satellite): the same panel
+    steps with the in-carry reflector/τ orthogonality-loss gauge —
+    results bitwise, the only reduction the unaudited exit pmax (the
+    _lu_info_dist class), so the audited wire bytes match the plain
+    ``geqrf_ckpt_seg`` exactly."""
+    import jax.numpy as jnp
+
+    from ..ft import ckpt
+    from ..parallel.comm import num_gauge_dtype
+
+    a = ctx.dist()
+    st = {}
+    ckpt._multi_init("geqrf", a, st, a.nt)
+    g0 = jnp.zeros((), num_gauge_dtype(a.dtype))
+    return (lambda t, x, y, z, g: ckpt._qr_seg_nm_jit(
+        t, x, y, z, g, ctx.mesh, ctx.p, ctx.q, N, 1, a.nt, "auto")), \
+        (a.tiles, st["tls"], st["tvs"], st["tts"], g0)
 
 
 @register("he2hb_ckpt_seg", tags=("ckpt",))

@@ -611,6 +611,47 @@ def _qr_seg_jit(at, tls, tvs, tts, mesh, p, q, m_true, k0, k1, bi):
         )(at, tls, tvs, tts)
 
 
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _qr_seg_nm_jit(at, tls, tvs, tts, g, mesh, p, q, m_true, k0, k1, bi):
+    """The MONITORED twin of ``_qr_seg_jit`` (ISSUE 14 satellite; the
+    ROADMAP "NumMonitor gauges through the QR/eig segment chains" item):
+    the same ``dist_qr._qr_panel_step`` arithmetic — tile/T/tree results
+    stay bitwise-identical to the plain chain — with the per-panel
+    reflector/τ consistency margin (``dist_qr._qr_orth_loss``) carried
+    as a running max.  The gauge is LOCAL per mesh row (T was built from
+    this row's V), so the only reduction is the unaudited exit pmax —
+    the ``_lu_info_dist`` class: comm-audit wire bytes are unchanged.
+    The off mode never calls this jit, so the unmonitored chain's jaxpr
+    is untouched by construction."""
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(t_loc, tl_loc, tv, tt, g_in):
+        rdt = num_gauge_dtype(t_loc.dtype)
+
+        def step(k, carry):
+            *st4, gg = carry
+            out4, loss = _qr_panel_step(k, tuple(st4), p, q, m_true,
+                                        nm=True)
+            return out4 + (jnp.maximum(gg, loss),)
+
+        with audit_scope(k1 - k0):
+            t_loc, tl_loc, tv, tt, gg = lax.fori_loop(
+                k0, k1, step, (t_loc, tl_loc, tv, tt, g_in.astype(rdt)))
+        # exact max fold: seeding the next segment with the reduced
+        # partial is bitwise (the potrf/LU segment-gauge contract)
+        gg = lax.pmax(lax.pmax(gg, ROW_AXIS), COL_AXIS)
+        return t_loc, tl_loc, tv, tt, gg[None, None]
+
+    with bcast_impl_scope(bi):
+        t, tls, tvs, tts, g_out = shard_map_compat(
+            kernel, mesh=mesh,
+            in_specs=(spec, P(ROW_AXIS), P(), P(), P()),
+            out_specs=(spec, P(ROW_AXIS), P(), P(),
+                       P(ROW_AXIS, COL_AXIS)), check_vma=False,
+        )(at, tls, tvs, tts, g)
+    return t, tls, tvs, tts, jnp.max(g_out)
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
 def _qr_fin_jit(at, mesh, p, q, n_true):
     """The fused CAQR kernel's exit computation (identity on the padded
@@ -671,10 +712,16 @@ def _seg_dispatch(op, st, mesh, p, q, nt, m_true, k0, k1, bi, pi, nm):
             st["tiles"], st["rowperm"], st["g"], mesh, p, q, nt, m_true,
             k0, k1, bi, nm)
     elif op == "geqrf":
-        st["tiles"], st["tls"], st["tvs"], st["tts"] = _qr_seg_jit(
-            st["tiles"], st["tls"], st["tvs"], st["tts"], mesh, p, q,
-            m_true, k0, k1, bi)
-        g = None
+        if nm:
+            st["tiles"], st["tls"], st["tvs"], st["tts"], g = \
+                _qr_seg_nm_jit(
+                    st["tiles"], st["tls"], st["tvs"], st["tts"], st["g"],
+                    mesh, p, q, m_true, k0, k1, bi)
+        else:
+            st["tiles"], st["tls"], st["tvs"], st["tts"] = _qr_seg_jit(
+                st["tiles"], st["tls"], st["tvs"], st["tts"], mesh, p, q,
+                m_true, k0, k1, bi)
+            g = None
     elif op == "he2hb":
         nb = st["tiles"].shape[-1]
         st["tiles"], st["vqs"], st["tqs"] = _he2hb_seg_jit(
@@ -747,6 +794,8 @@ def _finish(op, d: DistMatrix, st, nm):
         t = _qr_fin_jit(st["tiles"], mesh, p, q, d.n)
         fd = DistMatrix(tiles=t, m=d.m, n=d.n, nb=d.nb, mesh=mesh,
                         diag_pad=True)
+        if nm:
+            _num.record_qr_orth("geqrf", st["g"])
         return DistQR(fd, st["tls"], st["tvs"], st["tts"])
     if op == "he2hb":
         band = DistMatrix(tiles=st["tiles"], m=d.m, n=d.n, nb=d.nb, mesh=mesh)
@@ -814,7 +863,8 @@ def _run(op: str, d: DistMatrix, k_from: int, every: int, bi: str, pi: str,
             else jnp.arange(nt * d.nb)
         )
     if op in _MULTI_KEYS:
-        nm = False  # no NumMonitor gauges thread these loops (yet)
+        if op != "geqrf":
+            nm = False  # no NumMonitor gauges thread he2hb (yet)
         if arrays:
             for kk in _MULTI_KEYS[op]:
                 st[kk] = jnp.asarray(arrays[kk])
@@ -824,6 +874,11 @@ def _run(op: str, d: DistMatrix, k_from: int, every: int, bi: str, pi: str,
         if op == "potrf":
             st["g"] = (jnp.asarray(gauges["g"]) if gauges
                        else jnp.asarray(jnp.inf, num_gauge_dtype(d.dtype)))
+        elif op == "geqrf":
+            # running max of the per-panel orthogonality-loss proxy
+            # (dist_qr._qr_orth_loss); 0 = nothing observed yet
+            st["g"] = (jnp.asarray(gauges["g"]) if gauges
+                       else jnp.zeros((), num_gauge_dtype(d.dtype)))
         elif gauges:
             st["amax0"] = jnp.asarray(gauges["amax0"])
             st["g"] = jnp.asarray(gauges["g"])
@@ -964,20 +1019,28 @@ def getrf_pp_ckpt(a: DistMatrix, every=None,
 
 @instrument("geqrf_ckpt")
 def geqrf_ckpt(a: DistMatrix, every=None, bcast_impl: Optional[str] = None,
-               async_snapshots=None):
+               async_snapshots=None, num_monitor: Optional[str] = None):
     """Checkpointed distributed CAQR (ISSUE 13): ``geqrf_dist`` results
     (bitwise) with the MULTI-ARRAY carry — tile stack, per-(mesh-row,
     panel) T_loc stack, replicated tree V/T stacks — snapshotted every
     ``every`` panel steps.  Returns DistQR; raises ``Preempted`` under
     an armed kill fault.  The auxiliary carries are grid-locked: resume
-    requires the snapshot's own (p, q) grid shape."""
+    requires the snapshot's own (p, q) grid shape.
+
+    ``num_monitor`` (Option.NumMonitor, ISSUE 14 satellite): ``on``
+    carries the per-panel reflector/τ orthogonality-loss proxy
+    (``dist_qr._qr_orth_loss``) as a running max through the segment
+    chain — results stay bitwise, zero extra audited collectives —
+    surfaced as the ``num.qr_orth_margin`` gauge / ``qr_orth_loss_max``
+    num-section total; off keeps the plain (unchanged) segment jits."""
     ev = resolve_checkpoint(every)
     if ev is None:
         return geqrf_dist(a, bcast_impl=bcast_impl)
     if a.m < a.n:
         raise ValueError(f"geqrf_ckpt requires m >= n, got {a.m}x{a.n}")
     return _run("geqrf", a, 0, ev, resolve_bcast_impl(bcast_impl), "xla",
-                False, async_snap=resolve_ckpt_async(async_snapshots))
+                resolve_num_monitor(num_monitor) == "on",
+                async_snap=resolve_ckpt_async(async_snapshots))
 
 
 @instrument("he2hb_ckpt")

@@ -10,7 +10,10 @@ be used from tooling that never builds a mesh.
 
 from __future__ import annotations
 
+import math
+import random
 import threading
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 # histograms keep a bounded sample reservoir next to exact running stats
@@ -23,15 +26,42 @@ def _key(name: str, tags: Dict[str, object]) -> _Key:
     return name, tuple(sorted((k, str(v)) for k, v in tags.items()))
 
 
-class _Hist:
-    __slots__ = ("count", "total", "vmin", "vmax", "samples")
+def quantile_of(samples: List[float], q: float,
+                vmin: Optional[float] = None,
+                vmax: Optional[float] = None) -> Optional[float]:
+    """Linear-interpolated quantile of a sample list, clamped to the
+    EXACT running [vmin, vmax] when given (a reservoir can have dropped
+    the true extremes; the running stats never do).  Returns None for an
+    empty list."""
+    if not samples:
+        return None
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile q must be in [0, 1], got {q}")
+    s = sorted(samples)
+    pos = q * (len(s) - 1)
+    lo = int(math.floor(pos))
+    hi = min(lo + 1, len(s) - 1)
+    val = s[lo] + (s[hi] - s[lo]) * (pos - lo)
+    if vmin is not None:
+        val = max(val, vmin)
+    if vmax is not None:
+        val = min(val, vmax)
+    return val
 
-    def __init__(self) -> None:
+
+class _Hist:
+    __slots__ = ("count", "total", "vmin", "vmax", "samples", "_rng")
+
+    def __init__(self, seed: int = 0) -> None:
         self.count = 0
         self.total = 0.0
         self.vmin = float("inf")
         self.vmax = float("-inf")
         self.samples: List[float] = []
+        # deterministic per-series reservoir (Vitter algorithm R): the
+        # seed derives from the series key, not process salt, so a fixed
+        # workload reproduces the same sample set run-to-run
+        self._rng = random.Random(seed)
 
     def observe(self, v: float) -> None:
         self.count += 1
@@ -40,6 +70,20 @@ class _Hist:
         self.vmax = max(self.vmax, v)
         if len(self.samples) < _HIST_SAMPLE_CAP:
             self.samples.append(v)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < _HIST_SAMPLE_CAP:
+                self.samples[j] = v
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Quantile estimate: EXACT (sorted-sample interpolation over
+        every observation) while count <= the reservoir cap — which
+        covers the tiny-count case: 1 observation returns it, 2 return
+        their interpolation — and a reservoir estimate clamped to the
+        exact running min/max beyond it."""
+        if self.count == 0:
+            return None
+        return quantile_of(self.samples, q, self.vmin, self.vmax)
 
 
 class MetricsRegistry:
@@ -70,7 +114,7 @@ class MetricsRegistry:
         with self._lock:
             h = self._hists.get(k)
             if h is None:
-                h = self._hists[k] = _Hist()
+                h = self._hists[k] = _Hist(zlib.crc32(repr(k).encode()))
             h.observe(float(value))
 
     def reset(self) -> None:
@@ -82,6 +126,26 @@ class MetricsRegistry:
     # -- read side ----------------------------------------------------
     def counter_value(self, name: str, **tags) -> float:
         return self._counters.get(_key(name, tags), 0.0)
+
+    def quantile(self, name: str, q: float, **tags) -> Optional[float]:
+        """Quantile of one histogram series (None when it never
+        observed) — the first-class read the SLA reductions build on
+        instead of ad-hoc sorting at report time."""
+        with self._lock:
+            h = self._hists.get(_key(name, tags))
+            return h.quantile(q) if h is not None else None
+
+    def histogram_series(self, name: str) -> List[dict]:
+        """All series of one histogram name: [{tags, count, sum, min,
+        max, samples}] — the pooling surface for reductions that merge
+        series across a tag (e.g. per-(op, class) latency over all
+        outcomes)."""
+        with self._lock:
+            return [
+                {"tags": dict(tags), "count": h.count, "sum": h.total,
+                 "min": h.vmin, "max": h.vmax, "samples": list(h.samples)}
+                for (n, tags), h in sorted(self._hists.items()) if n == name
+            ]
 
     def snapshot(self) -> Dict[str, List[dict]]:
         """JSON-able dump: the RunReport ``metrics`` section."""
@@ -100,6 +164,9 @@ class MetricsRegistry:
                         "sum": h.total,
                         "min": h.vmin if h.count else None,
                         "max": h.vmax if h.count else None,
+                        "p50": h.quantile(0.5),
+                        "p95": h.quantile(0.95),
+                        "p99": h.quantile(0.99),
                     }
                 )
             return out

@@ -114,6 +114,7 @@ _STATE = {
     "lu_growth_max": 0.0,      # worst element growth seen this run
     "condest_max": 0.0,        # worst estimated condition number
     "chol_margin_min": 0.0,    # smallest Schur-diagonal margin seen
+    "qr_orth_loss_max": 0.0,   # worst QR reflector/τ consistency loss
 }
 
 
@@ -273,6 +274,23 @@ def record_chol_gauges(op: str, margin, lmin, lmax) -> None:
             _STATE["chol_margin_min"] = m
         else:
             _STATE["chol_margin_min"] = min(_STATE["chol_margin_min"], m)
+
+
+def record_qr_orth(op: str, loss) -> None:
+    """Record one monitored QR chain's orthogonality-loss proxy: the
+    running max over panels of the reflector/τ consistency residual
+    |T(VᴴV)Tᴴ − T − Tᴴ| / max|T| (``dist_qr._qr_orth_loss``) — ~eps for
+    healthy panels, rising when cancellation degrades the implicit Q's
+    orthogonality.  Surfaced as the ``num.qr_orth_margin`` gauge and the
+    ``qr_orth_loss_max`` num-section total (lower is better)."""
+    c = _concrete(loss)
+    if c is None:
+        return
+    val = c[0]
+    REGISTRY.gauge_set("num.qr_orth_margin", val, op=op)
+    _note(op, {"qr_orth_loss": val})
+    with _lock:
+        _STATE["qr_orth_loss_max"] = max(_STATE["qr_orth_loss_max"], val)
 
 
 def record_condest(op: str, rcond) -> None:

@@ -321,6 +321,94 @@ def validate_chrome_trace(obj) -> List[str]:
     return errs
 
 
+def request_trace_events(traces) -> List[dict]:
+    """Per-request serving timelines (ISSUE 14): one track per ACCURACY
+    CLASS (the condest-keyed friendly/hostile partition is the SLA
+    partition, so a class's track is its latency story at a glance), one
+    complete event per request phase (admission → classify →
+    cache_lookup → factor → solve plus the degradation phases), and flow
+    arrows chaining retry → resume → the final phase of every request
+    that consumed the degradation ladder.
+
+    ``traces`` are finished ``serve.trace.RequestTrace`` objects; phase
+    timestamps are perf_counter absolutes rebased to the earliest
+    request start."""
+    traces = [t for t in traces if t is not None]
+    classes = sorted({t.klass or "friendly" for t in traces})
+    tid_of = {kl: 300 + i for i, kl in enumerate(classes)}
+    evs: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": PID, "tid": 0,
+         "args": {"name": "slate_tpu.serve"}},
+    ]
+    for kl in classes:
+        evs.append(
+            {"name": "thread_name", "ph": "M", "pid": PID,
+             "tid": tid_of[kl], "args": {"name": f"serve[{kl}]"}}
+        )
+    base = min((t.t0 for t in traces), default=0.0)
+    flow_id = 50_000
+    for t in traces:
+        tid = tid_of[t.klass or "friendly"]
+        phases = sorted(t.phases, key=lambda ph: (ph["t0"], -ph["t1"]))
+        for ph in phases:
+            args = {"rid": t.rid, "op": t.op, "n": t.n,
+                    "outcome": t.outcome, "phase": ph["name"],
+                    "depth": ph["depth"]}
+            if ph["parent"]:
+                args["parent"] = ph["parent"]
+            args.update({k: str(v) for k, v in ph.get("meta", {}).items()})
+            evs.append(
+                {
+                    "name": f"{t.op}#{t.rid} {ph['name']}",
+                    "cat": "serve",
+                    "ph": "X",
+                    "pid": PID,
+                    "tid": tid,
+                    "ts": (ph["t0"] - base) * _US,
+                    "dur": max(0.0, (ph["t1"] - ph["t0"]) * _US),
+                    "args": args,
+                }
+            )
+        # flow arrows retry -> resume -> final: chain every top-level
+        # degradation phase to the next, ending at the phase that
+        # finished last (the terminal dispatch the ladder carried the
+        # request to)
+        degr = sorted((ph for ph in t.phases
+                       if ph["name"] in ("retry", "resume")),
+                      key=lambda ph: ph["t0"])
+        rest = [ph for ph in t.phases if ph not in degr]
+        if degr and rest:
+            # the final dispatch the ladder carried the request to: the
+            # last-closing non-ladder phase (typically its solve)
+            final = max(rest, key=lambda ph: ph["t1"])
+            chain = degr + [final]
+            for a, b in zip(chain, chain[1:]):
+                flow_id += 1
+                common = {"cat": "serve", "pid": PID, "id": flow_id,
+                          "name": f"{t.op}#{t.rid} ladder"}
+                evs.append(dict(common, ph="s", tid=tid,
+                                ts=(a["t0"] - base) * _US,
+                                args={"from": a["name"], "to": b["name"],
+                                      "rid": t.rid}))
+                evs.append(dict(common, ph="f", bp="e", tid=tid,
+                                ts=(b["t0"] - base) * _US, args={}))
+    return evs
+
+
+def request_chrome_trace(traces) -> dict:
+    return {
+        "traceEvents": request_trace_events(traces),
+        "displayTimeUnit": "ms",
+        "otherData": {"producer": "slate_tpu.serve.trace"},
+    }
+
+
+def write_request_trace(path: str, traces) -> str:
+    with open(path, "w") as f:
+        json.dump(request_chrome_trace(traces), f, indent=1)
+    return path
+
+
 def numerics_counter_events(history, op: str = "", tid: int = 0,
                             t0: float = 0.0, dt: float = 1e-3) -> List[dict]:
     """Counter events (``ph: "C"``) for a refinement convergence

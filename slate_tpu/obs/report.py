@@ -51,11 +51,23 @@ _LOWER_BETTER = ("second", "time", "byte", "error", "err", "resid", "latency",
                  # history_drop convergence ratio stay higher-is-better)
                  "growth", "condest", "alarm", "routed", "ir_iters",
                  "history_len",
+                 # QR-chain orthogonality-loss proxy rising = the
+                 # implicit Q degrading under a fixed workload
+                 "orth_loss",
                  # serving runtime: misses/retraces/rejections rising
                  # under a fixed request stream = cache hygiene or
                  # admission coverage degrading (hits/traces/warmups
                  # stay direction-neutral counts that gate on equality)
                  "cache_miss", "retrace", "admission_reject",
+                 # request-level SLA surface (ISSUE 14): rejected /
+                 # failed terminal outcomes (counts AND rates) rising
+                 # under a fixed request stream = the degradation
+                 # ladder resolving fewer requests ("latency" above
+                 # already covers the quantile keys the CI gate
+                 # --ignores as wall-clock); "reject_" catches both the
+                 # outcome_reject_* counts and the outcome_rate_reject_*
+                 # shares, "failed_" both failed_info and failed_error
+                 "reject_", "failed_",
                  # elastic reliability: steps lost to an unsnapshotted
                  # window (recovery cost) and FtError retries rising
                  # under a fixed injection = checkpoint cadence or

@@ -48,6 +48,7 @@ from .comm import (
     bcast_from_col,
     bcast_impl_scope,
     local_indices,
+    num_gauge_dtype,
     resolve_bcast_impl,
     shard_map_compat,
 )
@@ -152,14 +153,40 @@ def _apply_tree_tops(tops, treev_k, treet_k, k, p, nb, adjoint: bool):
     return tops[jnp.argsort(rot)]
 
 
-def _qr_panel_step(k, carry, p, q, m_true):
+def _qr_orth_loss(v, tl, rdt):
+    """Cheap per-panel reflector/τ consistency margin — the QR-chain
+    orthogonality-loss proxy gauge (ISSUE 14 satellite; ROADMAP
+    "NumMonitor gauges through the QR/eig segment chains").
+
+    For an exact compact-WY pair, T^{-1} + T^{-H} = V^H V, equivalently
+    T (V^H V) T^H = T + T^H — an identity between quantities the panel
+    step already holds (no extra factorization, no collective: V spans
+    only this mesh row's rows and T was built FROM this V, so the
+    identity is local).  Floating-point drift in that residual tracks
+    the loss of orthogonality of the panel's implicit Q: ~eps for a
+    healthy panel, growing when cancellation degrades the reflectors.
+    Returned relative to max|T| in the gauge dtype."""
+    s = jnp.einsum("ri,rj->ij", jnp.conj(v), v, precision=PRECISE)
+    e = jnp.einsum("ij,jk,lk->il", tl, s, jnp.conj(tl),
+                   precision=PRECISE) - tl - jnp.conj(tl).T
+    denom = jnp.maximum(jnp.max(jnp.abs(tl)).astype(rdt),
+                        jnp.asarray(jnp.finfo(rdt).tiny, rdt))
+    return (jnp.max(jnp.abs(e)).astype(rdt) / denom)
+
+
+def _qr_panel_step(k, carry, p, q, m_true, nm=False):
     """One CAQR panel step of the strict schedule on the full local view
     (carry = (tile stack, T_loc stack, tree-V stack, tree-T stack)).
 
     Module-level so the fused ``_geqrf_jit`` loop and the checkpointed
     segment chain (``ft/ckpt._qr_seg_jit``) run the IDENTICAL per-element
     arithmetic — chained segments reproduce the fused kernel bitwise at
-    any boundary set (the dist_chol/_lu step-helper contract)."""
+    any boundary set (the dist_chol/_lu step-helper contract).
+
+    ``nm=True`` (the monitored segment chain, ``ft/ckpt._qr_seg_nm_jit``)
+    additionally returns this step's ``_qr_orth_loss`` scalar; the
+    default leaves the computation — and hence the fused kernel's and
+    the plain chain's jaxpr — untouched."""
     t_loc, tls, tvs, tts = carry
     mtl, ntl, nb, _ = t_loc.shape
     dtype = t_loc.dtype
@@ -242,7 +269,10 @@ def _qr_panel_step(k, carry, p, q, m_true):
     t_loc = lax.dynamic_update_slice_in_dim(
         t_loc, pflat.reshape(mtl, 1, nb, nb), kc, axis=1
     )
-    return t_loc, tls.at[k].set(tl), tvs.at[k].set(tv), tts.at[k].set(tt)
+    out = (t_loc, tls.at[k].set(tl), tvs.at[k].set(tv), tts.at[k].set(tt))
+    if nm:
+        return out, _qr_orth_loss(v, tl, num_gauge_dtype(dtype))
+    return out
 
 
 def _qr_pad_identity(t_loc, p, q, n_true, dtype):

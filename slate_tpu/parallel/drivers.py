@@ -247,16 +247,19 @@ def geqrf_mesh(
     opts: Optional[Options] = None,
 ):
     """Distributed CAQR factorization (src/geqrf.cc). Returns DistQR.
-    ``opts`` carries Option.BcastImpl (panel-broadcast lowering) and
+    ``opts`` carries Option.BcastImpl (panel-broadcast lowering),
     Option.Checkpoint (ISSUE 13: the multi-array carry — tile stack +
     T_loc stack + tree V/T stacks — snapshots every K panel steps; off
-    keeps the fused kernel untouched, trace-identical)."""
+    keeps the fused kernel untouched, trace-identical) and, on the
+    checkpointed chain, Option.NumMonitor (ISSUE 14 satellite: the
+    in-carry reflector/τ orthogonality-loss gauge -> num.qr_orth_margin;
+    off keeps the plain segment jits)."""
     every = _ckpt_every(opts)
     if every is not None:
         from ..ft.ckpt import geqrf_ckpt
 
         return geqrf_ckpt(from_dense(a, mesh, nb), every=every,
-                          bcast_impl=_bi(opts))
+                          bcast_impl=_bi(opts), num_monitor=_nm(opts))
     return geqrf_dist(from_dense(a, mesh, nb), bcast_impl=_bi(opts))
 
 

@@ -29,6 +29,14 @@ requests.  This package is the throughput layer over ``api.py`` /
   nopiv+IR for friendly operators, pp+GMRES-IR above
   ``numerics.CONDEST_THRESHOLD`` — the Carson–Higham regime boundary),
   then dispatch through the executable cache.
+- ``trace`` / ``stats``: request-level observability (ISSUE 14).  With
+  the obs layer on, every Router request carries a ``RequestTrace``
+  across admission → classify → cache → factor/solve → the degradation
+  ladder, terminated with exactly one outcome; latencies land in
+  (op, class, outcome)-tagged histograms reduced to the gated
+  ``serve.latency_{p50,p95,p99}_*`` + outcome-rate SLA surface.
+  ``python -m slate_tpu.serve.stats`` exports Prometheus text + JSON;
+  ``obs.perfetto.request_trace_events`` renders request timelines.
 - ``python -m slate_tpu.serve.smoke`` is the CI acceptance run; the
   ``serve.*`` counters land in every RunReport and gate via
   ``obs.report --check`` like the ft/ir/mem/num sections.
@@ -46,6 +54,7 @@ from .batch import (  # noqa: F401
 from .cache import CacheKey, ExecutableCache, executable_cache  # noqa: F401
 from .metrics import serve_counter_values  # noqa: F401
 from .router import Router  # noqa: F401
+from .trace import RequestTrace, finished_traces  # noqa: F401
 from .table import (  # noqa: F401
     load_tuned_table,
     resolve_request_options,
@@ -65,6 +74,8 @@ __all__ = [
     "pad_to_bin",
     "unpack_block_diag",
     "serve_counter_values",
+    "RequestTrace",
+    "finished_traces",
     "load_tuned_table",
     "resolve_request_options",
     "use_tuned_table",

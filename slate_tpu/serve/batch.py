@@ -43,6 +43,18 @@ from .metrics import serve_count
 DEFAULT_BINS: Tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096)
 
 
+def record_batch_size(op: str, count: int) -> None:
+    """Observe one dispatched batch's size into the ``serve.batch_size``
+    histogram (ISSUE 14: the batching-efficiency distribution beside the
+    latency SLA — a p50 batch size of 1 under heavy traffic means the
+    binning vocabulary is fragmenting the stream).  No-op while the obs
+    layer is off."""
+    from ..obs import REGISTRY, enabled
+
+    if enabled():
+        REGISTRY.observe("serve.batch_size", float(count), op=op)
+
+
 # ---------------------------------------------------------------------------
 # Stacked batch drivers (bitwise per-problem)
 # ---------------------------------------------------------------------------
@@ -206,6 +218,7 @@ def posv_packed_mesh(
     m = bin_for(max(op.shape[0] for op in operands), bins)
     if m is None:
         raise ValueError("packed operand exceeds the largest serving bin")
+    record_batch_size("posv_packed", len(operands))
     a, b = pack_block_diag(operands, m, rhs)
     merged = resolve_request_options(
         opts, "posv", a.shape[0], str(a.dtype), mesh_shape(mesh))

@@ -91,6 +91,12 @@ class ExecutableCache:
     def __len__(self) -> int:
         return len(self._programs)
 
+    def contains(self, key: CacheKey) -> bool:
+        """Pure membership probe (no counter side effects): the request
+        tracer reads it to attribute a lookup as hit vs miss BEFORE
+        ``get_or_build`` performs (and counts) the real lookup."""
+        return key in self._programs
+
     def get_or_build(self, key: CacheKey, build: Callable[[], Callable]):
         """The request path: a hit returns the pinned program; a miss
         builds the pure array->array function via ``build()``, wraps it

@@ -12,7 +12,14 @@ deterministic count under a fixed workload and gates tight.
 
 from __future__ import annotations
 
+import re
 from typing import Dict
+
+
+def _sanitize_key(name: str) -> str:
+    """Report/Prometheus-safe metric-name fragment (tag values like
+    dtype strings can carry characters the flat key space cannot)."""
+    return re.sub(r"[^A-Za-z0-9_]", "_", name)
 
 _ZEROS: Dict[str, float] = {
     # request router
@@ -57,10 +64,22 @@ def serve_count(name: str, n: float = 1.0) -> None:
 
 def serve_counter_values() -> Dict[str, float]:
     """Snapshot for RunReports (obs.report.make_report's ``serve``
-    section)."""
-    return dict(_COUNTS)
+    section): the flat counters plus the request-level SLA reduction
+    (ISSUE 14) — per-(op, class) latency quantiles/counts and outcome
+    attribution totals/rates from serve/trace.py.  An idle run (no
+    request terminated) contributes nothing beyond the counter zeros,
+    so the all-zero section keeps staying out of the report-gate
+    comparison surface."""
+    out = dict(_COUNTS)
+    from . import trace as _trace
+
+    out.update(_trace.sla_values())
+    return out
 
 
 def reset() -> None:
     _COUNTS.clear()
     _COUNTS.update(_ZEROS)
+    from . import trace as _trace
+
+    _trace.reset()

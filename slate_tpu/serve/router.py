@@ -33,7 +33,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..types import Norm, Options, SlateError
-from .batch import DEFAULT_BINS, bin_for, pad_rhs_to_bin, pad_to_bin
+from . import trace as rtrace
+from .batch import DEFAULT_BINS, bin_for, pad_rhs_to_bin, pad_to_bin, \
+    record_batch_size
 from .cache import ExecutableCache, executable_cache, make_key
 from .metrics import serve_count
 
@@ -171,18 +173,18 @@ class Router:
 
     # -- dispatch ----------------------------------------------------------
 
-    def _program(self, op: str, variant: str, args: Tuple[jax.Array, ...],
-                 batch: int):
-        # the stacked single-chip programs have NO schedule knobs (no
-        # broadcasts, no k-loop pipelining), so tuned options are
-        # deliberately NOT folded into their cache keys — a re-tuned
-        # table must not re-key (and re-trace) programs it cannot
-        # affect.  The tuned tier's consumers are the mesh paths
+    def _key_for(self, op: str, variant: str,
+                 args: Tuple[jax.Array, ...], batch: int):
+        # the ONE source of the stacked-program cache key (the request
+        # tracer's hit/miss probe must agree with the lookup by
+        # construction).  The stacked single-chip programs have NO
+        # schedule knobs (no broadcasts, no k-loop pipelining), so tuned
+        # options are deliberately NOT folded into their cache keys — a
+        # re-tuned table must not re-key (and re-trace) programs it
+        # cannot affect.  The tuned tier's consumers are the mesh paths
         # (batch.posv_packed_mesh resolves it into nb/BcastImpl/
         # Lookahead for the packed solve).
-        key = make_key(f"{op}_{variant}", args, batch=batch, mesh=None)
-        return self.cache.get_or_build(key, lambda: _build_batched(
-            op, variant)), key
+        return make_key(f"{op}_{variant}", args, batch=batch, mesh=None)
 
     def solve_batch(self, requests: Sequence[Tuple[str, jax.Array, jax.Array]]
                     ) -> List[jax.Array]:
@@ -190,24 +192,60 @@ class Router:
         Returns per-request solutions in order.  Same-class requests
         sharing a bin run as ONE stacked compiled program (ragged sizes
         identity-pad to the bin; the padded rows solve an appended
-        identity system and never touch data rows)."""
+        identity system and never touch data rows).
+
+        With the obs layer enabled, every request carries a
+        ``RequestTrace`` (serve/trace.py) across its whole lifecycle —
+        admission → classify → cache lookup → solve (plus the mesh
+        path's factor/solve/degradation phases) — terminated with
+        exactly one outcome; disabled, the tracer allocates nothing and
+        the dispatch below is byte-identical.  A failure anywhere
+        aborts the WHOLE call, so on the error path every still-open
+        sibling trace terminates as ``reject_batch_abort`` (the request
+        that actually failed already carries its own outcome) — the
+        exactly-one-terminal contract holds for every request on every
+        exit."""
+        traces: List[Optional[rtrace.RequestTrace]] = [None] * len(requests)
+        try:
+            return self._solve_batch_inner(requests, traces)
+        except Exception:
+            for tr in traces:
+                if tr is not None and tr.outcome is None:
+                    tr.finish("reject_batch_abort")
+            raise
+
+    def _solve_batch_inner(self, requests, traces):
         groups: Dict[Tuple, List[int]] = {}
         padded: List[Optional[Tuple[jax.Array, jax.Array]]] = [None] * len(requests)
         for i, (op, a, b) in enumerate(requests):
             serve_count("requests")
             n = a.shape[0]
-            m = bin_for(n, self.bins)
-            if m is None:
-                serve_count("admission_rejects")
-                raise SlateError(f"serve: n={n} exceeds the largest bin "
-                                 f"{self.bins[-1]}")
-            self.admit(op, m)  # the program runs at the PADDED bin size
+            tr = traces[i] = rtrace.new_trace(op, n, self.nb, str(a.dtype))
+            try:
+                with rtrace.phase(tr, "admission"):
+                    m = bin_for(n, self.bins)
+                    if m is None:
+                        serve_count("admission_rejects")
+                        raise SlateError(
+                            f"serve: n={n} exceeds the largest bin "
+                            f"{self.bins[-1]}")
+                    # the program runs at the PADDED bin size
+                    self.admit(op, m)
+            except SlateError:
+                rtrace.finish(tr, "reject_admission")
+                raise
+            if tr is not None:
+                tr.bin = m
             # the resilient mesh path has its own dispatch (pp for gesv)
             # and never consumes the accuracy class — skip the condest
             # probe instead of paying it for a discarded label
-            klass = (self.classify(op, a)
-                     if op == "gesv" and not self._mesh_resilient(op)
-                     else "friendly")
+            if op == "gesv" and not self._mesh_resilient(op):
+                with rtrace.phase(tr, "classify"):
+                    klass = self.classify(op, a)
+            else:
+                klass = "friendly"
+            if tr is not None:
+                tr.klass = klass
             bd = b if b.ndim == 2 else b[:, None]
             padded[i] = (pad_to_bin(a, m), pad_rhs_to_bin(bd, m))
             groups.setdefault(
@@ -215,19 +253,48 @@ class Router:
 
         out: List[Optional[jax.Array]] = [None] * len(requests)
         for (op, klass, m, nrhs, _dt), idxs in groups.items():
+            trs = [traces[i] for i in idxs]
+            for tr in trs:
+                if tr is not None:
+                    tr.batch = len(idxs)
             a_stack = jnp.stack([padded[i][0] for i in idxs])
             b_stack = jnp.stack([padded[i][1] for i in idxs])
-            self.admit_batch(op, m, len(idxs), a_stack.dtype.itemsize)
+            try:
+                self.admit_batch(op, m, len(idxs), a_stack.dtype.itemsize)
+            except SlateError:
+                for tr in trs:
+                    rtrace.finish(tr, "reject_admission")
+                raise
+            record_batch_size(op, len(idxs))
             if self._mesh_resilient(op):
-                xs, info = self._solve_group_mesh(op, a_stack, b_stack)
+                xs, info = self._solve_group_mesh(op, a_stack, b_stack, trs)
             else:
-                prog, _key = self._program(op, klass, (a_stack, b_stack),
-                                           batch=len(idxs))
-                xs, info = prog(a_stack, b_stack)
+                key = self._key_for(op, klass, (a_stack, b_stack),
+                                    len(idxs))
+                live = any(tr is not None for tr in trs)
+                # the membership probe exists only for the tracer's
+                # hit/miss label; untraced dispatch skips it
+                hit = self.cache.contains(key) if live else False
+                with rtrace.phase_all(trs, "cache_lookup",
+                                      result="hit" if hit else "miss"):
+                    prog = self.cache.get_or_build(
+                        key, lambda op=op, klass=klass: _build_batched(
+                            op, klass))
+                with rtrace.phase_all(trs, "solve"):
+                    xs, info = prog(a_stack, b_stack)
+                    if live:
+                        # fence so the span (and the SLA latency) covers
+                        # the execution, not just the dispatch — the
+                        # untraced path keeps JAX's async semantics
+                        jax.block_until_ready(xs)
             serve_count("batches")
             serve_count("batched_solves", len(idxs))
-            bad = [idxs[j] for j, v in enumerate(np.asarray(info)) if v != 0]
+            infos = np.asarray(info)
+            bad = [idxs[j] for j, v in enumerate(infos) if v != 0]
             if bad:
+                for j, i in enumerate(idxs):
+                    if infos[j] != 0:
+                        rtrace.finish(traces[i], "failed_info")
                 # never silently serve a failed factorization's output
                 raise SlateError(
                     f"serve: {op} batch reported nonzero info for request "
@@ -237,6 +304,7 @@ class Router:
                 n = requests[i][1].shape[0]
                 xi = xs[j, :n]
                 out[i] = xi[:, 0] if requests[i][2].ndim == 1 else xi
+                rtrace.finish(traces[i])  # note-attributed served terminal
         return out  # type: ignore[return-value]
 
     def solve(self, op: str, a: jax.Array, b: jax.Array) -> jax.Array:
@@ -277,15 +345,29 @@ class Router:
         return (resolve_policy(self.opts) != FtPolicy.Off
                 or self._ckpt_every() is not None)
 
-    def _solve_group_mesh(self, op: str, a_stack, b_stack):
+    def _solve_group_mesh(self, op: str, a_stack, b_stack, trs=None):
         xs, infos = [], []
         for i in range(a_stack.shape[0]):
-            x, info = self._solve_one_mesh(op, a_stack[i], b_stack[i])
+            tr = trs[i] if trs is not None else None
+            x, info = self._solve_one_mesh(op, a_stack[i], b_stack[i], tr)
             xs.append(x)
             infos.append(jnp.asarray(info, jnp.int32))
         return jnp.stack(xs), jnp.stack(infos)
 
-    def _solve_one_mesh(self, op: str, a, b):
+    def _solve_one_mesh(self, op: str, a, b, tr=None):
+        try:
+            return self._solve_one_mesh_inner(op, a, b, tr)
+        except Exception:
+            # an error escaping THIS request's own dispatch (e.g. a
+            # second FtError after the one retry, or an abort raised
+            # inside a retry) is this request's failure, not a sibling's
+            # — terminate it with its own cause so solve_batch's
+            # batch-abort sweep only ever labels true bystanders
+            if tr is not None and tr.outcome is None:
+                tr.finish("failed_error")
+            raise
+
+    def _solve_one_mesh_inner(self, op: str, a, b, tr=None):
         from ..ft import ckpt as _ckpt
         from ..ft.policy import FtError, FtPolicy, resolve_policy
 
@@ -294,20 +376,25 @@ class Router:
         pol = resolve_policy(self.opts)
         try:
             return self._guard(op, a, b, *self._factor_solve_mesh(
-                op, a, b, pol))
+                op, a, b, pol, tr), tr=tr)
         except _ckpt.Preempted as e:
             if e.checkpoint is None:
                 serve_count("admission_rejects")
+                rtrace.finish(tr, "reject_unresumable")
                 raise SlateError(
                     f"serve: {op} request preempted at step {e.killed_at} "
                     "before its first checkpoint — rejected (unresumable), "
                     "not served NaNs") from e
             serve_count("resumes")
+            rtrace.note(tr, "resume")
             try:
-                return self._guard(op, a, b, *self._resume_solve(
-                    op, b, e.checkpoint))
+                with rtrace.phase(tr, "resume", killed_at=e.killed_at,
+                                  from_step=e.checkpoint.step):
+                    resumed = self._resume_solve(op, b, e.checkpoint, tr)
+                return self._guard(op, a, b, *resumed, tr=tr)
             except _ckpt.Preempted as e2:
                 serve_count("admission_rejects")
+                rtrace.finish(tr, "reject_unresumable")
                 raise SlateError(
                     f"serve: {op} request re-preempted on resume at step "
                     f"{e2.killed_at} — rejected") from e2
@@ -316,15 +403,21 @@ class Router:
                 # (Checkpoint.growth_abort) and aborted: same escalation
                 # as the uninterrupted abort — one pivoted retry
                 serve_count("retries")
-                return self._guard(op, a, b, *self._factor_solve_pp(op, a, b))
+                rtrace.note(tr, "growth_retry")
+                with rtrace.phase(tr, "retry", cause="growth_abort"):
+                    retried = self._factor_solve_pp(op, a, b, tr=tr)
+                return self._guard(op, a, b, *retried, tr=tr)
         except FtError:
             # transient-SDC class: one retry under the recompute policy;
             # a second FtError (persistent corruption) surfaces raw
             serve_count("retries")
-            return self._guard(op, a, b, *self._factor_solve_mesh(
-                op, a, b, FtPolicy.Recompute))
+            rtrace.note(tr, "ft_retry")
+            with rtrace.phase(tr, "retry", cause="ft_error"):
+                retried = self._factor_solve_mesh(
+                    op, a, b, FtPolicy.Recompute, tr)
+            return self._guard(op, a, b, *retried, tr=tr)
 
-    def _guard(self, op: str, a, b, x, info):
+    def _guard(self, op: str, a, b, x, info, tr=None):
         """The resilient mesh path bypasses the batched drivers'
         condest-keyed accuracy ladder (the ABFT LU is no-pivot), so no
         solution leaves unvalidated: one residual check rejects a
@@ -338,6 +431,7 @@ class Router:
         resid = float(jnp.max(jnp.abs(a @ x - b)))
         if not np.isfinite(resid) or resid > 1e6 * n * eps * max(scale, 1.0):
             serve_count("admission_rejects")
+            rtrace.finish(tr, "reject_residual")
             raise SlateError(
                 f"serve: {op} resilient-path solution failed the residual "
                 f"gate (|Ax-b| max {resid:.3g}) — rejected, not served")
@@ -354,7 +448,7 @@ class Router:
                 get_option(self.opts, Option.PanelImpl),
                 get_option(self.opts, Option.NumMonitor))
 
-    def _factor_solve_mesh(self, op: str, a, b, pol):
+    def _factor_solve_mesh(self, op: str, a, b, pol, tr=None):
         from ..ft.ckpt import getrf_pp_ckpt, potrf_ckpt
         from ..ft.policy import FtPolicy
         from ..parallel.dist import from_dense
@@ -369,22 +463,24 @@ class Router:
                     "checkpointed yet); arm one of them")
             from ..ft import abft
 
-            if op == "posv":
-                l, info, _rep = abft.potrf_ft(
-                    a, self.mesh, self.nb, policy=pol, lookahead=la,
-                    bcast_impl=bi, panel_impl=pi)
-            else:
-                # the only ABFT LU is no-pivot — _guard validates the
-                # solution it produces
-                l, info, _rep = abft.getrf_nopiv_ft(
-                    a, self.mesh, self.nb, policy=pol, lookahead=la,
-                    bcast_impl=bi, panel_impl=pi)
-            return self._trsm_solve(op, l, b), info
+            with rtrace.phase(tr, "factor", method="abft", policy=str(pol)):
+                if op == "posv":
+                    l, info, _rep = abft.potrf_ft(
+                        a, self.mesh, self.nb, policy=pol, lookahead=la,
+                        bcast_impl=bi, panel_impl=pi)
+                else:
+                    # the only ABFT LU is no-pivot — _guard validates the
+                    # solution it produces
+                    l, info, _rep = abft.getrf_nopiv_ft(
+                        a, self.mesh, self.nb, policy=pol, lookahead=la,
+                        bcast_impl=bi, panel_impl=pi)
+            return self._trsm_solve(op, l, b, tr=tr), info
         d = from_dense(a, self.mesh, self.nb, diag_pad_one=True)
         if op == "posv":
-            l, info = potrf_ckpt(d, every=every, bcast_impl=bi,
-                                 panel_impl=pi, num_monitor=nm)
-            return self._trsm_solve(op, l, b), info
+            with rtrace.phase(tr, "factor", method="potrf_ckpt"):
+                l, info = potrf_ckpt(d, every=every, bcast_impl=bi,
+                                     panel_impl=pi, num_monitor=nm)
+            return self._trsm_solve(op, l, b, tr=tr), info
         # gesv on the checkpointed path: with NumMonitor armed, try the
         # cheap no-pivot factor first — the FRIENDLY accuracy class the
         # batched router already serves (PR 11's condest-keyed nopiv+IR
@@ -407,16 +503,21 @@ class Router:
             from ..ft.ckpt import getrf_nopiv_ckpt
 
             try:
-                lu, info = getrf_nopiv_ckpt(
-                    d, every=every, bcast_impl=bi, panel_impl=pi,
-                    num_monitor=nm)
+                with rtrace.phase(tr, "factor", method="nopiv_ckpt"):
+                    lu, info = getrf_nopiv_ckpt(
+                        d, every=every, bcast_impl=bi, panel_impl=pi,
+                        num_monitor=nm)
                 serve_count("class_friendly")
-                return self._trsm_solve(op, lu, b), info
+                return self._trsm_solve(op, lu, b, tr=tr), info
             except GrowthAbort:
                 serve_count("retries")
-        return self._factor_solve_pp(op, b_dense=b, d=d)
+                rtrace.note(tr, "growth_retry")
+                with rtrace.phase(tr, "retry", cause="growth_abort"):
+                    return self._factor_solve_pp(op, b_dense=b, d=d, tr=tr)
+        return self._factor_solve_pp(op, b_dense=b, d=d, tr=tr)
 
-    def _factor_solve_pp(self, op: str, a=None, b_dense=None, d=None):
+    def _factor_solve_pp(self, op: str, a=None, b_dense=None, d=None,
+                         tr=None):
         """The pivoted gesv tier (shared by the growth-abort retry paths:
         the initial attempt hands over its DistMatrix, the resumed-abort
         path re-encodes from the dense operand)."""
@@ -426,44 +527,50 @@ class Router:
         _la, bi, _pi, nm = self._resil_opts()
         if d is None:
             d = from_dense(a, self.mesh, self.nb, diag_pad_one=True)
-        lu, perm, info = getrf_pp_ckpt(d, every=self._ckpt_every(),
-                                       bcast_impl=bi, num_monitor=nm)
+        with rtrace.phase(tr, "factor", method="pp_ckpt"):
+            lu, perm, info = getrf_pp_ckpt(d, every=self._ckpt_every(),
+                                           bcast_impl=bi, num_monitor=nm)
         serve_count("class_hostile")
-        return self._trsm_solve(op, lu, b_dense, perm=perm), info
+        return self._trsm_solve(op, lu, b_dense, perm=perm, tr=tr), info
 
-    def _resume_solve(self, op: str, b, checkpoint):
+    def _resume_solve(self, op: str, b, checkpoint, tr=None):
         from ..ft import elastic
 
         _la, bi, pi, _nm = self._resil_opts()
-        out = elastic.resume(checkpoint, self.mesh, bcast_impl=bi,
-                             panel_impl=pi)
+        with rtrace.phase(tr, "factor", method="elastic_resume"):
+            out = elastic.resume(checkpoint, self.mesh, bcast_impl=bi,
+                                 panel_impl=pi)
         if len(out) == 3:  # getrf_pp: (LU, perm, info)
             lu, perm, info = out
-            return self._trsm_solve(op, lu, b, perm=perm), info
+            return self._trsm_solve(op, lu, b, perm=perm, tr=tr), info
         l, info = out
-        return self._trsm_solve(op, l, b), info
+        return self._trsm_solve(op, l, b, tr=tr), info
 
-    def _trsm_solve(self, op: str, l, b, perm=None):
+    def _trsm_solve(self, op: str, l, b, perm=None, tr=None):
         from ..parallel.dist import from_dense, to_dense
         from ..parallel.dist_lu import permute_rows_dist
         from ..parallel.dist_trsm import trsm_dist
         from ..types import Diag, Op, Uplo
 
         la, bi, _pi, _nm = self._resil_opts()
-        bd = from_dense(b, self.mesh, self.nb)
-        if perm is not None:
-            bd = permute_rows_dist(bd, perm)
-        if op == "posv":
-            y = trsm_dist(l, bd, Uplo.Lower, Op.NoTrans, lookahead=la,
-                          bcast_impl=bi)
-            x = trsm_dist(l, y, Uplo.Lower, Op.ConjTrans, lookahead=la,
-                          bcast_impl=bi)
-        else:
-            y = trsm_dist(l, bd, Uplo.Lower, Op.NoTrans, Diag.Unit,
-                          lookahead=la, bcast_impl=bi)
-            x = trsm_dist(l, y, Uplo.Upper, Op.NoTrans, lookahead=la,
-                          bcast_impl=bi)
-        return to_dense(x)[: b.shape[0]]
+        with rtrace.phase(tr, "solve"):
+            bd = from_dense(b, self.mesh, self.nb)
+            if perm is not None:
+                bd = permute_rows_dist(bd, perm)
+            if op == "posv":
+                y = trsm_dist(l, bd, Uplo.Lower, Op.NoTrans, lookahead=la,
+                              bcast_impl=bi)
+                x = trsm_dist(l, y, Uplo.Lower, Op.ConjTrans, lookahead=la,
+                              bcast_impl=bi)
+            else:
+                y = trsm_dist(l, bd, Uplo.Lower, Op.NoTrans, Diag.Unit,
+                              lookahead=la, bcast_impl=bi)
+                x = trsm_dist(l, y, Uplo.Upper, Op.NoTrans, lookahead=la,
+                              bcast_impl=bi)
+            out = to_dense(x)[: b.shape[0]]
+            if tr is not None:
+                jax.block_until_ready(out)  # honest span/SLA end time
+        return out
 
 
 def _build_batched(op: str, variant: str):

@@ -17,12 +17,21 @@ Asserts, on the 8-device CPU mesh harness:
 (d) **Tuned table**: the committed artifact loads, validates, and the
     request path resolves unset options through it (explicit still
     wins).
+(e) **Request-level SLA** (ISSUE 14): a deterministic request stream
+    through the Router must leave a nonempty latency histogram per
+    accuracy class with p50 <= p95 <= p99, attribute every request to
+    EXACTLY one terminal outcome (totals == request count), export a
+    Perfetto-valid request timeline, and the ``serve.stats``
+    Prometheus text must carry the surface.
 
 Emits ``serve.report.json`` (RunReport schema, ``serve`` counter
-section + headline values) for the CI regression gate — machine-
-dependent rates carry a ``_runtime_`` infix so the committed-artifact
-check can ``--ignore 'serve.*_runtime_*'`` while the deterministic
-cache-hygiene counts gate tight.
+section + headline values) and ``serve_sla.report.json`` (the SLA
+phase's own RunReport: latency quantiles/counts + outcome rates) for
+the CI regression gates — machine-dependent rates carry a ``_runtime_``
+infix and the latency quantiles a ``latency…_s`` shape so the
+committed-artifact checks can ``--ignore 'serve.*_runtime_*'`` /
+``--ignore '*latency*_s'`` while the deterministic shape/count/rate
+keys gate tight.
 
 Usage::
 
@@ -101,6 +110,118 @@ def measure_throughput(mesh, n: int = 512, batch: int = 8, nrhs: int = 1,
         "bitwise": bitwise,
         "info_ok": bool(np.all(np.asarray(info) == 0)),
     }
+
+
+def run_sla_phase(out_dir: str, failures: list) -> dict:
+    """(e) Request-level SLA observability (ISSUE 14): drive a
+    deterministic meshless request stream through the Router — both
+    condest accuracy classes plus an admission reject — then assert the
+    trace/SLA contracts and emit ``serve_sla.report.json`` + the
+    Perfetto request timeline.  Meshless on purpose: the stream is
+    broadcast-impl-independent, so the ring CI re-run reproduces the
+    gated counts exactly."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..obs import REGISTRY, perfetto, report
+    from ..types import SlateError
+    from . import trace as serve_trace
+    from .router import Router
+    from .stats import prometheus_text, stats_snapshot
+
+    rng = np.random.default_rng(3)
+    n = 48
+    router = Router(bins=(64,), hbm_budget=1 << 30)
+    traces_before = len(serve_trace.finished_traces())
+    requests = 0
+
+    def spd(sz):
+        g = rng.standard_normal((sz, sz))
+        return jnp.asarray(g @ g.T / sz + 2 * np.eye(sz))
+
+    b = jnp.asarray(rng.standard_normal((n, 2)))
+    # friendly gesv x2 + posv x3 + hostile gesv x2 (prescribed spectrum,
+    # cond 1e9 >> CONDEST_THRESHOLD)
+    for _ in range(2):
+        good = jnp.asarray(rng.standard_normal((n, n)) + n * np.eye(n))
+        router.solve("gesv", good, b)
+        requests += 1
+    for _ in range(3):
+        router.solve("posv", spd(n), b)
+        requests += 1
+    q1, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    q2, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    sing = np.logspace(0, -9, n)
+    for _ in range(2):
+        router.solve("gesv", jnp.asarray(q1 @ np.diag(sing) @ q2), b)
+        requests += 1
+    # one admission reject: a router whose modeled HBM budget admits
+    # nothing terminates the request as reject_admission
+    tiny = Router(bins=(64,), hbm_budget=10_000)
+    try:
+        tiny.solve("posv", spd(n), b)
+        failures.append("SLA phase: 10kB-budget router admitted an n=48 "
+                        "solve — admission model broken")
+    except SlateError:
+        pass
+    requests += 1
+
+    traces = serve_trace.finished_traces()[traces_before:]
+    # every request terminated with exactly one outcome
+    if len(traces) != requests:
+        failures.append(f"SLA phase: {requests} requests produced "
+                        f"{len(traces)} finished traces")
+    if any(t.outcome is None for t in traces):
+        failures.append("SLA phase: a finished trace has no terminal "
+                        "outcome")
+    sla = serve_trace.sla_values()
+    total_outcomes = sum(v for k, v in sla.items()
+                         if k.startswith("outcome_")
+                         and not k.startswith("outcome_rate_"))
+    if total_outcomes != requests:
+        failures.append(
+            f"SLA phase: outcome attribution totals {total_outcomes} != "
+            f"request count {requests} — a request is unattributed or "
+            "double-attributed")
+    # nonempty latency histogram per accuracy class, p50 <= p95 <= p99
+    for op, klass in (("gesv", "friendly"), ("gesv", "hostile"),
+                      ("posv", "friendly")):
+        if sla.get(f"latency_count_{op}_{klass}", 0) <= 0:
+            failures.append(f"SLA phase: empty latency histogram for "
+                            f"({op}, {klass})")
+            continue
+        p50 = sla[f"latency_p50_{op}_{klass}_s"]
+        p95 = sla[f"latency_p95_{op}_{klass}_s"]
+        p99 = sla[f"latency_p99_{op}_{klass}_s"]
+        if not (0 <= p50 <= p95 <= p99):
+            failures.append(f"SLA phase: quantiles not monotone for "
+                            f"({op}, {klass}): {p50} / {p95} / {p99}")
+    # export surfaces: Perfetto request timeline + Prometheus text
+    trace_path = os.path.join(out_dir, "serve_requests.trace.json")
+    perfetto.write_request_trace(trace_path, traces)
+    with open(trace_path) as f:
+        errs = perfetto.validate_chrome_trace(json.load(f))
+    if errs:
+        failures.append(f"SLA phase: request timeline invalid: {errs[:3]}")
+    text = prometheus_text(stats_snapshot())
+    for needle in ("slate_tpu_serve_requests", "slate_tpu_serve_latency_s",
+                   'quantile="0.99"'):
+        if needle not in text:
+            failures.append(f"SLA phase: {needle!r} missing from the "
+                            "Prometheus export")
+    sla_rep_path = os.path.join(out_dir, "serve_sla.report.json")
+    report.write_report(
+        sla_rep_path, name="serve_sla",
+        config={"n": n, "bins": "64", "driver": "router_meshless"},
+        values={"serve.sla_requests": float(requests),
+                "serve.sla_traces": float(len(traces))})
+    with open(sla_rep_path) as f:
+        errs = report.validate_report(json.load(f))
+    if errs:
+        failures.append(f"SLA RunReport schema: {errs}")
+    return {"requests": requests, "traces": len(traces),
+            "report": sla_rep_path, "trace": trace_path}
 
 
 def run_smoke(out_dir: str, n: int = 512, batch: int = 8) -> int:
@@ -223,8 +344,11 @@ def run_smoke(out_dir: str, n: int = 512, batch: int = 8) -> int:
         if explicit.get(Option.Lookahead) != 0:
             failures.append("explicit option lost to the tuned table")
 
-    # report ----------------------------------------------------------------
+    # (e) request-level SLA observability (ISSUE 14) -----------------------
     os.makedirs(out_dir, exist_ok=True)
+    sla = run_sla_phase(out_dir, failures)
+
+    # report ----------------------------------------------------------------
     rep_path = os.path.join(out_dir, "serve.report.json")
     values = {
         # machine-dependent rates: _runtime_ infix => CI gate --ignore's
@@ -262,8 +386,9 @@ def run_smoke(out_dir: str, n: int = 512, batch: int = 8) -> int:
         return 1
     print(f"serve.smoke: OK — {thr['speedup']:.1f}x batched speedup, "
           f"{int(serve_sec['traces'])} trace(s) over "
-          f"{len(executable_cache)} program(s), 0 retraces, report "
-          f"{rep_path}")
+          f"{len(executable_cache)} program(s), 0 retraces, "
+          f"{sla['requests']} SLA request(s) fully attributed, report "
+          f"{rep_path} + {sla['report']}")
     return 0
 
 

@@ -1,0 +1,194 @@
+"""Metrics export surface: Prometheus-style text + JSON snapshots of the
+serving registry (ISSUE 14).
+
+The library half is ``stats_snapshot()`` / ``prometheus_text()`` — a
+server embedding the Router exposes its scrape endpoint by returning
+``prometheus_text()`` from a handler; counters map to Prometheus
+counters, gauges to gauges, and histograms to summary-style series with
+``quantile="0.5|0.95|0.99"`` labels from the first-class reservoir
+quantiles (obs/metrics.py).
+
+The CLI::
+
+    python -m slate_tpu.serve.stats [REPORT.json] [--json OUT] [--demo]
+
+- with a RunReport argument, formats THAT report's ``serve`` section +
+  metric series (the offline view of a committed artifact — CI runs it
+  over the fresh SLA report as a format smoke);
+- without one, snapshots the LIVE registry of this process (``--demo``
+  first drives a tiny batched workload through the Router so a bare
+  invocation shows a populated surface);
+- ``--json`` additionally writes the machine-readable snapshot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+from .metrics import _sanitize_key, serve_counter_values
+
+_PREFIX = "slate_tpu_serve"
+
+
+def stats_snapshot() -> dict:
+    """JSON-able snapshot of the live serving surface: the serve.*
+    counter section (with the SLA reduction merged in), the exact
+    outcome-attribution totals, and every ``serve.*``-named metric
+    series in the shared registry."""
+    from ..obs import REGISTRY
+    from . import trace as _trace
+
+    snap = REGISTRY.snapshot()
+    serve_metrics = {
+        kind: [e for e in entries if e["name"].startswith("serve.")]
+        for kind, entries in snap.items()
+    }
+    return {
+        "serve": serve_counter_values(),
+        "sla": _trace.sla_values(),
+        "finished_requests": len(_trace.finished_traces()),
+        "metrics": serve_metrics,
+    }
+
+
+def _fmt_tags(tags: Dict[str, str], extra: Optional[Dict[str, str]] = None
+              ) -> str:
+    items = dict(tags or {})
+    if extra:
+        items.update(extra)
+    if not items:
+        return ""
+    body = ",".join(f'{_sanitize_key(k)}="{v}"'
+                    for k, v in sorted(items.items()))
+    return "{" + body + "}"
+
+
+def prometheus_text(snapshot: Optional[dict] = None) -> str:
+    """Prometheus exposition-format text of a ``stats_snapshot()``
+    (taken live when not given).  Rows are grouped per metric NAME with
+    exactly one ``# TYPE`` header each — multiple tag sets of one
+    metric (the (op, klass, outcome) latency series) are one metric
+    family to Prometheus, and a repeated TYPE line is a parse error."""
+    snap = snapshot if snapshot is not None else stats_snapshot()
+    # family name -> (kind, [sample rows]); insertion-ordered
+    families: Dict[str, tuple] = {}
+
+    def emit(name: str, kind: str, rows) -> None:
+        fam = families.setdefault(name, (kind, []))
+        fam[1].extend(rows)
+
+    # flat serve counters (+ merged SLA keys): the RunReport serve section
+    for key, val in sorted((snap.get("serve") or {}).items()):
+        name = f"{_PREFIX}_{_sanitize_key(key)}"
+        emit(name, "gauge" if "latency" in key or "rate" in key
+             else "counter", [f"{name} {val:.10g}"])
+    # registry series (tagged counters/gauges/histograms)
+    m = snap.get("metrics") or {}
+    for e in m.get("counters", []):
+        name = f"slate_tpu_{_sanitize_key(e['name'])}_total"
+        emit(name, "counter",
+             [f"{name}{_fmt_tags(e.get('tags'))} {e['value']:.10g}"])
+    for e in m.get("gauges", []):
+        name = f"slate_tpu_{_sanitize_key(e['name'])}"
+        emit(name, "gauge",
+             [f"{name}{_fmt_tags(e.get('tags'))} {e['value']:.10g}"])
+    for e in m.get("histograms", []):
+        name = f"slate_tpu_{_sanitize_key(e['name'])}"
+        rows = [
+            f"{name}_count{_fmt_tags(e.get('tags'))} {e['count']}",
+            f"{name}_sum{_fmt_tags(e.get('tags'))} {e['sum']:.10g}",
+        ]
+        for label, qkey in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            qv = e.get(qkey)
+            if qv is not None:
+                rows.append(
+                    f"{name}{_fmt_tags(e.get('tags'), {'quantile': label})}"
+                    f" {qv:.10g}")
+        emit(name, "summary", rows)
+    lines: List[str] = []
+    for name, (kind, rows) in families.items():
+        lines.append(f"# TYPE {name} {kind}")
+        lines.extend(rows)
+    return "\n".join(lines) + "\n"
+
+
+def snapshot_from_report(rep: dict) -> dict:
+    """Rebuild the stats surface from a committed RunReport (the offline
+    twin of the live snapshot)."""
+    metrics = rep.get("metrics") or {}
+    return {
+        "serve": dict(rep.get("serve") or {}),
+        "sla": {k: v for k, v in (rep.get("serve") or {}).items()
+                if k.startswith(("latency_", "outcome_"))},
+        "finished_requests": None,
+        "metrics": {
+            kind: [e for e in metrics.get(kind, [])
+                   if str(e.get("name", "")).startswith("serve.")]
+            for kind in ("counters", "gauges", "histograms")
+        },
+    }
+
+
+def _run_demo() -> None:
+    """Tiny meshless Router workload so a bare CLI run shows a populated
+    surface (small n — the point is the export format, not the solve)."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .. import obs
+    from .router import Router
+
+    obs.enable()
+    rng = np.random.default_rng(0)
+    router = Router(bins=(32,), hbm_budget=1 << 30)
+    n = 32
+    for seed in range(3):
+        g = rng.standard_normal((n, n))
+        a = jnp.asarray(g @ g.T / n + 2 * np.eye(n))
+        b = jnp.asarray(rng.standard_normal((n, 2)))
+        router.solve("posv", a, b)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m slate_tpu.serve.stats", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("report", nargs="?",
+                    help="RunReport JSON to format instead of the live "
+                         "registry")
+    ap.add_argument("--json", metavar="PATH",
+                    help="also write the JSON snapshot to PATH")
+    ap.add_argument("--demo", action="store_true",
+                    help="drive a tiny Router workload first (live mode)")
+    args = ap.parse_args(argv)
+
+    if args.report:
+        try:
+            with open(args.report) as f:
+                rep = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"serve.stats: cannot read report: {e}", file=sys.stderr)
+            return 2
+        snap = snapshot_from_report(rep)
+    else:
+        if args.demo:
+            _run_demo()
+        snap = stats_snapshot()
+
+    text = prometheus_text(snap)
+    sys.stdout.write(text)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(snap, f, indent=1)
+        print(f"# snapshot written to {args.json}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -246,6 +246,35 @@ def test_qr_kill_resume_bitwise(tmp_path):
         elastic.resume(ck, mesh42())
 
 
+def test_qr_ckpt_orth_gauge_bitwise_and_recorded():
+    """ISSUE 14 satellite (ROADMAP "NumMonitor gauges through the QR/eig
+    segment chains"): the monitored CAQR chain carries the per-panel
+    reflector/τ orthogonality-loss proxy — results stay BITWISE equal to
+    the unmonitored chain (and hence the fused kernel), the gauge lands
+    as num.qr_orth_margin / qr_orth_loss_max (eps-class for a healthy
+    operand), and off mode records nothing."""
+    from slate_tpu.obs import numerics as num
+
+    mesh = mesh24()
+    d = from_dense(_operand("general"), mesh, NB)
+    ref = geqrf_dist(d)
+    num.reset()
+    _assert_tree_bitwise(ref, ckpt.geqrf_ckpt(d, every=EVERY,
+                                              num_monitor="on"),
+                         "monitored geqrf ckpt vs fused")
+    vals = num.num_counter_values()
+    assert 0.0 < vals["qr_orth_loss_max"] < 1e-10  # ~eps64, healthy panel
+    assert num.last_gauges("geqrf")["qr_orth_loss"] \
+        == vals["qr_orth_loss_max"]
+    # off mode: the plain (unchanged) segment chain — already compiled by
+    # test_qr_kill_resume_bitwise — records nothing (the kill->resume
+    # gauge flow itself rides the same snapshot gauges dict the potrf/LU
+    # chains tier-1-test; no extra segment compiles here)
+    num.reset()
+    ckpt.geqrf_ckpt(d, every=EVERY, num_monitor="off")
+    assert num.num_counter_values()["qr_orth_loss_max"] == 0.0
+
+
 def test_in_segment_kill_loses_steps_since_snapshot():
     """KillFault(in_segment=True): the partial segment really executes
     (then dies), the loss counter reads exactly kill.k − last_snapshot

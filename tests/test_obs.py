@@ -418,3 +418,53 @@ def test_check_mixed_schema_sections_inconclusive(tmp_path, capsys):
     assert out.count("INCONCLUSIVE") == 2
     assert "sched.overlap_eff" in out and "sched.critical_path_s" in out
     obs.reset()
+
+
+def test_histogram_quantiles_exact_reservoir_and_snapshot():
+    """ISSUE 14 satellite: first-class histogram quantiles — exact
+    (interpolated over every observation) below the reservoir cap with
+    running-stats clamping, a deterministic reservoir estimate beyond
+    it, and p50/p95/p99 surfaced in snapshots."""
+    from slate_tpu.obs.metrics import (
+        _HIST_SAMPLE_CAP,
+        MetricsRegistry,
+        quantile_of,
+    )
+
+    reg = MetricsRegistry()
+    # tiny counts: 1 observation returns it, 2 interpolate exactly
+    reg.observe("lat", 3.0, op="tiny")
+    assert reg.quantile("lat", 0.0, op="tiny") == 3.0
+    assert reg.quantile("lat", 0.99, op="tiny") == 3.0
+    reg.observe("lat", 5.0, op="tiny")
+    assert reg.quantile("lat", 0.5, op="tiny") == 4.0
+    # exact tier: 1..10 -> interpolated median 5.5, extremes exact
+    for v in range(1, 11):
+        reg.observe("lat", float(v), op="x")
+    assert reg.quantile("lat", 0.5, op="x") == 5.5
+    assert reg.quantile("lat", 0.0, op="x") == 1.0
+    assert reg.quantile("lat", 1.0, op="x") == 10.0
+    # an unobserved series has no quantiles
+    assert reg.quantile("lat", 0.5, op="nope") is None
+    with pytest.raises(ValueError):
+        quantile_of([1.0], 1.5)
+    # beyond the cap: reservoir estimate stays within the exact running
+    # extrema, monotone across q, with deterministic samples
+    nbig = 4 * _HIST_SAMPLE_CAP
+    for v in range(nbig):
+        reg.observe("lat", float(v), op="big")
+    p50 = reg.quantile("lat", 0.5, op="big")
+    p95 = reg.quantile("lat", 0.95, op="big")
+    p99 = reg.quantile("lat", 0.99, op="big")
+    assert 0.0 <= p50 <= p95 <= p99 <= nbig - 1
+    assert abs(p50 - nbig / 2) < nbig * 0.15  # loose reservoir sanity
+    reg2 = MetricsRegistry()
+    for v in range(nbig):
+        reg2.observe("lat", float(v), op="big")
+    assert reg2.quantile("lat", 0.99, op="big") == p99  # deterministic
+    # snapshot carries the quantile surface per series
+    hsnap = {(e["name"], str(sorted(e["tags"].items()))): e
+             for e in reg.snapshot()["histograms"]}
+    entry = hsnap[("lat", str(sorted({"op": "x"}.items())))]
+    assert entry["count"] == 10 and entry["p50"] == 5.5
+    assert entry["p99"] <= entry["max"] == 10.0

@@ -454,6 +454,196 @@ def test_router_rejects_unresumable_preemption(rng):
     assert after == before + 2
 
 
+def _nested_ok(tr):
+    """Phase spans nest correctly: every child interval lies inside an
+    enclosing span named by its parent, and depths are consistent."""
+    for ph in tr.phases:
+        assert ph["t1"] >= ph["t0"] >= tr.t0
+        if ph["parent"] is None:
+            assert ph["depth"] == 0
+        else:
+            assert ph["depth"] >= 1
+            encl = [p for p in tr.phases
+                    if p["name"] == ph["parent"] and p is not ph
+                    and p["t0"] <= ph["t0"] and p["t1"] >= ph["t1"]
+                    and p["depth"] == ph["depth"] - 1]
+            assert encl, (ph["name"], ph["parent"])
+
+
+# ---------------------------------------------------------------------------
+# request-level observability (ISSUE 14): trace completeness across the
+# degradation ladder, disabled-mode honesty, SLA/export surfaces.  The
+# ladder cases reuse the EXACT router opts/shapes of the degradation
+# tests above (NumMonitor pinned off where they resolved off), so every
+# mesh program is already compiled — lean by construction.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("case,want", [
+    ("clean", "served"),
+    ("ft_retry", "served_retry"),
+    ("resume", "served_resume"),
+    ("growth_abort", "served_growth_retry"),
+    ("reject", "reject_unresumable"),
+])
+def test_request_trace_degradation_ladder(rng, case, want):
+    """Every Router path terminates its RequestTrace with exactly ONE
+    outcome attributing the exit to one cause, phase spans nest, and
+    served requests land in the (op, class, outcome)-tagged latency
+    histogram."""
+    from slate_tpu import obs
+    from slate_tpu.ft import FtPolicy, inject
+    from slate_tpu.obs.metrics import REGISTRY
+    from slate_tpu.serve import trace as rtrace
+
+    n = 64
+    a = _spd_one(rng)
+    b = jnp.asarray(rng.standard_normal((n, 2)))
+    nmoff = {Option.NumMonitor: "off"}
+    with obs.force_enabled(True):
+        before = len(rtrace.finished_traces())
+        if case == "clean":
+            router = _resilient_router({Option.Checkpoint: 3, **nmoff})
+            router.solve("posv", a, b)
+        elif case == "ft_retry":
+            router = _resilient_router(
+                {Option.FaultTolerance: FtPolicy.Detect, **nmoff})
+            f = inject.seeded_fault(12, "potrf", 8, (2, 4), phase="panel")
+            with inject.fault_scope(inject.FaultPlan([f])):
+                router.solve("posv", a, b)
+        elif case == "resume":
+            router = _resilient_router({Option.Checkpoint: 3, **nmoff})
+            with inject.fault_scope(
+                inject.FaultPlan([inject.KillFault("potrf", 4)])
+            ):
+                router.solve("posv", a, b)
+        elif case == "growth_abort":
+            router = _resilient_router({Option.Checkpoint: 3,
+                                        Option.NumMonitor: "on"})
+            g = rng.standard_normal((n, n)) + n * np.eye(n)
+            g[0, 0] = 1e-9  # nopiv growth explodes; pp retry swaps it
+            router.solve("gesv", jnp.asarray(g), b)
+        elif case == "reject":
+            router = _resilient_router({Option.Checkpoint: 3, **nmoff})
+            with inject.fault_scope(
+                inject.FaultPlan([inject.KillFault("potrf", 1)])
+            ):
+                with pytest.raises(SlateError, match="unresumable"):
+                    router.solve("posv", a, b)
+        traces = rtrace.finished_traces()[before:]
+    assert len(traces) == 1
+    tr = traces[0]
+    assert tr.outcome == want
+    # exactly one terminal: a second finish is a programming error
+    with pytest.raises(RuntimeError, match="already terminal"):
+        tr.finish("served")
+    _nested_ok(tr)
+    names = [ph["name"] for ph in tr.phases]
+    assert "admission" in names
+    if want.startswith("served"):
+        assert "factor" in names and "solve" in names
+        klass = tr.klass or "friendly"
+        hist = [h for h in REGISTRY.histogram_series("serve.latency_s")
+                if h["tags"] == {"op": tr.op, "klass": klass,
+                                 "outcome": want}]
+        assert hist and hist[-1]["count"] >= 1
+    if want == "served_retry":
+        assert "retry" in names and tr.notes == ["ft_retry"]
+    if want == "served_resume":
+        assert "resume" in names and tr.notes == ["resume"]
+    if want in ("served_retry", "served_resume", "served_growth_retry"):
+        # the degradation ladder renders as flow arrows chaining the
+        # retry/resume span(s) to the final dispatch — validator-clean
+        from slate_tpu.obs import perfetto
+
+        evs = perfetto.request_trace_events([tr])
+        assert perfetto.validate_chrome_trace({"traceEvents": evs}) == []
+        starts = [e for e in evs if e.get("ph") == "s"]
+        ends = [e for e in evs if e.get("ph") == "f"]
+        assert starts and len(starts) == len(ends)
+    if want == "served_growth_retry":
+        # the pivoted retry's factor/solve nest under the retry span
+        retried = [ph for ph in tr.phases
+                   if ph["parent"] == "retry" and ph["name"] == "factor"]
+        assert retried and tr.notes == ["growth_retry"]
+
+
+def test_request_trace_disabled_honest_and_dispatch_identical(rng):
+    """Obs off => ZERO trace allocations (new_trace returns None, the
+    finished stream stays empty) and the Router dispatch is
+    byte-identical: the solution bits match the traced run's, and the
+    batched program's jaxpr is the same traced or not (tracing is
+    host-side only — the no-new-collectives contract)."""
+    from slate_tpu import obs
+    from slate_tpu.obs import perfetto
+    from slate_tpu.serve import trace as rtrace
+    from slate_tpu.serve.router import Router, _build_batched
+    from slate_tpu.serve.stats import prometheus_text, stats_snapshot
+
+    n = 32  # the accuracy-class test's shapes: programs already warm
+    good = jnp.asarray(rng.standard_normal((n, n)) + n * np.eye(n))
+    b = jnp.asarray(rng.standard_normal((n, 2)))
+    router = Router(bins=(32,), hbm_budget=1 << 30)
+    with obs.force_enabled(False):
+        assert rtrace.new_trace("gesv", n, 8, "float64") is None
+        before = len(rtrace.finished_traces())
+        x_off = router.solve("gesv", good, b)
+        assert len(rtrace.finished_traces()) == before  # zero allocations
+    with obs.force_enabled(True):
+        x_on = router.solve("gesv", good, b)
+        traces = rtrace.finished_traces()[before:]
+    assert len(traces) == 1 and traces[0].outcome == "served"
+    np.testing.assert_array_equal(np.asarray(x_off), np.asarray(x_on))
+    # dispatch-identical: the stacked program's jaxpr is invariant under
+    # an armed tracer (host-side spans cannot reach the compiled code)
+    fn = _build_batched("posv", "friendly")
+    spd = _spd_stack(rng, 1, 16)
+    bb = jnp.asarray(rng.standard_normal((1, 16, 1)))
+    j_off = str(jax.make_jaxpr(fn)(spd, bb))
+    with obs.force_enabled(True):
+        j_on = str(jax.make_jaxpr(fn)(spd, bb))
+    assert j_off == j_on
+    # export surfaces over the traced request: SLA reduction keys,
+    # Perfetto request timeline, Prometheus text
+    sla = rtrace.sla_values()
+    assert sla["latency_count_gesv_friendly"] >= 1
+    p50 = sla["latency_p50_gesv_friendly_s"]
+    p99 = sla["latency_p99_gesv_friendly_s"]
+    assert 0 <= p50 <= p99
+    total = sum(v for k, v in sla.items() if k.startswith("outcome_")
+                and not k.startswith("outcome_rate_"))
+    assert total == len(rtrace.finished_traces())
+    evs = perfetto.request_trace_events(traces)
+    assert perfetto.validate_chrome_trace({"traceEvents": evs}) == []
+    assert any(e.get("args", {}).get("name") == "serve[friendly]"
+               for e in evs if e.get("ph") == "M")
+    txt = prometheus_text(stats_snapshot())
+    assert "slate_tpu_serve_requests" in txt
+    assert 'quantile="0.99"' in txt
+
+
+def test_request_trace_batch_abort_attributes_siblings(rng):
+    """A failing request aborts the whole solve_batch call; its OWN
+    trace carries the cause (failed_info) and every sibling terminates
+    as reject_batch_abort — no trace leaks unterminated."""
+    from slate_tpu import obs
+    from slate_tpu.serve import trace as rtrace
+    from slate_tpu.serve.router import Router
+
+    n = 32
+    router = Router(bins=(32,), hbm_budget=1 << 30)
+    good = _spd_stack(rng, 1, n)[0]
+    b = jnp.asarray(rng.standard_normal((n, 2)))
+    with obs.force_enabled(True):
+        before = len(rtrace.finished_traces())
+        with pytest.raises(SlateError, match="nonzero info"):
+            router.solve_batch([("posv", good, b),
+                                ("posv", jnp.asarray(-np.eye(n)), b)])
+        traces = rtrace.finished_traces()[before:]
+    assert sorted(t.outcome for t in traces) \
+        == ["failed_info", "reject_batch_abort"]
+
+
 def test_router_growth_abort_retries_with_pivoting(rng):
     """ISSUE 13 satellite (ROADMAP "close the control loop"): on the
     monitored checkpointed path, gesv tries the cheap no-pivot factor
