@@ -56,6 +56,16 @@ python -m slate_tpu.obs.report --check \
     artifacts/obs_flight/flight_potrf.flight.json \
     artifacts/obs/flight_potrf.flight.json --threshold 4 \
     --ignore 'sched.*_s' --ignore 'sched.overlap_eff'
+# ISSUE 15: the QR/eig chains' flights (strict schedules — the smoke
+# asserts overlap_eff == 0 by construction; the byte surface gates here)
+python -m slate_tpu.obs.report --check \
+    artifacts/obs_flight/flight_geqrf.flight.json \
+    artifacts/obs/flight_geqrf.flight.json --threshold 4 \
+    --ignore 'sched.*_s' --ignore 'sched.overlap_eff'
+python -m slate_tpu.obs.report --check \
+    artifacts/obs_flight/flight_he2hb.flight.json \
+    artifacts/obs/flight_he2hb.flight.json --threshold 4 \
+    --ignore 'sched.*_s' --ignore 'sched.overlap_eff'
 
 # memwatch smoke (ISSUE 9): the HBM memory observability layer — AOT
 # compile memory analysis of summa + potrf on the 8-device mesh must
@@ -92,7 +102,7 @@ python -m slate_tpu.obs.report --check \
 # are bitwise-reproducible at fixed shape, so only the wall-clock keys
 # are --ignore'd — the accuracy surface gates tight.
 python -m slate_tpu.obs.numwatch --smoke --out artifacts/obs_num
-for op in lu potrf mixed; do
+for op in lu potrf mixed qr; do
   python -m slate_tpu.obs.report --check \
       "artifacts/obs_num/num_${op}.report.json" \
       "artifacts/obs/num_${op}.report.json" \
@@ -152,6 +162,14 @@ python -m slate_tpu.obs.report --check \
     --ignore '*latency*_s'
 python -m slate_tpu.serve.stats artifacts/serve_ci/serve_sla.report.json \
     > /dev/null
+# the export surface's new families (ISSUE 15): one scrape carries the
+# num.* accuracy gauges and the sched.* schedule keys next to serve.* —
+# format the fresh numwatch + flight artifacts and assert both appear
+python -m slate_tpu.serve.stats artifacts/obs_num/num_qr.report.json \
+    | grep -q 'slate_tpu_num_qr_orth_margin_fused'
+python -m slate_tpu.serve.stats \
+    artifacts/obs_flight/flight_geqrf.flight.json \
+    | grep -q 'slate_tpu_sched_model_bytes'
 
 # scaling-curve artifact (ISSUE 7 satellite): fold the MULTICHIP round
 # artifacts into one RunReport-schema curve and schema-validate it

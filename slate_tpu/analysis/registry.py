@@ -942,6 +942,52 @@ def _getrf_nopiv_flight(ctx):
     return _flight_build(ctx, "getrf_nopiv", "tril")
 
 
+@register("geqrf_dist_flight", tags=("flight",))
+def _geqrf_flight(ctx):
+    """One full CAQR flight k-step over the MULTI-ARRAY carry (ISSUE 15):
+    panel -> three rooted column broadcasts -> trailing update + tree
+    merge, composed through obs.flight.step_traceable with k a runtime
+    scalar — proving the recorder's per-step programs add zero audited
+    collectives beyond the fused kernel's schedule (the PR 10/14
+    contract's flight sibling).  Carry shapes come from ckpt._multi_init,
+    the one authority the drivers themselves use."""
+    import jax.numpy as jnp
+
+    from ..ft import ckpt
+    from ..obs.flight import step_traceable
+
+    a = ctx.dist()
+    st = {}
+    ckpt._multi_init("geqrf", a, st, a.nt)
+    mtl, ntl = a.tiles.shape[0] // ctx.p, a.tiles.shape[1] // ctx.q
+    fn = step_traceable("geqrf", ctx.mesh, ctx.p, ctx.q, a.nt, mtl, ntl,
+                        a.nb)
+    k = jnp.asarray(1)
+    return fn, (a.tiles, st["tls"], st["tvs"], st["tts"], k)
+
+
+@register("he2hb_flight", tags=("flight",))
+def _he2hb_flight(ctx):
+    """One full he2hb flight k-step (rooted panel-column broadcast + row
+    gather -> replicated panel QR -> distributed two-sided update) over
+    the reflector/WY carry, k a runtime scalar (ISSUE 15)."""
+    import jax.numpy as jnp
+
+    from ..ft import ckpt
+    from ..linalg.eig import _he2hb_panel_count
+    from ..obs.flight import step_traceable
+
+    a = ctx.dist(kind="spd")
+    nsteps = _he2hb_panel_count(a.n, a.nb)
+    st = {}
+    ckpt._multi_init("he2hb", a, st, nsteps)
+    mtl, ntl = a.tiles.shape[0] // ctx.p, a.tiles.shape[1] // ctx.q
+    fn = step_traceable("he2hb", ctx.mesh, ctx.p, ctx.q, a.nt, mtl, ntl,
+                        a.nb)
+    k = jnp.asarray(1)
+    return fn, (a.tiles, st["vqs"], st["tqs"], k)
+
+
 # ---------------------------------------------------------------------------
 # Numerics-monitored variants (ISSUE 10): the Option.NumMonitor=on
 # lowerings under the gate.  The default entries above trace nm=off
@@ -1199,6 +1245,28 @@ def _he2hb_ckpt_seg(ctx):
         "auto")), (a.tiles, st["vqs"], st["tqs"])
 
 
+@register("he2hb_ckpt_seg_num", tags=("ckpt", "num"))
+def _he2hb_ckpt_seg_num(ctx):
+    """The MONITORED he2hb segment (ISSUE 15): the same panel steps with
+    the in-carry orthogonality-loss gauge — results bitwise, the gauge
+    replicated (no reduction at all), audited wire bytes matching the
+    plain ``he2hb_ckpt_seg`` exactly."""
+    import jax.numpy as jnp
+
+    from ..ft import ckpt
+    from ..linalg.eig import _he2hb_panel_count
+    from ..parallel.comm import num_gauge_dtype
+
+    a = ctx.dist(kind="spd")
+    nsteps = _he2hb_panel_count(a.n, a.nb)
+    st = {}
+    ckpt._multi_init("he2hb", a, st, nsteps)
+    g0 = jnp.zeros((), num_gauge_dtype(a.dtype))
+    return (lambda t, v, s, g: ckpt._he2hb_seg_nm_jit(
+        t, v, s, g, ctx.mesh, ctx.p, ctx.q, a.n, a.nb, 1, max(nsteps, 2),
+        "auto")), (a.tiles, st["vqs"], st["tqs"], g0)
+
+
 def _ft_her2k_build(ctx, armed):
     """The checksum-carrying her2k under the gate: encode -> augmented
     rank-2k kernel (the shared dist_blas3 panel schedule) -> checksum
@@ -1316,6 +1384,29 @@ def _getrf_tnt_num(ctx):
 
     a = ctx.dist(diag_pad=True)
     return (lambda x: getrf_tntpiv_dist(x, num_monitor="on")), (a,)
+
+
+@register("geqrf_dist_num", tags=("num",))
+def _geqrf_num(ctx):
+    """The FUSED monitored CAQR loop (ISSUE 15): the per-panel
+    reflector/τ orthogonality-loss gauge riding the fori_loop carry —
+    the only reduction the unaudited exit pmax (the _lu_info_dist
+    class), so audited wire bytes match the unmonitored trace."""
+    from ..parallel.dist_qr import geqrf_dist
+
+    a = ctx.dist()
+    return (lambda x: geqrf_dist(x, num_monitor="on")), (a,)
+
+
+@register("he2hb_num", tags=("num",))
+def _he2hb_num(ctx):
+    """The FUSED monitored two-stage eig stage-1 loop (ISSUE 15): the
+    first eig-chain gauge — the replicated panel QR's loss proxy in the
+    carry, collective-free by replication."""
+    from ..parallel.dist_twostage import he2hb_dist
+
+    a = ctx.dist(kind="spd")
+    return (lambda x: he2hb_dist(x, num_monitor="on")), (a,)
 
 
 @register("posv_mixed_mesh_num", tags=("num", "mixed"))

@@ -695,6 +695,42 @@ def _he2hb_seg_jit(at, vqs, tqs, mesh, p, q, n_true, nb, k0, k1, bi):
         )(at, vqs, tqs)
 
 
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+def _he2hb_seg_nm_jit(at, vqs, tqs, g, mesh, p, q, n_true, nb, k0, k1, bi):
+    """The MONITORED twin of ``_he2hb_seg_jit`` (ISSUE 15): the same
+    ``dist_twostage._he2hb_step`` arithmetic — band/reflector/WY results
+    stay bitwise-identical to the plain chain — with the per-panel
+    reflector/τ consistency margin carried as a running max.  The panel
+    factors are REPLICATED, so the gauge needs no reduction at all:
+    collective-free, audited wire bytes unchanged.  The off mode never
+    calls this jit, so the unmonitored chain's jaxpr is untouched."""
+    spec = P(ROW_AXIS, COL_AXIS)
+
+    def kernel(t_loc, vq_loc, tq, g_in):
+        mtl, ntl, _, _ = t_loc.shape
+        a = jnp.transpose(t_loc, (0, 2, 1, 3)).reshape(mtl * nb, ntl * nb)
+        rdt = num_gauge_dtype(t_loc.dtype)
+
+        def step(k, carry):
+            *st3, gg = carry
+            out3, loss = _he2hb_step(k, tuple(st3), p, q, n_true, nb,
+                                     nm=True)
+            return out3 + (jnp.maximum(gg, loss),)
+
+        with audit_scope(k1 - k0):
+            a, vq_loc, tq, gg = lax.fori_loop(
+                k0, k1, step, (a, vq_loc, tq, g_in.astype(rdt)))
+        t_out = jnp.transpose(a.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
+        return t_out, vq_loc, tq, gg
+
+    with bcast_impl_scope(bi):
+        return shard_map_compat(
+            kernel, mesh=mesh,
+            in_specs=(spec, P(None, ROW_AXIS), P(), P()),
+            out_specs=(spec, P(None, ROW_AXIS), P(), P()), check_vma=False,
+        )(at, vqs, tqs, g)
+
+
 # ---------------------------------------------------------------------------
 # Host engine: segment chain + snapshot + kill consultation
 # ---------------------------------------------------------------------------
@@ -724,10 +760,15 @@ def _seg_dispatch(op, st, mesh, p, q, nt, m_true, k0, k1, bi, pi, nm):
             g = None
     elif op == "he2hb":
         nb = st["tiles"].shape[-1]
-        st["tiles"], st["vqs"], st["tqs"] = _he2hb_seg_jit(
-            st["tiles"], st["vqs"], st["tqs"], mesh, p, q, m_true, nb,
-            k0, k1, bi)
-        g = None
+        if nm:
+            st["tiles"], st["vqs"], st["tqs"], g = _he2hb_seg_nm_jit(
+                st["tiles"], st["vqs"], st["tqs"], st["g"], mesh, p, q,
+                m_true, nb, k0, k1, bi)
+        else:
+            st["tiles"], st["vqs"], st["tqs"] = _he2hb_seg_jit(
+                st["tiles"], st["vqs"], st["tqs"], mesh, p, q, m_true, nb,
+                k0, k1, bi)
+            g = None
     else:
         raise ValueError(f"no checkpointed driver for op {op!r}; "
                          f"expected one of {CKPT_OPS}")
@@ -799,6 +840,8 @@ def _finish(op, d: DistMatrix, st, nm):
         return DistQR(fd, st["tls"], st["tvs"], st["tts"])
     if op == "he2hb":
         band = DistMatrix(tiles=st["tiles"], m=d.m, n=d.n, nb=d.nb, mesh=mesh)
+        if nm:
+            _num.record_he2hb_orth("he2hb", st["g"])
         return DistTwoStage(band, st["vqs"], st["tqs"],
                             st["vqs"][:0], st["tqs"][:0])
     out = DistMatrix(
@@ -863,8 +906,6 @@ def _run(op: str, d: DistMatrix, k_from: int, every: int, bi: str, pi: str,
             else jnp.arange(nt * d.nb)
         )
     if op in _MULTI_KEYS:
-        if op != "geqrf":
-            nm = False  # no NumMonitor gauges thread he2hb (yet)
         if arrays:
             for kk in _MULTI_KEYS[op]:
                 st[kk] = jnp.asarray(arrays[kk])
@@ -874,7 +915,7 @@ def _run(op: str, d: DistMatrix, k_from: int, every: int, bi: str, pi: str,
         if op == "potrf":
             st["g"] = (jnp.asarray(gauges["g"]) if gauges
                        else jnp.asarray(jnp.inf, num_gauge_dtype(d.dtype)))
-        elif op == "geqrf":
+        elif op in ("geqrf", "he2hb"):
             # running max of the per-panel orthogonality-loss proxy
             # (dist_qr._qr_orth_loss); 0 = nothing observed yet
             st["g"] = (jnp.asarray(gauges["g"]) if gauges
@@ -1035,7 +1076,7 @@ def geqrf_ckpt(a: DistMatrix, every=None, bcast_impl: Optional[str] = None,
     num-section total; off keeps the plain (unchanged) segment jits."""
     ev = resolve_checkpoint(every)
     if ev is None:
-        return geqrf_dist(a, bcast_impl=bcast_impl)
+        return geqrf_dist(a, bcast_impl=bcast_impl, num_monitor=num_monitor)
     if a.m < a.n:
         raise ValueError(f"geqrf_ckpt requires m >= n, got {a.m}x{a.n}")
     return _run("geqrf", a, 0, ev, resolve_bcast_impl(bcast_impl), "xla",
@@ -1045,17 +1086,25 @@ def geqrf_ckpt(a: DistMatrix, every=None, bcast_impl: Optional[str] = None,
 
 @instrument("he2hb_ckpt")
 def he2hb_ckpt(a: DistMatrix, every=None, bcast_impl: Optional[str] = None,
-               async_snapshots=None):
+               async_snapshots=None, num_monitor: Optional[str] = None):
     """Checkpointed two-stage eig stage-1 reduction (ISSUE 13):
     ``he2hb_dist`` results (bitwise) with the multi-array carry — tile
     stack evolving toward the band, sharded reflector stack, replicated
     compact-WY accumulators — snapshotted every ``every`` panel steps.
     Returns DistTwoStage; raises ``Preempted`` under an armed kill
-    fault.  Grid-locked carry, as geqrf_ckpt."""
+    fault.  Grid-locked carry, as geqrf_ckpt.
+
+    ``num_monitor`` (Option.NumMonitor, ISSUE 15): ``on`` carries the
+    per-panel reflector/τ orthogonality-loss proxy — the first eig-chain
+    gauge — as a running max through the segment chain (results bitwise,
+    collective-free: the panel factors are replicated), surfaced as the
+    ``num.he2hb_orth_margin`` gauge / ``he2hb_orth_loss_max`` total;
+    off keeps the plain (unchanged) segment jits."""
     ev = resolve_checkpoint(every)
     if a.m != a.n:
         raise ValueError("he2hb_ckpt needs a square matrix")
     if ev is None or _he2hb_panel_count(a.n, a.nb) == 0:
-        return he2hb_dist(a)
+        return he2hb_dist(a, bcast_impl=bcast_impl, num_monitor=num_monitor)
     return _run("he2hb", a, 0, ev, resolve_bcast_impl(bcast_impl), "xla",
-                False, async_snap=resolve_ckpt_async(async_snapshots))
+                resolve_num_monitor(num_monitor) == "on",
+                async_snap=resolve_ckpt_async(async_snapshots))

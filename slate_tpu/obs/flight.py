@@ -64,7 +64,12 @@ DEEP_ENV = "SLATE_TPU_OBS_DEEP"
 FLIGHT_SCHEMA = "slate_tpu.obs.flight_report"
 FLIGHT_VERSION = 1
 PHASES = ("panel", "bcast", "bulk")
-FLIGHT_OPS = ("summa", "potrf", "getrf_nopiv", "trsm")
+FLIGHT_OPS = ("summa", "potrf", "getrf_nopiv", "trsm", "geqrf", "he2hb")
+# strict-schedule ops: no lookahead pipelining exists for these k-loops
+# (panel k+1 reads the whole trailing update of step k), so the flight
+# always records the depth-0 issue order and the overlap lens reads 0 by
+# construction — the ScheduleModel byte surface is the regression gate
+_STRICT_OPS = ("geqrf", "he2hb")
 
 # bound on recorded events / hop-event groups so a big flight cannot grow
 # without limit (nt steps x 3 phases x P devices stays far below this)
@@ -696,6 +701,175 @@ def trsm_steps(at, bt, mesh, p, q, nt, uplo, op_, diag, la, bi):
     return b
 
 
+def _qr_phase_kernels(p, q, m_true):
+    """Raw per-device phase kernels of one CAQR panel step (the
+    module-level dist_qr._qr_panel_* helpers), shared by the
+    step-dispatch driver and the lint-registry traceable.  The carry is
+    MULTI-ARRAY (tile stack, T_loc stack sharded over 'p', replicated
+    tree V/T stacks — the ft/ckpt segment-jit layout)."""
+    from ..parallel.dist_qr import (
+        _qr_pad_identity, _qr_panel_bcast, _qr_panel_factor,
+        _qr_panel_update,
+    )
+
+    def panel_k(t_loc, k):
+        ro, vo, to = _qr_panel_factor(k, t_loc, p, q, m_true)
+        return ro[None, None], vo[None, None], to[None, None]
+
+    def bcast_k(ro, vo, to, k):
+        r_a, v, tl = _qr_panel_bcast((ro[0, 0], vo[0, 0], to[0, 0]), k, q)
+        return r_a[None, None], v[None, None], tl[None, None]
+
+    def update_k(t_loc, tls, tvs, tts, r_a, v, tl, k):
+        return _qr_panel_update(k, (t_loc, tls, tvs, tts),
+                                (r_a[0, 0], v[0, 0], tl[0, 0]), p, q,
+                                m_true)
+
+    def fin_k(t_loc, n_true):
+        return _qr_pad_identity(t_loc, p, q, n_true, t_loc.dtype)
+
+    return {"panel": panel_k, "bcast": bcast_k, "update": update_k,
+            "fin": fin_k}
+
+
+def geqrf_steps(at, mesh, p, q, nt, m_true, n_true, bi):
+    """Per-step distributed CAQR (the _geqrf_jit strict schedule over
+    dist_qr's module-level phase helpers), fenced per phase: panel = the
+    local offset-pivot QR + compact-WY T, bcast = the three rooted
+    column broadcasts of the panel factors, bulk = packed write +
+    trailing update + the all_gather'd tree merge/update."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.comm import bcast_impl_scope
+    from ..parallel.mesh import ROW_AXIS
+
+    rec = active_recorder()
+    spec, rep = _specs()
+    prow = P(ROW_AXIS)
+    nb = at.shape[2]
+    nmerge = max(1, p)
+    ks = _qr_phase_kernels(p, q, m_true)
+
+    panel = _Phase("geqrf", "panel",
+                   _sm(ks["panel"], mesh, (spec, rep), (spec, spec, spec)))
+    bcast = _Phase("geqrf", "bcast",
+                   _sm(ks["bcast"], mesh, (spec, spec, spec, rep),
+                       (spec, spec, spec)),
+                   trace_ctx=lambda: bcast_impl_scope(bi))
+    update = _Phase("geqrf", "bulk",
+                    _sm(ks["update"], mesh,
+                        (spec, prow, rep, rep, spec, spec, spec, rep),
+                        (spec, prow, rep, rep)))
+    fin = _Phase("geqrf", "panel",
+                 _sm(functools.partial(ks["fin"], n_true=n_true), mesh,
+                     (spec,), spec),
+                 label="fin")
+
+    coords = _coords(p, q)
+    if rec is not None:
+        rec.note_run(op="geqrf", nt=int(nt), depth=0, impl=bi, grid=(p, q),
+                     phases=PHASES)
+    dtype = at.dtype
+    t = at
+    tls = jax.device_put(jnp.zeros((p * nt, nb, nb), dtype),
+                         NamedSharding(mesh, prow))
+    tvs = jnp.zeros((nt, nmerge, 2 * nb, nb), dtype)
+    tts = jnp.zeros((nt, nmerge, nb, nb), dtype)
+    for k in range(nt):
+        po = panel(rec, k, coords, t, _ik(k))
+        pl = bcast(rec, k, coords, po[0], po[1], po[2], _ik(k), root_k=k)
+        t, tls, tvs, tts = update(rec, k, coords, t, tls, tvs, tts,
+                                  pl[0], pl[1], pl[2], _ik(k))
+    t = fin(None, 0, coords, t)
+    return t, tls, tvs, tts
+
+
+def _he2hb_phase_kernels(p, q, n_true, nb, mtl, ntl):
+    """Raw per-device phase kernels of one he2hb panel + two-sided
+    trailing step (the module-level dist_twostage._he2hb_* helpers).
+    The tile<->flat transposes at each dispatch boundary are exact byte
+    moves (the ft/ckpt segment-jit layout), so the chain stays bitwise
+    with the fused kernel."""
+    import jax.numpy as jnp
+
+    from ..parallel.dist_twostage import (
+        _he2hb_fetch, _he2hb_panel, _he2hb_update,
+    )
+
+    mfl, nfl = mtl * nb, ntl * nb
+
+    def _flat(t_loc):
+        return jnp.transpose(t_loc, (0, 2, 1, 3)).reshape(mfl, nfl)
+
+    def _tiles(a):
+        return jnp.transpose(a.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
+
+    def fetch_k(t_loc, k):
+        return _he2hb_fetch(k, _flat(t_loc), p, q, nb)
+
+    def panel_k(gpan, k):
+        return _he2hb_panel(k, gpan, n_true, nb)
+
+    def update_k(t_loc, vq_loc, tq, gpan, r_a, v, t, k):
+        a, vq_loc, tq = _he2hb_update(
+            k, (_flat(t_loc), vq_loc, tq), gpan, (r_a, v, t), p, q,
+            n_true, nb)
+        return _tiles(a), vq_loc, tq
+
+    return {"fetch": fetch_k, "panel": panel_k, "update": update_k}
+
+
+def he2hb_steps(at, mesh, p, q, n_true, nb, nsteps, bi):
+    """Per-step two-stage eig stage-1 reduction (the _he2hb_jit strict
+    schedule over dist_twostage's module-level phase helpers), fenced
+    per phase: bcast = the rooted panel-column broadcast + row gather,
+    panel = the replicated offset QR + T, bulk = band write + the
+    distributed two-sided trailing update."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.comm import bcast_impl_scope
+    from ..parallel.mesh import ROW_AXIS
+
+    rec = active_recorder()
+    spec, rep = _specs()
+    pvq = P(None, ROW_AXIS)
+    mtl, ntl = at.shape[0] // p, at.shape[1] // q
+    ks = _he2hb_phase_kernels(p, q, n_true, nb, mtl, ntl)
+
+    fetch = _Phase("he2hb", "bcast", _sm(ks["fetch"], mesh, (spec, rep), rep),
+                   trace_ctx=lambda: bcast_impl_scope(bi))
+    panel = _Phase("he2hb", "panel",
+                   _sm(ks["panel"], mesh, (rep, rep), (rep, rep, rep)))
+    update = _Phase("he2hb", "bulk",
+                    _sm(ks["update"], mesh,
+                        (spec, pvq, rep, rep, rep, rep, rep, rep),
+                        (spec, pvq, rep)),
+                    trace_ctx=lambda: bcast_impl_scope(bi))
+
+    coords = _coords(p, q)
+    if rec is not None:
+        rec.note_run(op="he2hb", nt=int(nsteps), depth=0, impl=bi,
+                     grid=(p, q), phases=PHASES)
+    dtype = at.dtype
+    t = at
+    vqs = jax.device_put(
+        jnp.zeros((max(nsteps, 1), p * mtl * nb, nb), dtype),
+        NamedSharding(mesh, pvq))
+    tqs = jnp.zeros((max(nsteps, 1), nb, nb), dtype)
+    for k in range(nsteps):
+        gpan = fetch(rec, k, coords, t, _ik(k), root_k=k)
+        r_a, v, tl = panel(rec, k, coords, gpan, _ik(k))
+        t, vqs, tqs = update(rec, k, coords, t, vqs, tqs, gpan, r_a, v,
+                             tl, _ik(k))
+    return t, vqs, tqs
+
+
 def step_traceable(op: str, mesh, p: int, q: int, nt: int, mtl: int,
                    ntl: int, nb: int, cplx: bool = False,
                    bi: str = "auto", pi: str = "xla"):
@@ -722,6 +896,49 @@ def step_traceable(op: str, mesh, p: int, q: int, nt: int, mtl: int,
                 acol, brow = fetch(at, bt, k)
                 acc = jnp.zeros((at.shape[0], bt.shape[1], nb, nb), at.dtype)
                 return bulk(acc, acol, brow)
+
+        return fn
+
+    if op == "geqrf":
+        from jax.sharding import PartitionSpec as Pspec
+
+        from ..parallel.mesh import ROW_AXIS as _RA
+
+        ks = _qr_phase_kernels(p, q, nt * nb)
+        prow = Pspec(_RA)
+        panel = _sm(ks["panel"], mesh, (spec, rep), (spec, spec, spec))
+        bcast = _sm(ks["bcast"], mesh, (spec, spec, spec, rep),
+                    (spec, spec, spec))
+        update = _sm(ks["update"], mesh,
+                     (spec, prow, rep, rep, spec, spec, spec, rep),
+                     (spec, prow, rep, rep))
+
+        def fn(at, tls, tvs, tts, k):
+            with bcast_impl_scope(bi):
+                po = panel(at, k)
+                pl = bcast(po[0], po[1], po[2], k)
+                return update(at, tls, tvs, tts, pl[0], pl[1], pl[2], k)
+
+        return fn
+
+    if op == "he2hb":
+        from jax.sharding import PartitionSpec as Pspec
+
+        from ..parallel.mesh import ROW_AXIS as _RA
+
+        ks = _he2hb_phase_kernels(p, q, nt * nb, nb, mtl, ntl)
+        pvq = Pspec(None, _RA)
+        fetch = _sm(ks["fetch"], mesh, (spec, rep), rep)
+        panel = _sm(ks["panel"], mesh, (rep, rep), (rep, rep, rep))
+        update = _sm(ks["update"], mesh,
+                     (spec, pvq, rep, rep, rep, rep, rep, rep),
+                     (spec, pvq, rep))
+
+        def fn(at, vqs, tqs, k):
+            with bcast_impl_scope(bi):
+                gpan = fetch(at, k)
+                r_a, v, tl = panel(gpan, k)
+                return update(at, vqs, tqs, gpan, r_a, v, tl, k)
 
         return fn
 
@@ -842,6 +1059,42 @@ def _build_case(op: str, n: int, nb: int, mesh, rng):
                          / (np.abs(tl).max() * max(np.abs(x).max(), 1e-30) * n))
 
         return run, verify, td.nt
+    if op == "geqrf":
+        from ..parallel.dist_qr import geqrf_dist
+
+        ad = from_dense(jnp.asarray(a), mesh, nb)
+
+        def run(depth, impl):
+            # strict schedule: the panel chain has no lookahead reorder
+            return geqrf_dist(ad, bcast_impl=impl)
+
+        def verify(res):
+            # R^H R == A^H A for any QR of A (no Q needed): the cheap
+            # factor-correctness residual at the flight's tiny shapes
+            r_up = np.triu(np.asarray(to_dense(res.fact)))[:n, :n]
+            ref = a.T @ a
+            return float(np.abs(r_up.T @ r_up - ref).max()
+                         / (np.abs(ref).max() + 1e-30))
+
+        return run, verify, ad.nt
+    if op == "he2hb":
+        from ..linalg.eig import _he2hb_panel_count
+        from ..parallel.dist_twostage import he2hb_dist
+
+        spd = (a @ a.T / n + 2 * np.eye(n)).astype(np.float32)
+        sd = from_dense(jnp.asarray(spd), mesh, nb)
+
+        def run(depth, impl):
+            return he2hb_dist(sd, bcast_impl=impl)
+
+        def verify(res):
+            # the two-sided orthogonal reduction preserves the Frobenius
+            # norm: the reduced band's norm must match A's
+            band = np.asarray(to_dense(res.band))
+            fa = np.linalg.norm(spd)
+            return float(abs(np.linalg.norm(band) - fa) / fa)
+
+        return run, verify, _he2hb_panel_count(n, nb)
     raise ValueError(f"unknown flight op {op!r}; expected one of {FLIGHT_OPS}")
 
 
@@ -878,6 +1131,8 @@ def run_flight(op: str, n: int = 96, nb: int = 8, depth: Optional[int] = None,
         # the factor-loop pipelining (and its step driver) caps at depth
         # 1 — record the depth that actually dispatched, not the request
         d = min(d, 1)
+    if op in _STRICT_OPS:
+        d = 0  # strict panel chains: no lookahead reorder exists
     impl = resolve_bcast_impl(bcast_impl)
 
     # (b) static ScheduleModel: one trace of the FUSED kernel under the
@@ -896,10 +1151,14 @@ def run_flight(op: str, n: int = 96, nb: int = 8, depth: Optional[int] = None,
     rows = schedule.rows_from_events(rec.events)
     sched = schedule.analyze(rows, d)
 
-    # the overlap contrast: the strict depth-0 issue order
-    with flight_scope() as rec0:
-        run(0, impl)
-    sched0 = schedule.analyze(schedule.rows_from_events(rec0.events), 0)
+    # the overlap contrast: the strict depth-0 issue order (for the
+    # strict-schedule ops the measured run IS depth 0 — no second run)
+    if op in _STRICT_OPS:
+        sched0 = sched
+    else:
+        with flight_scope() as rec0:
+            run(0, impl)
+        sched0 = schedule.analyze(schedule.rows_from_events(rec0.events), 0)
 
     if hops and impl != "psum":
         with no_flight():
@@ -1053,16 +1312,19 @@ def write_flight_report(path: str, rep: dict) -> str:
 
 
 def _smoke(out_dir: str) -> int:
-    """CI acceptance: tiny summa + potrf flights under psum and ring —
-    schema-valid FlightReports whose modeled bytes match a fresh
-    comm-audit capture, Perfetto export validates with per-device tracks
-    and hop flow events, and overlap_eff separates depth 1 from depth 0."""
+    """CI acceptance: tiny summa + potrf + geqrf + he2hb flights under
+    psum and ring — schema-valid FlightReports whose modeled bytes match
+    a fresh comm-audit capture, Perfetto export validates with
+    per-device tracks and hop flow events, and overlap_eff separates
+    depth 1 from depth 0 (the pipelined ops; the strict QR/eig panel
+    chains record the depth-0 order and gate on the byte surface)."""
     from . import memory, perfetto
 
     os.makedirs(out_dir, exist_ok=True)
     failures: List[str] = []
     n, nb = 64, 8
-    for op in ("summa", "potrf"):
+    for op in ("summa", "potrf", "geqrf", "he2hb"):
+        strict = op in _STRICT_OPS
         reports = {}
         for impl in ("psum", "ring"):
             # memory sampling forced on (ISSUE 9): every fenced dispatch
@@ -1070,11 +1332,18 @@ def _smoke(out_dir: str) -> int:
             # carries the per-device memory counter track
             with memory.force_sampling():
                 rep = run_flight(op, n=n, nb=nb, depth=1, bcast_impl=impl,
-                                 hops=(impl == "ring"))
+                                 hops=(impl == "ring" and not strict))
             errs = validate_flight_report(rep)
             if errs:
                 failures.append(f"{op}/{impl} schema: {errs[:4]}")
-            if rep["sched"]["overlap_eff"] <= rep["sched"]["overlap_eff_la0"]:
+            if strict:
+                # no lookahead exists: the strict chain must read as
+                # fully exposed communication, never a fake overlap
+                if rep["sched"]["overlap_eff"] != 0.0:
+                    failures.append(
+                        f"{op}/{impl}: strict-schedule overlap_eff "
+                        f"{rep['sched']['overlap_eff']:.3f} nonzero")
+            elif rep["sched"]["overlap_eff"] <= rep["sched"]["overlap_eff_la0"]:
                 failures.append(
                     f"{op}/{impl}: overlap_eff {rep['sched']['overlap_eff']:.3f} "
                     f"does not exceed the depth-0 value "

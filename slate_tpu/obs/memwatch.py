@@ -8,8 +8,10 @@ CLI::
             [--depth 1] [--impl ring] [--out MEM.report.json]
     python -m slate_tpu.obs.memwatch --smoke [--out artifacts/obs]
 
-``<op>`` is one of summa / potrf / getrf_nopiv.  The emitted artifact is
-an ordinary RunReport whose headline ``values`` carry the ``mem.*``
+``<op>`` is one of summa / potrf / getrf_nopiv / trsm / geqrf / he2hb
+(the last three since ISSUE 15: trsm at exact-class calibration, the
+QR/eig chains with their multi-array out terms).  The emitted artifact
+is an ordinary RunReport whose headline ``values`` carry the ``mem.*``
 keys:
 
 - ``mem.arg/out/temp/alias_bytes`` — XLA's compile-time buffer
@@ -40,7 +42,7 @@ import os
 import sys
 from typing import Dict, Optional
 
-MEM_OPS = ("summa", "potrf", "getrf_nopiv")
+MEM_OPS = ("summa", "potrf", "getrf_nopiv", "trsm", "geqrf", "he2hb")
 MODEL_TOL = 0.10  # acceptance: modeled workspace within 10% of measured
 
 
@@ -115,6 +117,49 @@ def _build_case(op: str, n: int, nb: int, mesh, depth: int, impl: str,
 
         return fn, (ad.tiles,), lambda: getrf_nopiv_dist(
             ad, lookahead=depth, bcast_impl=impl)
+    if op == "trsm":
+        from ..parallel.dist_trsm import trsm_dist
+        from ..types import MethodTrsm, Op, Uplo
+
+        tl = (np.tril(a) + n * np.eye(n)).astype(np.float32)
+        ad = from_dense(jnp.asarray(tl), mesh, nb, diag_pad_one=True)
+        bdm = from_dense(jnp.asarray(
+            rng.standard_normal((n, n)).astype(np.float32)), mesh, nb)
+
+        def fn(at, bt):
+            da = DistMatrix(tiles=at, m=n, n=n, nb=nb, mesh=mesh,
+                            diag_pad=True)
+            db = DistMatrix(tiles=bt, m=n, n=n, nb=nb, mesh=mesh)
+            return trsm_dist(da, db, Uplo.Lower, Op.NoTrans,
+                             method=MethodTrsm.TrsmB, lookahead=depth,
+                             bcast_impl=impl).tiles
+
+        return fn, (ad.tiles, bdm.tiles), lambda: trsm_dist(
+            ad, bdm, Uplo.Lower, Op.NoTrans, method=MethodTrsm.TrsmB,
+            lookahead=depth, bcast_impl=impl)
+    if op == "geqrf":
+        from ..parallel.dist_qr import geqrf_dist
+
+        ad = from_dense(jnp.asarray(a), mesh, nb)
+
+        def fn(at):
+            da = DistMatrix(tiles=at, m=n, n=n, nb=nb, mesh=mesh)
+            f = geqrf_dist(da, bcast_impl=impl)
+            return f.fact.tiles, f.tloc, f.treev, f.treet
+
+        return fn, (ad.tiles,), lambda: geqrf_dist(ad, bcast_impl=impl)
+    if op == "he2hb":
+        from ..parallel.dist_twostage import he2hb_dist
+
+        spd = (a @ a.T / n + 2 * np.eye(n)).astype(np.float32)
+        ad = from_dense(jnp.asarray(spd), mesh, nb)
+
+        def fn(at):
+            da = DistMatrix(tiles=at, m=n, n=n, nb=nb, mesh=mesh)
+            f = he2hb_dist(da, bcast_impl=impl)
+            return f.band.tiles, f.vq, f.tq
+
+        return fn, (ad.tiles,), lambda: he2hb_dist(ad, bcast_impl=impl)
     raise ValueError(f"unknown memwatch op {op!r}; expected {MEM_OPS}")
 
 
@@ -220,6 +265,22 @@ def _smoke(out_dir: str) -> int:
     os.makedirs(out_dir, exist_ok=True)
     failures = []
     mesh = _mesh_default()
+    # the ISSUE 15 ops (trsm now exact-class, geqrf/he2hb newly modeled):
+    # the model-vs-measured 10% gate must hold; no committed-artifact
+    # comparison (the summa/potrf references below gate the schema path)
+    for op in ("trsm", "geqrf", "he2hb"):
+        rep = run_memwatch(op, n=96, nb=8, depth=1, bcast_impl="ring",
+                           mesh=mesh, with_donations=False,
+                           with_runtime=False)
+        vals = rep["values"]
+        if vals["mem.model_err_frac"] > MODEL_TOL:
+            failures.append(
+                f"{op}: model workspace off by "
+                f"{vals['mem.model_err_frac']:.1%} (> {MODEL_TOL:.0%})")
+        write_mem_report(os.path.join(out_dir, f"mem_{op}.report.json"), rep)
+        print(f"obs.memwatch smoke: {op} ok — temp "
+              f"{vals['mem.temp_bytes']:,.0f} B/dev, model err "
+              f"{vals['mem.model_err_frac']:.1%}")
     for op in ("summa", "potrf"):
         rep = run_memwatch(op, n=96, nb=8, depth=1, bcast_impl="ring",
                            mesh=mesh)

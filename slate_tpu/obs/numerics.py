@@ -115,6 +115,7 @@ _STATE = {
     "condest_max": 0.0,        # worst estimated condition number
     "chol_margin_min": 0.0,    # smallest Schur-diagonal margin seen
     "qr_orth_loss_max": 0.0,   # worst QR reflector/τ consistency loss
+    "he2hb_orth_loss_max": 0.0,  # worst eig-chain (he2hb) panel loss
 }
 
 
@@ -291,6 +292,26 @@ def record_qr_orth(op: str, loss) -> None:
     _note(op, {"qr_orth_loss": val})
     with _lock:
         _STATE["qr_orth_loss_max"] = max(_STATE["qr_orth_loss_max"], val)
+
+
+def record_he2hb_orth(op: str, loss) -> None:
+    """Record one monitored two-stage eig (he2hb) chain's
+    orthogonality-loss proxy (ISSUE 15): the running max over panels of
+    the reflector/τ consistency residual of the REPLICATED gathered-
+    column panel QR (``dist_qr._qr_orth_loss`` — the identity holds for
+    any compact-WY pair, so the gauge transfers to the band-reduction
+    panels unchanged and is collective-free by replication).  Surfaced
+    as the ``num.he2hb_orth_margin`` gauge and the
+    ``he2hb_orth_loss_max`` num-section total (lower is better)."""
+    c = _concrete(loss)
+    if c is None:
+        return
+    val = c[0]
+    REGISTRY.gauge_set("num.he2hb_orth_margin", val, op=op)
+    _note(op, {"he2hb_orth_loss": val})
+    with _lock:
+        _STATE["he2hb_orth_loss_max"] = max(_STATE["he2hb_orth_loss_max"],
+                                            val)
 
 
 def record_condest(op: str, rcond) -> None:

@@ -8,7 +8,10 @@ CLI::
             [--impl ring] [--out NUM.report.json]
     python -m slate_tpu.obs.numwatch --smoke [--out artifacts/obs]
 
-``<op>`` is one of lu / potrf / mixed.  Each pass runs SEEDED
+``<op>`` is one of lu / potrf / mixed / qr (the last since ISSUE 15:
+the QR/eig-chain orthogonality-loss gauges — the fused-vs-checkpointed
+geqrf gauge equality pinned at an exact 0.0 key, plus the first he2hb
+margin).  Each pass runs SEEDED
 deterministic inputs (utils.testing.generate — including the adversarial
 kinds: Wilkinson growth, prescribed-spectrum ill-conditioned,
 near-singular-diagonal SPD) through the monitored kernels
@@ -56,7 +59,7 @@ import sys
 import time
 from typing import Dict
 
-NUM_OPS = ("lu", "potrf", "mixed")
+NUM_OPS = ("lu", "potrf", "mixed", "qr")
 CONDEST_PARITY_RTOL = 1e-6  # dist vs single-chip probe sequences agree
 MARGIN_RTOL = 1e-3          # seeded 1/cond margin reproduction
 
@@ -224,7 +227,58 @@ def _run_mixed(n, nb, mesh, impl) -> Dict[str, float]:
     return vals
 
 
-_RUNNERS = {"lu": _run_lu, "potrf": _run_potrf, "mixed": _run_mixed}
+def _run_qr(n, nb, mesh, impl) -> Dict[str, float]:
+    """The QR/eig-chain orthogonality-loss gauges (ISSUE 15): the FUSED
+    monitored geqrf loop vs the checkpointed segment chain on the same
+    operand (bitwise-equal by the exact-max-fold contract — the
+    acceptance bound, exported as a 0.0 mismatch key), plus the first
+    he2hb (two-stage eig) gauge."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ft import ckpt
+    from ..obs import numerics
+    from ..parallel.dist import from_dense
+    from ..parallel.dist_qr import geqrf_dist
+    from ..parallel.dist_twostage import he2hb_dist
+    from ..utils.testing import generate
+
+    vals: Dict[str, float] = {}
+    rng = np.random.default_rng(9)
+    a = rng.standard_normal((n, n))
+    ad = from_dense(jnp.asarray(a), mesh, nb, diag_pad_one=False)
+    geqrf_dist(ad, bcast_impl=impl, num_monitor="on")
+    fused = numerics.last_gauges("geqrf")["qr_orth_loss"]
+    vals["num.qr_orth_margin_fused"] = fused
+    numerics.clear_last("geqrf")
+    ckpt.geqrf_ckpt(ad, every=2, bcast_impl=impl, num_monitor="on")
+    chained = numerics.last_gauges("geqrf")["qr_orth_loss"]
+    vals["num.qr_orth_margin_ckpt"] = chained
+    # the acceptance bound: fused == checkpointed, BITWISE (max folds
+    # are exact) — committed as an always-0.0 lower-better key so any
+    # divergence fails the gate outright
+    vals["num.qr_orth_fused_vs_ckpt_err"] = abs(fused - chained)
+
+    # an ill-conditioned operand must not trip the gauge (the identity
+    # measures the PANEL's internal consistency, not cond(A)) — but it
+    # must stay finite and recorded
+    ill = generate("svd", n, seed=10, cond=1e10)
+    geqrf_dist(_dist(ill, mesh, nb, pad=False), bcast_impl=impl,
+               num_monitor="on")
+    vals["num.qr_orth_margin_ill"] = numerics.last_gauges(
+        "geqrf")["qr_orth_loss"]
+
+    # the first eig-chain gauge: he2hb's replicated panel QR margin
+    spd = generate("spd", n, seed=11)
+    he2hb_dist(_dist(spd, mesh, nb, pad=False), bcast_impl=impl,
+               num_monitor="on")
+    vals["num.he2hb_orth_margin"] = numerics.last_gauges(
+        "he2hb")["he2hb_orth_loss"]
+    return vals
+
+
+_RUNNERS = {"lu": _run_lu, "potrf": _run_potrf, "mixed": _run_mixed,
+            "qr": _run_qr}
 
 
 def run_numwatch(op: str, n: int = _N_DEFAULT, nb: int = _NB_DEFAULT,
@@ -340,6 +394,18 @@ def _smoke(out_dir: str) -> int:
             with open(tpath, "w") as f:
                 json.dump(trace, f, indent=1)
 
+        if op == "qr":
+            if vals["num.qr_orth_fused_vs_ckpt_err"] != 0.0:
+                failures.append(
+                    "qr: fused geqrf gauge differs from the checkpointed "
+                    f"chain's by {vals['num.qr_orth_fused_vs_ckpt_err']:.3g}"
+                    " (must be bitwise-equal)")
+            for key in ("num.qr_orth_margin_fused", "num.he2hb_orth_margin"):
+                if not 0.0 < vals[key] < 1e-10:
+                    failures.append(
+                        f"qr: {key} = {vals[key]:.3g} outside the "
+                        "healthy-panel eps class (0, 1e-10)")
+
         # cross-impl bitwise invariance: the gauges measure arithmetic
         # the broadcast lowering must not change (the acceptance bound
         # "gate green under both psum and ring" holds because the values
@@ -361,7 +427,8 @@ def _smoke(out_dir: str) -> int:
         # an unchanged report passes, a 4x-grown gauge fails
         worse = copy.deepcopy(rep)
         for k in list(worse["values"]):
-            if "growth" in k or "condest_cond" in k or "cond" in k:
+            if ("growth" in k or "condest_cond" in k or "cond" in k
+                    or "orth_margin" in k):
                 worse["values"][k] = worse["values"][k] * 4.0
         worse_path = os.path.join(out_dir, f"num_{op}.worse.json")
         with open(worse_path, "w") as f:

@@ -51,9 +51,10 @@ _LOWER_BETTER = ("second", "time", "byte", "error", "err", "resid", "latency",
                  # history_drop convergence ratio stay higher-is-better)
                  "growth", "condest", "alarm", "routed", "ir_iters",
                  "history_len",
-                 # QR-chain orthogonality-loss proxy rising = the
-                 # implicit Q degrading under a fixed workload
-                 "orth_loss",
+                 # QR/eig-chain orthogonality-loss proxy rising = the
+                 # implicit Q degrading under a fixed workload (the
+                 # num.*_orth_margin gauge keys name the same loss)
+                 "orth_loss", "orth_margin",
                  # serving runtime: misses/retraces/rejections rising
                  # under a fixed request stream = cache hygiene or
                  # admission coverage degrading (hits/traces/warmups

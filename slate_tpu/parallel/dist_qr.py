@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..linalg.qr import _larft, _larft_v, _panel_qr, _panel_qr_offset, _v_of
 from ..obs import instrument
+from ..obs.numerics import resolve_num_monitor
 from ..types import Op
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
@@ -49,6 +50,7 @@ from .comm import (
     bcast_impl_scope,
     local_indices,
     num_gauge_dtype,
+    phase_scope,
     resolve_bcast_impl,
     shard_map_compat,
 )
@@ -85,19 +87,45 @@ def _merge_ids(p: int) -> List[List[int]]:
 
 
 @instrument("geqrf_dist")
-def geqrf_dist(a: DistMatrix, bcast_impl=None) -> DistQR:
+def geqrf_dist(a: DistMatrix, bcast_impl=None, num_monitor=None) -> DistQR:
     """Factor A = Q R across the mesh (m >= n).  ``bcast_impl``
     (Option.BcastImpl) picks the panel-broadcast lowering — the rooted
     ppermute engine or the legacy masked psum — bitwise-identical
     (PR 5's engine, threaded here per the ROADMAP "finish the collective
-    story" item)."""
+    story" item).
+
+    ``num_monitor`` (Option.NumMonitor, ISSUE 15): ``on`` carries the
+    per-panel reflector/τ orthogonality-loss proxy (``_qr_orth_loss``)
+    as a running max through the FUSED k-loop — results stay bitwise,
+    the gauge is local per mesh row so the only reduction is the
+    unaudited exit pmax (the ``_lu_info_dist`` class) — recorded as the
+    ``num.qr_orth_margin`` gauge, bitwise-equal to the checkpointed
+    chain's gauge on the same operand.  ``off`` is jaxpr-IDENTICAL."""
+    from ..obs import flight as _flight
+    from ..obs import numerics as _num
+
     p, q = mesh_shape(a.mesh)
     if a.m < a.n:
         raise ValueError(f"geqrf_dist requires m >= n, got {a.m}x{a.n}")
-    fact, tloc, treev, treet = _geqrf_jit(
-        a.tiles, a.mesh, p, q, a.nt, a.m, a.n,
-        resolve_bcast_impl(bcast_impl),
-    )
+    bi = resolve_bcast_impl(bcast_impl)
+    nm = resolve_num_monitor(num_monitor) == "on"
+    if _flight.step_dispatch_active():
+        # flight-recorder step dispatch: same arithmetic, fenced per
+        # phase (the per-phase programs carry no gauges — monitoring is
+        # the fused kernels' surface, the potrf/LU contract)
+        fact, tloc, tvs, tts = _flight.geqrf_steps(
+            a.tiles, a.mesh, p, q, a.nt, a.m, a.n, bi)
+        fd = DistMatrix(
+            tiles=fact, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True
+        )
+        return DistQR(fd, tloc, tvs, tts)
+    if nm:
+        fact, tloc, treev, treet, g = _geqrf_jit(
+            a.tiles, a.mesh, p, q, a.nt, a.m, a.n, bi, True)
+        _num.record_qr_orth("geqrf", jnp.max(g))
+    else:
+        fact, tloc, treev, treet = _geqrf_jit(
+            a.tiles, a.mesh, p, q, a.nt, a.m, a.n, bi, False)
     fd = DistMatrix(
         tiles=fact, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True
     )
@@ -174,20 +202,47 @@ def _qr_orth_loss(v, tl, rdt):
     return (jnp.max(jnp.abs(e)).astype(rdt) / denom)
 
 
-def _qr_panel_step(k, carry, p, q, m_true, nm=False):
-    """One CAQR panel step of the strict schedule on the full local view
-    (carry = (tile stack, T_loc stack, tree-V stack, tree-T stack)).
+def _qr_panel_factor(k, t_loc, p, q, m_true):
+    """Local panel QR of step k (the pre-broadcast half of the panel
+    phase): my stacked valid rows through the offset-pivot panel QR plus
+    the compact-WY T, results masked to the owner column — exactly the
+    bytes the broadcasts have always moved.  Module-level (the
+    dist_chol/_lu phase-helper contract) so the fused loop, the
+    checkpointed segments, and the flight recorder's per-step dispatches
+    share one arithmetic."""
+    mtl, ntl, nb, _ = t_loc.shape
+    r, c, i_log, _j_log = local_indices(p, q, mtl, ntl)
+    mfl = mtl * nb
+    flat_gids = (i_log[:, None] * nb + jnp.arange(nb)[None, :]).reshape(-1)
+    kc = k // q
+    mine_c = c == k % q
+    row0, _has = _local_panel_geometry(k, r, p, mtl, nb)
+    pcol = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)[:, 0]
+    flat = pcol.reshape(mfl, nb)
+    valid = (flat_gids >= k * nb) & (flat_gids < m_true)
+    masked = jnp.where((valid & mine_c)[:, None], flat, 0)
+    r_a, v, tau = _panel_qr_offset(masked, row0)
+    tl = _larft_v(v, tau)
+    return (jnp.where(mine_c, r_a, 0), jnp.where(mine_c, v, 0),
+            jnp.where(mine_c, tl, 0))
 
-    Module-level so the fused ``_geqrf_jit`` loop and the checkpointed
-    segment chain (``ft/ckpt._qr_seg_jit``) run the IDENTICAL per-element
-    arithmetic — chained segments reproduce the fused kernel bitwise at
-    any boundary set (the dist_chol/_lu step-helper contract).
 
-    ``nm=True`` (the monitored segment chain, ``ft/ckpt._qr_seg_nm_jit``)
-    additionally returns this step's ``_qr_orth_loss`` scalar; the
-    default leaves the computation — and hence the fused kernel's and
-    the plain chain's jaxpr — untouched."""
+def _qr_panel_bcast(pan_own, k, q):
+    """Share step k's panel factors across 'q' (three rooted column
+    broadcasts — the comm-audit volume of the CAQR bcast phase) so every
+    column runs the same trailing update."""
+    r_a, v, tl = pan_own
+    return (bcast_from_col(r_a, k % q), bcast_from_col(v, k % q),
+            bcast_from_col(tl, k % q))
+
+
+def _qr_panel_update(k, carry, pan, p, q, m_true):
+    """The remainder of the strict-schedule panel step on the broadcast
+    factors: packed V\\R write, local compact-WY trailing update, tree
+    merge of the per-row R factors (the all_gather'd tree reduction),
+    and the tree update on the gathered R-row slices of C."""
     t_loc, tls, tvs, tts = carry
+    r_a, v, tl = pan
     mtl, ntl, nb, _ = t_loc.shape
     dtype = t_loc.dtype
     nmerge = tvs.shape[1]
@@ -197,18 +252,9 @@ def _qr_panel_step(k, carry, p, q, m_true, nm=False):
     kc = k // q
     mine_c = c == k % q
     row0, has_rows = _local_panel_geometry(k, r, p, mtl, nb)
-
-    # ---- local panel QR on my stacked valid rows ----
     pcol = lax.dynamic_slice_in_dim(t_loc, kc, 1, axis=1)[:, 0]
     flat = pcol.reshape(mfl, nb)
     valid = (flat_gids >= k * nb) & (flat_gids < m_true)
-    masked = jnp.where((valid & mine_c)[:, None], flat, 0)
-    r_a, v, tau = _panel_qr_offset(masked, row0)
-    tl = _larft_v(v, tau)
-    # share the panel factors across 'q' so every column updates
-    r_a = bcast_from_col(jnp.where(mine_c, r_a, 0), k % q)
-    v = bcast_from_col(jnp.where(mine_c, v, 0), k % q)
-    tl = bcast_from_col(jnp.where(mine_c, tl, 0), k % q)
 
     # ---- write packed V\R into the panel column ----
     fr = jnp.arange(mfl)[:, None]
@@ -269,9 +315,36 @@ def _qr_panel_step(k, carry, p, q, m_true, nm=False):
     t_loc = lax.dynamic_update_slice_in_dim(
         t_loc, pflat.reshape(mtl, 1, nb, nb), kc, axis=1
     )
-    out = (t_loc, tls.at[k].set(tl), tvs.at[k].set(tv), tts.at[k].set(tt))
+    return (t_loc, tls.at[k].set(tl), tvs.at[k].set(tv), tts.at[k].set(tt))
+
+
+def _qr_panel_step(k, carry, p, q, m_true, nm=False):
+    """One CAQR panel step of the strict schedule on the full local view
+    (carry = (tile stack, T_loc stack, tree-V stack, tree-T stack)) —
+    the composition of the module-level phase helpers above, with
+    ``phase_scope`` tags (pure trace-time bookkeeping, no jaxpr change)
+    so one ``sched_audit`` trace of the fused kernel yields the
+    per-phase communication schedule the flight recorder's
+    ``ScheduleModel`` consumes.
+
+    Module-level so the fused ``_geqrf_jit`` loop and the checkpointed
+    segment chain (``ft/ckpt._qr_seg_jit``) run the IDENTICAL per-element
+    arithmetic — chained segments reproduce the fused kernel bitwise at
+    any boundary set (the dist_chol/_lu step-helper contract).
+
+    ``nm=True`` (the monitored fused loop and segment chain,
+    ``ft/ckpt._qr_seg_nm_jit``) additionally returns this step's
+    ``_qr_orth_loss`` scalar; the default leaves the computation — and
+    hence the fused kernel's and the plain chain's jaxpr — untouched."""
+    with phase_scope("panel", k):
+        pan_own = _qr_panel_factor(k, carry[0], p, q, m_true)
+    with phase_scope("bcast", k):
+        pan = _qr_panel_bcast(pan_own, k, q)
+    with phase_scope("bulk", k):
+        out = _qr_panel_update(k, carry, pan, p, q, m_true)
     if nm:
-        return out, _qr_orth_loss(v, tl, num_gauge_dtype(dtype))
+        return out, _qr_orth_loss(pan[1], pan[2],
+                                  num_gauge_dtype(carry[0].dtype))
     return out
 
 
@@ -289,8 +362,8 @@ def _qr_pad_identity(t_loc, p, q, n_true, dtype):
     return jnp.where(dmask, jnp.ones((), dtype), t_loc)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7))
-def _geqrf_jit(at, mesh, p, q, nt, m_true, n_true, bi):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8))
+def _geqrf_jit(at, mesh, p, q, nt, m_true, n_true, bi, nm):
     spec = P(ROW_AXIS, COL_AXIS)
     nmerge = max(1, p)
 
@@ -298,25 +371,53 @@ def _geqrf_jit(at, mesh, p, q, nt, m_true, n_true, bi):
         mtl, ntl, nb, _ = t_loc.shape
         dtype = t_loc.dtype
 
-        def panel_step(k, carry):
-            return _qr_panel_step(k, carry, p, q, m_true)
-
         tls0 = jnp.zeros((nt, nb, nb), dtype)
         tvs0 = jnp.zeros((nt, nmerge, 2 * nb, nb), dtype)
         tts0 = jnp.zeros((nt, nmerge, nb, nb), dtype)
+        if not nm:
+            def panel_step(k, carry):
+                return _qr_panel_step(k, carry, p, q, m_true)
+
+            with audit_scope(nt):
+                t_loc, tls, tvs, tts = lax.fori_loop(
+                    0, nt, panel_step, (t_loc, tls0, tvs0, tts0)
+                )
+            t_loc = _qr_pad_identity(t_loc, p, q, n_true, at.dtype)
+            return t_loc, tls, tvs[None, None], tts[None, None]
+
+        # monitored loop (ISSUE 15): the per-panel orthogonality-loss
+        # proxy rides the carry as a running max — same step arithmetic,
+        # one unaudited exit pmax (the _lu_info_dist class), so the
+        # audited wire bytes are unchanged and the gauge is bitwise-
+        # equal to the checkpointed chain's (max folds are exact)
+        rdt = num_gauge_dtype(dtype)
+
+        def panel_step_nm(k, carry):
+            *st4, gg = carry
+            out4, loss = _qr_panel_step(k, tuple(st4), p, q, m_true,
+                                        nm=True)
+            return out4 + (jnp.maximum(gg, loss),)
+
         with audit_scope(nt):
-            t_loc, tls, tvs, tts = lax.fori_loop(
-                0, nt, panel_step, (t_loc, tls0, tvs0, tts0)
+            t_loc, tls, tvs, tts, gg = lax.fori_loop(
+                0, nt, panel_step_nm,
+                (t_loc, tls0, tvs0, tts0, jnp.zeros((), rdt))
             )
         t_loc = _qr_pad_identity(t_loc, p, q, n_true, at.dtype)
-        return t_loc, tls, tvs[None, None], tts[None, None]
+        gg = lax.pmax(lax.pmax(gg, ROW_AXIS), COL_AXIS)
+        return (t_loc, tls, tvs[None, None], tts[None, None],
+                gg[None, None])
 
+    out_specs = (spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS),
+                 P(ROW_AXIS, COL_AXIS))
+    if nm:
+        out_specs = out_specs + (P(ROW_AXIS, COL_AXIS),)
     with bcast_impl_scope(bi):
         return shard_map_compat(
             kernel,
             mesh=mesh,
             in_specs=(spec,),
-            out_specs=(spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS), P(ROW_AXIS, COL_AXIS)),
+            out_specs=out_specs,
             check_vma=False,
         )(at)
 

@@ -36,11 +36,14 @@ from jax.sharding import PartitionSpec as P
 
 from ..linalg.eig import _he2hb_panel_count
 from ..obs import instrument
+from ..obs.numerics import resolve_num_monitor
 from ..linalg.qr import _larft_v, _panel_qr_offset
 from .comm import (PRECISE, all_gather_a, audit_scope, bcast_from_col,
-                   bcast_from_row, bcast_impl_scope, local_indices, psum_a,
-                   resolve_bcast_impl, shard_map_compat)
+                   bcast_from_row, bcast_impl_scope, local_indices,
+                   num_gauge_dtype, phase_scope, psum_a, resolve_bcast_impl,
+                   shard_map_compat)
 from .dist import DistMatrix
+from .dist_qr import _qr_orth_loss
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
 
 
@@ -73,28 +76,81 @@ class DistTwoStage(NamedTuple):
 
 
 @instrument("he2hb_dist")
-def he2hb_dist(a: DistMatrix) -> DistTwoStage:
+def he2hb_dist(a: DistMatrix, bcast_impl=None,
+               num_monitor=None) -> DistTwoStage:
     """Reduce the full Hermitian DistMatrix (both triangles stored) to a
-    Hermitian band of bandwidth nb; Q panels sharded over mesh rows."""
+    Hermitian band of bandwidth nb; Q panels sharded over mesh rows.
+
+    ``bcast_impl`` (Option.BcastImpl) picks the panel-broadcast lowering
+    (ISSUE 15: the he2hb panel column now rides the rooted engine like
+    geqrf's — bitwise-identical across lowerings).  ``num_monitor``
+    (Option.NumMonitor): ``on`` carries the per-panel reflector/τ
+    orthogonality-loss proxy — the first eig-chain gauge — as a running
+    max through the k-loop; the panel QR is REPLICATED, so the gauge is
+    collective-free and lands as ``num.he2hb_orth_margin``.  ``off`` is
+    jaxpr-IDENTICAL."""
+    from ..obs import flight as _flight
+    from ..obs import numerics as _num
+
     p, q = mesh_shape(a.mesh)
     if a.m != a.n:
         raise ValueError("he2hb_dist needs a square matrix")
     nsteps = _he2hb_panel_count(a.n, a.nb)
-    bt, vs, ts = _he2hb_jit(a.tiles, a.mesh, p, q, a.n, a.nb, nsteps)
+    bi = resolve_bcast_impl(bcast_impl)
+    nm = resolve_num_monitor(num_monitor) == "on"
+    if _flight.step_dispatch_active() and nsteps:
+        # flight-recorder step dispatch: same arithmetic, fenced per
+        # phase (no gauges — monitoring is the fused kernel's surface)
+        bt, vs, ts = _flight.he2hb_steps(
+            a.tiles, a.mesh, p, q, a.n, a.nb, nsteps, bi)
+    elif nm:
+        bt, vs, ts, g = _he2hb_jit(a.tiles, a.mesh, p, q, a.n, a.nb,
+                                   nsteps, bi, True)
+        _num.record_he2hb_orth("he2hb", g)
+    else:
+        bt, vs, ts = _he2hb_jit(a.tiles, a.mesh, p, q, a.n, a.nb, nsteps,
+                                bi, False)
     band = DistMatrix(tiles=bt, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh)
     return DistTwoStage(band, vs, ts, vs[:0], ts[:0])
 
 
-def _he2hb_step(k, carry, p, q, n_true, nb):
-    """One he2hb panel + two-sided trailing update of the strict schedule
-    on the full local FLAT view (carry = (a_flat, vq stack, tq stack)).
+def _he2hb_fetch(k, a, p, q, nb):
+    """Step k's full panel column in global row order, replicated: one
+    rooted column broadcast + one row all_gather (the he2hb bcast
+    phase's comm-audit volume).  Module-level (the dist_chol/_lu
+    phase-helper contract) so the fused loop, the checkpointed segments,
+    and the flight recorder's per-step dispatches share one
+    arithmetic."""
+    mfl, nfl = a.shape
+    mtl, ntl = mfl // nb, nfl // nb
+    _r, c, _il, _jl = local_indices(p, q, mtl, ntl)
+    kc = k // q
+    mine_c = c == k % q
+    pcol = lax.dynamic_slice(a, (0, kc * nb), (mfl, nb))
+    pcol = bcast_from_col(jnp.where(mine_c, pcol, 0), k % q)
+    return _to_global_rows(pcol, p, nb, ROW_AXIS)
 
-    Module-level so the fused ``_he2hb_jit`` loop and the checkpointed
-    segment chain (``ft/ckpt._he2hb_seg_jit``) run the IDENTICAL
-    per-element arithmetic — chained segments reproduce the fused kernel
-    bitwise at any boundary set (the dist_chol/_lu step-helper
-    contract)."""
+
+def _he2hb_panel(k, gpan, n_true, nb):
+    """Step k's REPLICATED offset panel QR + compact-WY T of the gathered
+    column — every device computes the same (R, V, T), so anything
+    derived from them (e.g. the orthogonality-loss gauge) is
+    collective-free."""
+    mglob = gpan.shape[0]
+    grows = jnp.arange(mglob)
+    c0 = k * nb + nb
+    masked = jnp.where(((grows >= c0) & (grows < n_true))[:, None], gpan, 0)
+    r_a, v, tau = _panel_qr_offset(masked, c0)
+    return r_a, v, _larft_v(v, tau)
+
+
+def _he2hb_update(k, carry, gpan, pan, p, q, n_true, nb):
+    """The remainder of the strict-schedule he2hb step: write R + its
+    mirror into the band column/row, then the distributed two-sided
+    trailing update A -= W~ V^H + V W~^H (one psum over 'q' + one row
+    all_gather)."""
     a, vqs, tqs = carry
+    r_a, v, t = pan
     mfl, nfl = a.shape
     mtl, ntl = mfl // nb, nfl // nb
     dtype = a.dtype
@@ -107,14 +163,6 @@ def _he2hb_step(k, carry, p, q, n_true, nb):
     c0 = j0 + nb
     kc, kr = k // q, k // p
     mine_c, mine_r = c == k % q, r == k % p
-
-    # full panel column, global row order, replicated
-    pcol = lax.dynamic_slice(a, (0, kc * nb), (mfl, nb))
-    pcol = bcast_from_col(jnp.where(mine_c, pcol, 0), k % q)
-    gpan = _to_global_rows(pcol, p, nb, ROW_AXIS)
-    masked = jnp.where(((grows >= c0) & (grows < n_true))[:, None], gpan, 0)
-    r_a, v, tau = _panel_qr_offset(masked, c0)
-    t = _larft_v(v, tau)
 
     # write [history above c0 | R; 0] into the panel column + mirror
     newpan = jnp.where((grows >= c0)[:, None], r_a, gpan)
@@ -162,8 +210,38 @@ def _he2hb_step(k, carry, p, q, n_true, nb):
     return a, vqs.at[k].set(v[rg]), tqs.at[k].set(t)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
-def _he2hb_jit(at, mesh, p, q, n_true, nb, nsteps):
+def _he2hb_step(k, carry, p, q, n_true, nb, nm=False):
+    """One he2hb panel + two-sided trailing update of the strict schedule
+    on the full local FLAT view (carry = (a_flat, vq stack, tq stack)) —
+    the composition of the module-level phase helpers above, with
+    ``phase_scope`` tags (trace-time bookkeeping only, no jaxpr change)
+    so one ``sched_audit`` trace of the fused kernel yields the
+    per-phase schedule the flight recorder's ``ScheduleModel`` consumes.
+
+    Module-level so the fused ``_he2hb_jit`` loop and the checkpointed
+    segment chain (``ft/ckpt._he2hb_seg_jit``) run the IDENTICAL
+    per-element arithmetic — chained segments reproduce the fused kernel
+    bitwise at any boundary set (the dist_chol/_lu step-helper
+    contract).
+
+    ``nm=True`` additionally returns this step's reflector/τ
+    orthogonality-loss scalar (``dist_qr._qr_orth_loss`` on the
+    REPLICATED panel factors — collective-free); the default leaves the
+    computation, and hence the unmonitored jaxpr, untouched."""
+    with phase_scope("bcast", k):
+        gpan = _he2hb_fetch(k, carry[0], p, q, nb)
+    with phase_scope("panel", k):
+        pan = _he2hb_panel(k, gpan, n_true, nb)
+    with phase_scope("bulk", k):
+        out = _he2hb_update(k, carry, gpan, pan, p, q, n_true, nb)
+    if nm:
+        return out, _qr_orth_loss(pan[1], pan[2],
+                                  num_gauge_dtype(carry[0].dtype))
+    return out
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8))
+def _he2hb_jit(at, mesh, p, q, n_true, nb, nsteps, bi="psum", nm=False):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
@@ -172,26 +250,55 @@ def _he2hb_jit(at, mesh, p, q, n_true, nb, nsteps):
         mfl, nfl = mtl * nb, ntl * nb
         a = jnp.transpose(t_loc, (0, 2, 1, 3)).reshape(mfl, nfl)
 
-        def step(k, carry):
-            return _he2hb_step(k, carry, p, q, n_true, nb)
-
         vqs0 = jnp.zeros((max(nsteps, 1), mfl, nb), dtype)
         tqs0 = jnp.zeros((max(nsteps, 1), nb, nb), dtype)
+        if not nm:
+            def step(k, carry):
+                return _he2hb_step(k, carry, p, q, n_true, nb)
+
+            if nsteps:
+                with audit_scope(nsteps):
+                    a2, vqs, tqs = lax.fori_loop(0, nsteps, step,
+                                                 (a, vqs0, tqs0))
+            else:
+                a2, vqs, tqs = a, vqs0, tqs0
+            t_out = jnp.transpose(a2.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
+            return t_out, vqs, tqs
+
+        # monitored loop (ISSUE 15): the per-panel orthogonality-loss
+        # proxy rides the carry as a running max.  The panel factors are
+        # REPLICATED (every device ran the same gathered-column QR), so
+        # the gauge needs no reduction at all — collective-free, audited
+        # wire bytes unchanged.
+        rdt = num_gauge_dtype(dtype)
+
+        def step_nm(k, carry):
+            *st3, gg = carry
+            out3, loss = _he2hb_step(k, tuple(st3), p, q, n_true, nb,
+                                     nm=True)
+            return out3 + (jnp.maximum(gg, loss),)
+
+        g0 = jnp.zeros((), rdt)
         if nsteps:
             with audit_scope(nsteps):
-                a, vqs, tqs = lax.fori_loop(0, nsteps, step, (a, vqs0, tqs0))
+                a2, vqs, tqs, gg = lax.fori_loop(
+                    0, nsteps, step_nm, (a, vqs0, tqs0, g0))
         else:
-            vqs, tqs = vqs0, tqs0
-        t_out = jnp.transpose(a.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
-        return t_out, vqs, tqs
+            a2, vqs, tqs, gg = a, vqs0, tqs0, g0
+        t_out = jnp.transpose(a2.reshape(mtl, nb, ntl, nb), (0, 2, 1, 3))
+        return t_out, vqs, tqs, gg
 
-    return shard_map_compat(
-        kernel,
-        mesh=mesh,
-        in_specs=(spec,),
-        out_specs=(spec, P(None, ROW_AXIS), P()),
-        check_vma=False,
-    )(at)
+    out_specs = (spec, P(None, ROW_AXIS), P())
+    if nm:
+        out_specs = out_specs + (P(),)
+    with bcast_impl_scope(bi):
+        return shard_map_compat(
+            kernel,
+            mesh=mesh,
+            in_specs=(spec,),
+            out_specs=out_specs,
+            check_vma=False,
+        )(at)
 
 
 @instrument("unmtr_he2hb_dist")

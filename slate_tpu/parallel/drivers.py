@@ -250,17 +250,19 @@ def geqrf_mesh(
     ``opts`` carries Option.BcastImpl (panel-broadcast lowering),
     Option.Checkpoint (ISSUE 13: the multi-array carry — tile stack +
     T_loc stack + tree V/T stacks — snapshots every K panel steps; off
-    keeps the fused kernel untouched, trace-identical) and, on the
-    checkpointed chain, Option.NumMonitor (ISSUE 14 satellite: the
-    in-carry reflector/τ orthogonality-loss gauge -> num.qr_orth_margin;
-    off keeps the plain segment jits)."""
+    keeps the fused kernel untouched, trace-identical) and
+    Option.NumMonitor (the in-carry reflector/τ orthogonality-loss
+    gauge -> num.qr_orth_margin, through the FUSED loop and the
+    checkpointed chain alike since ISSUE 15 — bitwise-equal gauges;
+    off keeps the plain kernels/segment jits)."""
     every = _ckpt_every(opts)
     if every is not None:
         from ..ft.ckpt import geqrf_ckpt
 
         return geqrf_ckpt(from_dense(a, mesh, nb), every=every,
                           bcast_impl=_bi(opts), num_monitor=_nm(opts))
-    return geqrf_dist(from_dense(a, mesh, nb), bcast_impl=_bi(opts))
+    return geqrf_dist(from_dense(a, mesh, nb), bcast_impl=_bi(opts),
+                      num_monitor=_nm(opts))
 
 
 @instrument("gels_mesh")
@@ -329,9 +331,10 @@ def heev_mesh(
         from ..ft.ckpt import he2hb_ckpt
 
         f = he2hb_ckpt(from_dense(a, mesh, nb), every=every,
-                       bcast_impl=_bi(opts))
+                       bcast_impl=_bi(opts), num_monitor=_nm(opts))
     else:
-        f = he2hb_dist(from_dense(a, mesh, nb))
+        f = he2hb_dist(from_dense(a, mesh, nb), bcast_impl=_bi(opts),
+                       num_monitor=_nm(opts))
     bandd = gather_diagband(f.band, nb)  # (n, 4nb) replicated, O(n nb)
     # the distributed two-sided update is Hermitian in exact arithmetic;
     # shave the O(eps * nsteps) rounding asymmetry before the band chase

@@ -109,8 +109,13 @@ class Router:
 
         got = self._max_n.get(op)
         if got is None:
+            # QR/eig requests carry their own models (ISSUE 15): the
+            # multi-array aux outputs (T_loc/tree stacks, reflector/WY
+            # stacks) made the old getrf_nopiv fallback over-admit them
             model_op = {"posv": "potrf", "potrf": "potrf",
-                        "gemm": "summa", "summa": "summa"}.get(
+                        "gemm": "summa", "summa": "summa",
+                        "geqrf": "geqrf", "gels": "geqrf",
+                        "heev": "he2hb", "he2hb": "he2hb"}.get(
                             op, "getrf_nopiv")
             grid = ((1, 1) if self.mesh is None
                     else tuple(self.mesh.devices.shape))

@@ -33,24 +33,38 @@ from .metrics import _sanitize_key, serve_counter_values
 _PREFIX = "slate_tpu_serve"
 
 
+# metric-name prefixes one scrape surfaces (ISSUE 15): the serving
+# counters/latencies plus the schedule (sched.*), accuracy-health
+# (num.*), and refinement-trajectory (ir.*) families — latency,
+# schedule, and health together in one exposition
+_SCRAPE_PREFIXES = ("serve.", "sched.", "num.", "ir.")
+
+
 def stats_snapshot() -> dict:
     """JSON-able snapshot of the live serving surface: the serve.*
     counter section (with the SLA reduction merged in), the exact
-    outcome-attribution totals, and every ``serve.*``-named metric
-    series in the shared registry."""
+    outcome-attribution totals, the num.* accuracy-health totals, and
+    every ``serve.``/``sched.``/``num.``/``ir.``-named metric series in
+    the shared registry."""
     from ..obs import REGISTRY
+    from ..obs import numerics as _numerics
     from . import trace as _trace
 
     snap = REGISTRY.snapshot()
-    serve_metrics = {
-        kind: [e for e in entries if e["name"].startswith("serve.")]
+    scrape_metrics = {
+        kind: [e for e in entries
+               if str(e.get("name", "")).startswith(_SCRAPE_PREFIXES)]
         for kind, entries in snap.items()
     }
+    # the num section (the RunReport twin): all-zero (nothing monitored
+    # this process) stays out, exactly like the report surface
+    num = _numerics.num_counter_values()
     return {
         "serve": serve_counter_values(),
         "sla": _trace.sla_values(),
+        "num": (num if any(num.values()) else {}),
         "finished_requests": len(_trace.finished_traces()),
-        "metrics": serve_metrics,
+        "metrics": scrape_metrics,
     }
 
 
@@ -85,6 +99,19 @@ def prometheus_text(snapshot: Optional[dict] = None) -> str:
         name = f"{_PREFIX}_{_sanitize_key(key)}"
         emit(name, "gauge" if "latency" in key or "rate" in key
              else "counter", [f"{name} {val:.10g}"])
+    # flat num.* accuracy-health totals (ISSUE 15): worst-case gauges are
+    # gauges, event totals counters — the RunReport num section's scrape
+    for key, val in sorted((snap.get("num") or {}).items()):
+        name = f"slate_tpu_num_{_sanitize_key(key)}"
+        kind = ("gauge" if any(t in key for t in ("_max", "_min", "margin",
+                                                  "cond", "_s"))
+                else "counter")
+        emit(name, kind, [f"{name} {val:.10g}"])
+    # flat sched.* keys (a formatted FlightReport's values — the offline
+    # schedule surface; live registries carry sched series below instead)
+    for key, val in sorted((snap.get("sched") or {}).items()):
+        name = f"slate_tpu_{_sanitize_key(key)}"
+        emit(name, "gauge", [f"{name} {val:.10g}"])
     # registry series (tagged counters/gauges/histograms)
     m = snap.get("metrics") or {}
     for e in m.get("counters", []):
@@ -116,17 +143,28 @@ def prometheus_text(snapshot: Optional[dict] = None) -> str:
 
 
 def snapshot_from_report(rep: dict) -> dict:
-    """Rebuild the stats surface from a committed RunReport (the offline
-    twin of the live snapshot)."""
+    """Rebuild the stats surface from a committed RunReport or
+    FlightReport (the offline twin of the live snapshot): the serve
+    section plus the num section and any ``num.*``/``sched.*`` headline
+    values (numwatch / flight artifacts format through the same
+    exposition — ISSUE 15)."""
     metrics = rep.get("metrics") or {}
+    values = rep.get("values") or {}
+    num = dict(rep.get("num") or {})
+    num.update({k[len("num."):]: v for k, v in values.items()
+                if isinstance(v, (int, float)) and k.startswith("num.")})
+    sched = {k: v for k, v in values.items()
+             if isinstance(v, (int, float)) and k.startswith("sched.")}
     return {
         "serve": dict(rep.get("serve") or {}),
         "sla": {k: v for k, v in (rep.get("serve") or {}).items()
                 if k.startswith(("latency_", "outcome_"))},
+        "num": num,
+        "sched": sched,
         "finished_requests": None,
         "metrics": {
             kind: [e for e in metrics.get(kind, [])
-                   if str(e.get("name", "")).startswith("serve.")]
+                   if str(e.get("name", "")).startswith(_SCRAPE_PREFIXES)]
             for kind in ("counters", "gauges", "histograms")
         },
     }

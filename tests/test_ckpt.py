@@ -266,6 +266,15 @@ def test_qr_ckpt_orth_gauge_bitwise_and_recorded():
     assert 0.0 < vals["qr_orth_loss_max"] < 1e-10  # ~eps64, healthy panel
     assert num.last_gauges("geqrf")["qr_orth_loss"] \
         == vals["qr_orth_loss_max"]
+    # ISSUE 15 acceptance: the FUSED (non-checkpointed) monitored loop
+    # reports the SAME gauge bitwise on the same operand (max folds are
+    # exact, so segment boundaries cannot move the running max) — and
+    # its results stay bitwise too
+    chained_gauge = vals["qr_orth_loss_max"]
+    num.reset()
+    _assert_tree_bitwise(ref, geqrf_dist(d, num_monitor="on"),
+                         "monitored fused geqrf vs plain")
+    assert num.last_gauges("geqrf")["qr_orth_loss"] == chained_gauge
     # off mode: the plain (unchanged) segment chain — already compiled by
     # test_qr_kill_resume_bitwise — records nothing (the kill->resume
     # gauge flow itself rides the same snapshot gauges dict the potrf/LU

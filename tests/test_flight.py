@@ -53,6 +53,9 @@ def ops(mesh):
 
 def _kernel_jaxprs(ops):
     """Jaxprs of every opted-in fused kernel, traced fresh."""
+    from slate_tpu.parallel.dist_qr import geqrf_dist
+    from slate_tpu.parallel.dist_twostage import he2hb_dist
+
     jax.clear_caches()
     out = {}
     out["summa"] = str(jax.make_jaxpr(
@@ -66,6 +69,12 @@ def _kernel_jaxprs(ops):
         lambda x, y: trsm_dist(x, y, Uplo.Lower, Op.NoTrans,
                                method=MethodTrsm.TrsmB).tiles
     )(ops["tril"], ops["b"]))
+    # the ISSUE 15 ops: the flight routing branch + the phase_scope
+    # markers inside the shared step helpers must not change a jaxpr
+    out["geqrf"] = str(jax.make_jaxpr(
+        lambda x: geqrf_dist(x).fact.tiles)(ops["a"]))
+    out["he2hb"] = str(jax.make_jaxpr(
+        lambda x: he2hb_dist(x).band.tiles)(ops["spd"]))
     return out
 
 
@@ -396,6 +405,137 @@ def test_report_check_ignore_glob(potrf_report, tmp_path):
     flight.write_flight_report(new, slow)
     assert report.main(["--check", new, old, "--threshold", "4",
                         "--ignore", "sched.*_s"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# QR / eig chains (ISSUE 15): flight coverage for geqrf + he2hb
+# ---------------------------------------------------------------------------
+
+
+def test_geqrf_flight_bitwise_and_bytes(ops):
+    """The per-step CAQR dispatch (panel -> three rooted column
+    broadcasts -> trailing/tree update) is bitwise-identical to the
+    fused kernel across the WHOLE multi-array result, and the recorded
+    bcast-phase bytes equal the closed-form broadcast volume: per step
+    three column broadcasts of (mfl, nb) + (mfl, nb) + (nb, nb), at
+    (q-1)x the payload under the ring engine."""
+    from slate_tpu.parallel.dist_qr import geqrf_dist
+
+    ref = geqrf_dist(ops["a"], bcast_impl="ring")
+    with flight.flight_scope() as rec:
+        fl = geqrf_dist(ops["a"], bcast_impl="ring")
+    for name in ("tloc", "treev", "treet"):
+        np.testing.assert_array_equal(np.asarray(getattr(ref, name)),
+                                      np.asarray(getattr(fl, name)),
+                                      err_msg=name)
+    np.testing.assert_array_equal(np.asarray(to_dense(ref.fact)),
+                                  np.asarray(to_dense(fl.fact)))
+    rows = schedule.rows_from_events(rec.events)
+    nt, mtl = ops["a"].nt, ops["a"].mt // P_
+    mfl = mtl * NB_
+    got = sum(r["bytes"] for r in rows if r["phase"] == "bcast")
+    expect = nt * (Q_ - 1) * (2 * mfl * NB_ + NB_ * NB_) * 4
+    assert got == expect
+    # strict schedule: every phase present per step, overlap reads 0
+    by_phase = {}
+    for r in rows:
+        by_phase.setdefault(r["phase"], set()).add(r["k"])
+    assert by_phase["panel"] >= set(range(nt))
+    assert by_phase["bcast"] == set(range(nt))
+    assert by_phase["bulk"] == set(range(nt))
+    assert schedule.analyze(rows, 0)["overlap_eff"] == 0.0
+
+
+@pytest.mark.parametrize("impl", ["psum", "ring"])
+def test_schedule_model_qr_he2hb_bytes_analytic(mesh, impl):
+    """The acceptance bound (ISSUE 15): the geqrf/he2hb ScheduleModel
+    per-step wire bytes equal the comm-audit analytic volumes under
+    psum AND ring.  Pure make_jaxpr traces at a shape no other test
+    compiles — no clear_caches, no execution."""
+    from slate_tpu.parallel.dist_qr import geqrf_dist
+    from slate_tpu.parallel.dist_twostage import he2hb_dist
+
+    n, nb = 80, 8  # pads to nt = 12 — unique in this suite
+    rng = np.random.default_rng(11)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    ad = from_dense(jnp.asarray(a), mesh, nb)
+    nt, mtl, ntl = ad.nt, ad.mt // P_, ad.nt // Q_
+    mfl, isz = mtl * nb, 4
+    eng = (Q_ - 1) if impl != "psum" else 1
+
+    with comm_audit() as plain, sched_audit() as tagged:
+        jax.make_jaxpr(
+            lambda t: geqrf_dist(
+                from_dense_like(t, ad), bcast_impl=impl).fact.tiles
+        )(ad.tiles)
+    model = schedule.ScheduleModel("geqrf", nt, P_, Q_, impl, list(tagged))
+    audit_total = sum(b * m for _, b, m in plain)
+    assert model.total_bytes == audit_total
+    # closed forms: bcast = 3 rooted column broadcasts (r_a, V, T);
+    # bulk = the tree all_gathers (R block + the gathered C row slices)
+    bcast = nt * eng * (2 * mfl * nb + nb * nb) * isz
+    bulk = nt * (nb * nb + nb * ntl * nb) * isz
+    assert model.phase_bytes["bcast"] == bcast
+    assert model.phase_bytes["bulk"] == bulk
+
+    spd = (a @ a.T / n + 2 * np.eye(n)).astype(np.float32)
+    sd = from_dense(jnp.asarray(spd), mesh, nb)
+    nsteps = 9  # _he2hb_panel_count(80, 8)
+    with comm_audit() as hplain, sched_audit() as htagged:
+        jax.make_jaxpr(
+            lambda t: he2hb_dist(
+                from_dense_like(t, sd), bcast_impl=impl).band.tiles
+        )(sd.tiles)
+    hmodel = schedule.ScheduleModel("he2hb", nsteps, P_, Q_, impl,
+                                    list(htagged))
+    haudit = sum(b * m for _, b, m in hplain)
+    assert hmodel.total_bytes == haudit
+    # bcast = the rooted panel-column broadcast + the row gather into
+    # global order; bulk = the Y psum over 'q' + the Y row gather
+    pan = mfl * nb * isz
+    assert hmodel.phase_bytes["bcast"] == nsteps * (eng * pan + pan)
+    assert hmodel.phase_bytes["bulk"] == nsteps * 2 * pan
+
+
+def from_dense_like(tiles, like):
+    from slate_tpu.parallel.dist import DistMatrix
+
+    return DistMatrix(tiles=tiles, m=like.m, n=like.n, nb=like.nb,
+                      mesh=like.mesh, diag_pad=like.diag_pad)
+
+
+@pytest.mark.slow
+def test_qr_he2hb_flight_reports_full():
+    """The full QR/he2hb flight sweep (ISSUE 15, -m slow): run_flight
+    under psum and ring — schema-valid FlightReports, model bytes ==
+    measured bytes, residuals clean, and the he2hb per-step dispatch
+    bitwise vs the fused kernel."""
+    from slate_tpu.parallel.dist_twostage import he2hb_dist
+
+    mesh = make_mesh(P_, Q_, devices=jax.devices("cpu")[:8])
+    for op in ("geqrf", "he2hb"):
+        for impl in ("psum", "ring"):
+            rep = flight.run_flight(op, n=N_, nb=NB_, depth=1,
+                                    bcast_impl=impl, mesh=mesh)
+            assert flight.validate_flight_report(rep) == []
+            assert rep["config"]["lookahead"] == 0  # strict schedule
+            assert rep["sched"]["overlap_eff"] == 0.0
+            assert rep["values"]["resid"] < 1e-3
+            assert (rep["sched"]["measured_bytes"]
+                    == rep["model"]["total_bytes"])
+    rng = np.random.default_rng(3)
+    g = rng.standard_normal((N_, N_)).astype(np.float32)
+    spd = (g @ g.T / N_ + 2 * np.eye(N_)).astype(np.float32)
+    sd = from_dense(jnp.asarray(spd), mesh, NB_)
+    ref = he2hb_dist(sd, bcast_impl="ring")
+    with flight.flight_scope():
+        fl = he2hb_dist(sd, bcast_impl="ring")
+    for name in ("vq", "tq"):
+        np.testing.assert_array_equal(np.asarray(getattr(ref, name)),
+                                      np.asarray(getattr(fl, name)),
+                                      err_msg=name)
+    np.testing.assert_array_equal(np.asarray(to_dense(ref.band)),
+                                  np.asarray(to_dense(fl.band)))
 
 
 @pytest.mark.parametrize("trans_op", [Op.Trans, Op.ConjTrans])

@@ -58,6 +58,62 @@ def test_model_peak_is_arg_out_workspace():
     assert m.peak_bytes == m.arg_bytes + m.out_bytes + m.workspace_bytes
 
 
+@pytest.mark.parametrize("op", ["trsm", "geqrf", "he2hb"])
+def test_issue15_op_models_match_measured(op):
+    """ISSUE 15: trsm promoted to exact-class, geqrf/he2hb newly modeled
+    (the QR/eig chains were the ROADMAP's last unmodeled drivers) — one
+    engine-lowering point tier-1; the full two-point psum/ring sweep
+    runs at -m slow.  Arg bytes are exact tile arithmetic; the
+    multi-array out bytes (T_loc/tree and reflector/WY stacks) land
+    within the measured assignment slack."""
+    mesh = mesh24()
+    fn, args, _run = _case(op, 96, 8, 1, "ring", mesh)
+    meas = memory.aot_memory_analysis(fn, *args)
+    assert meas is not None and meas["temp_bytes"] > 0
+    model = memmodel.MemoryModel(op, 96, 8, (2, 4), "float32",
+                                 lookahead=1, bcast_impl="ring")
+    err = abs(model.workspace_bytes - meas["temp_bytes"]) / meas["temp_bytes"]
+    assert err <= memwatch.MODEL_TOL, (
+        f"{op}: model {model.workspace_bytes:,.0f} vs measured "
+        f"{meas['temp_bytes']:,.0f} ({err:.1%})")
+    assert meas["arg_bytes"] == model.arg_bytes
+    assert abs(meas["out_bytes"] - model.out_bytes) <= 64
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("impl", ["psum", "ring"])
+@pytest.mark.parametrize("n,nb,depth", [(96, 8, 1), (192, 16, 0)])
+@pytest.mark.parametrize("op", ["trsm", "geqrf", "he2hb"])
+def test_issue15_op_models_full_sweep(op, n, nb, depth, impl):
+    mesh = mesh24()
+    fn, args, _run = _case(op, n, nb, depth, impl, mesh)
+    meas = memory.aot_memory_analysis(fn, *args)
+    model = memmodel.MemoryModel(op, n, nb, (2, 4), "float32",
+                                 lookahead=depth, bcast_impl=impl)
+    err = abs(model.workspace_bytes - meas["temp_bytes"]) / meas["temp_bytes"]
+    assert err <= memwatch.MODEL_TOL, (
+        f"{op} n={n} nb={nb} d={depth} {impl}: {err:.1%}")
+
+
+def test_predict_max_n_answers_for_qr_eig():
+    """ISSUE 15: the feasibility answer exists for the QR/eig family —
+    and the he2hb reflector stacks make its admissible n strictly
+    smaller than the tile-stack-only LU model at the same budget (the
+    over-admission the Router mapping fixes)."""
+    budget = 16 * 2**30
+    for op in ("geqrf", "he2hb"):
+        nmax = memmodel.predict_max_n(budget, op, nb=256, grid=(2, 4))
+        assert nmax > 0
+        m = memmodel.MemoryModel(op, nmax, 256, (2, 4))
+        assert m.peak_bytes <= budget
+        step = 256 * 4
+        over = memmodel.MemoryModel(op, nmax + step, 256, (2, 4))
+        assert over.peak_bytes > budget
+    assert (memmodel.predict_max_n(budget, "he2hb", nb=256, grid=(2, 4))
+            < memmodel.predict_max_n(budget, "getrf_nopiv", nb=256,
+                                     grid=(2, 4)))
+
+
 # ---------------------------------------------------------------------------
 # lookahead residency: depth adds exactly d panel-payload buffers
 # ---------------------------------------------------------------------------

@@ -111,6 +111,70 @@ def test_off_is_jaxpr_identical_per_kernel():
         assert str(j_on) != str(j_off), f"{name}: on traced no gauges"
 
 
+def test_qr_he2hb_off_jaxpr_identical_and_zero_extra_bytes():
+    """ISSUE 15: the FUSED geqrf loop and the he2hb (eig-chain) loop
+    under NumMonitor — off is jaxpr-IDENTICAL to the default trace, on
+    adds the in-carry gauge but ZERO extra audited wire bytes (pure
+    make_jaxpr traces: no compiles, no cache clears)."""
+    from slate_tpu.parallel.dist_qr import geqrf_dist
+    from slate_tpu.parallel.dist_twostage import he2hb_dist
+
+    mesh = mesh24()
+    gen = _dist(generate("randn", N, seed=12), mesh, pad=False)
+    spd = _dist(generate("spd", N, seed=13), mesh, pad=False)
+    cases = [
+        ("geqrf", gen, lambda d, nm: geqrf_dist(d, num_monitor=nm)),
+        ("he2hb", spd, lambda d, nm: he2hb_dist(d, num_monitor=nm)),
+    ]
+    for name, d, fn in cases:
+        with comm_audit() as off_recs:
+            j_off = jax.make_jaxpr(
+                lambda t, d=d, fn=fn: fn(_rewrap(t, d), "off"))(d.tiles)
+        j_def = jax.make_jaxpr(
+            lambda t, d=d, fn=fn: fn(_rewrap(t, d), None))(d.tiles)
+        assert str(j_off) == str(j_def), f"{name}: off != default jaxpr"
+        with comm_audit() as on_recs:
+            j_on = jax.make_jaxpr(
+                lambda t, d=d, fn=fn: fn(_rewrap(t, d), "on"))(d.tiles)
+        assert str(j_on) != str(j_off), f"{name}: on traced no gauges"
+        off_total = sum(b * m for _, b, m in off_recs)
+        on_total = sum(b * m for _, b, m in on_recs)
+        assert off_total == on_total > 0, (
+            f"{name}: monitored loop moved {on_total - off_total} extra "
+            "audited bytes")
+
+
+def _rewrap(tiles, like):
+    from slate_tpu.parallel.dist import DistMatrix
+
+    return DistMatrix(tiles=tiles, m=like.m, n=like.n, nb=like.nb,
+                      mesh=like.mesh, diag_pad=like.diag_pad)
+
+
+def test_he2hb_monitored_bitwise_and_gauge_recorded():
+    """The first eig-chain gauge (ISSUE 15): monitoring the fused he2hb
+    loop changes no result bit, and the replicated panel-QR loss proxy
+    lands as num.he2hb_orth_margin / he2hb_orth_loss_max (eps-class for
+    a healthy operand)."""
+    from slate_tpu.parallel.dist_twostage import he2hb_dist
+
+    mesh = mesh24()
+    spd = _dist(generate("spd", N, seed=13), mesh, pad=False)
+    off = he2hb_dist(spd, num_monitor="off")
+    numerics.reset()
+    on = he2hb_dist(spd, num_monitor="on")
+    for a, b in ((off.band.tiles, on.band.tiles), (off.vq, on.vq),
+                 (off.tq, on.tq)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    vals = numerics.num_counter_values()
+    assert 0.0 < vals["he2hb_orth_loss_max"] < 1e-10
+    assert numerics.last_gauges("he2hb")["he2hb_orth_loss"] \
+        == vals["he2hb_orth_loss_max"]
+    assert any(g["name"] == "num.he2hb_orth_margin"
+               for g in REGISTRY.snapshot().get("gauges", []))
+    numerics.reset()
+
+
 def test_mixed_refine_off_is_jaxpr_identical(rng):
     """The fused refinement program: NumMonitor=off == no option (the
     history buffer only ever enters the carry under on)."""

@@ -364,6 +364,53 @@ def test_prefactor_memo_stationary_operator(rng):
 # ---------------------------------------------------------------------------
 
 
+def test_router_admission_models_qr_eig():
+    """ISSUE 15: QR/eig requests admit on their OWN memory models (the
+    multi-array aux carries), not the getrf_nopiv fallback that
+    over-admitted them — pure model arithmetic, no dispatch."""
+    from slate_tpu.obs import memmodel
+    from slate_tpu.serve.router import Router
+
+    router = Router(hbm_budget=16 * 2**30)
+    grid = (1, 1)
+    for op, model_op in (("geqrf", "geqrf"), ("gels", "geqrf"),
+                         ("heev", "he2hb"), ("he2hb", "he2hb")):
+        expect = memmodel.predict_max_n(
+            16 * 2**30, op=model_op, nb=max(router.nb, 8), grid=grid,
+            dtype="float64")
+        assert router.max_n(op) == expect, op
+    # the over-admission contrast: the eig chain admits strictly less
+    # than the LU fallback would have granted it
+    assert router.max_n("heev") < router.max_n("gesv")
+    with pytest.raises(SlateError, match="admission"):
+        router.admit("heev", router.max_n("heev") + 8 * 4 * 256)
+
+
+def test_stats_export_grows_num_and_sched_families():
+    """ISSUE 15 satellite: one scrape surfaces latency + schedule +
+    health together — the Prometheus text grows num.*/sched.* families
+    from both the live registry and committed artifacts."""
+    from slate_tpu.obs import numerics
+    from slate_tpu.serve import stats
+
+    numerics.reset()
+    numerics.record_qr_orth("geqrf", 3e-15)
+    text = stats.prometheus_text()
+    assert "slate_tpu_num_qr_orth_loss_max" in text
+    assert "# TYPE slate_tpu_num_qr_orth_loss_max gauge" in text
+    assert "slate_tpu_num_qr_orth_margin" in text  # the registry series
+    numerics.reset()
+    # offline: a numwatch RunReport and a FlightReport format through
+    # the same exposition
+    rep = {"values": {"num.qr_orth_margin_fused": 1e-15,
+                      "sched.model_bytes": 61440.0},
+           "num": {"monitored": 2.0}}
+    off = stats.prometheus_text(stats.snapshot_from_report(rep))
+    assert "slate_tpu_num_qr_orth_margin_fused" in off
+    assert "slate_tpu_sched_model_bytes" in off
+    assert "slate_tpu_num_monitored" in off
+
+
 def test_serve_report_section():
     from slate_tpu.obs import report
     from slate_tpu.serve.metrics import serve_count
