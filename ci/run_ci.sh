@@ -13,19 +13,43 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 # driver (see slate_tpu/analysis/).  A lint failure is a CI failure.
 python -m slate_tpu.analysis.lint
 
-# self-check: the gate must actually trip on a seeded violation, otherwise
-# a silent lint regression would wave everything through.  Exit code must
-# be EXACTLY 1 (findings) — 2 means the seeded path itself crashed.
-set +e
-python -m slate_tpu.analysis.lint --skip-trace --seed-violation donation \
-    > /dev/null 2>&1
-seed_rc=$?
-set -e
-if [ "$seed_rc" -ne 1 ]; then
-  echo "slate_lint self-check FAILED: seeded violation run exited" \
-       "$seed_rc (want 1)" >&2
-  exit 1
-fi
+# contract-matrix autoprover (ISSUE 16): every registry entry's declared
+# option contracts (off_jaxpr_identical / zero_extra_collectives /
+# bytes_invariant) proved by abstract trace + comm audit, plus the
+# registry-completeness and naming-convention checks.  The ring re-run
+# proves the matrix holds under the non-default broadcast lowering too
+# (the hop schedules move the same bytes, so every cell must re-prove).
+python -m slate_tpu.analysis.contracts
+SLATE_TPU_BCAST_IMPL=ring python -m slate_tpu.analysis.contracts
+
+# self-checks: each gate must actually trip on its seeded violation,
+# otherwise a silent analysis regression would wave everything through.
+# Exit code must be EXACTLY 1 (findings) — 2 means the seeded path
+# itself crashed.  The three ISSUE 16 SPMD passes (branch-divergent
+# collectives, broken ppermute pair, read-after-donate) and the two
+# contract seeds (undeclared / broken declaration) gate beside the
+# original donation seed.
+check_seed() {  # check_seed <module> <args...>
+  set +e
+  python -m "$@" > /dev/null 2>&1
+  seed_rc=$?
+  set -e
+  if [ "$seed_rc" -ne 1 ]; then
+    echo "static-analysis self-check FAILED: '$*' exited $seed_rc" \
+         "(want 1)" >&2
+    exit 1
+  fi
+}
+check_seed slate_tpu.analysis.lint --skip-trace --seed-violation donation
+check_seed slate_tpu.analysis.lint --only seeded \
+    --seed-violation branch-divergence
+check_seed slate_tpu.analysis.lint --only seeded --seed-violation ppermute-pair
+check_seed slate_tpu.analysis.lint --only seeded \
+    --seed-violation read-after-donate
+check_seed slate_tpu.analysis.contracts --only seeded \
+    --seed-violation undeclared-contract
+check_seed slate_tpu.analysis.contracts --only seeded \
+    --seed-violation broken-contract
 
 # obs smoke: a tiny instrumented potrf_dist on the 8-device mesh must
 # emit a schema-valid RunReport (wall/compile time, flop estimate, comm

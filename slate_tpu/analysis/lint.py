@@ -1,10 +1,14 @@
 """slate_lint CLI: ``python -m slate_tpu.analysis.lint``.
 
 Runs, in order: the AST pass over the package sources, the pure-Python
-block-cyclic map invariants, the donation-aliasability contracts, and the
-jaxpr pass over every registered distributed driver (traced abstractly on
-a forced 8-device CPU mesh — no TPU, nothing executes beyond operand
-construction).  Findings not covered by the waiver file fail the run.
+block-cyclic map invariants, the broadcast-engine hop-schedule proof, the
+donation-aliasability contracts, and the jaxpr passes over every
+registered distributed driver (traced abstractly on a forced 8-device
+CPU mesh — no TPU, nothing executes beyond operand construction).  The
+jaxpr passes cover the collective/axis/precision/audit invariants plus
+the SPMD safety passes (spmd.py): branch-uniform collective ordering,
+ppermute bijections, donation liveness.  Findings not covered by the
+waiver file fail the run; on FULL runs, stale waivers fail it too.
 
 Exit codes: 0 clean (or fully waived), 1 findings, 2 internal error.
 
@@ -13,10 +17,11 @@ Options:
   --only PATTERN      restrict traced drivers to names containing PATTERN
   --skip-trace        AST + grid + donation checks only (fast, no tracing)
   --list              list registered drivers and exit
-  --seed-violation K  inject a known-bad driver or source (axis |
-                      precision | donation | loop-audit | masked-psum) —
-                      proves the gate trips; used by tests/test_lint.py
-                      and CI self-checks
+  --seed-violation K  inject a known-bad driver, source, or schedule
+                      (axis | precision | donation | loop-audit |
+                      masked-psum | branch-divergence | ppermute-pair |
+                      read-after-donate) — proves the gate trips; used
+                      by tests/test_lint.py and CI self-checks
 """
 
 from __future__ import annotations
@@ -105,6 +110,85 @@ def _seed_violation(kind: str) -> None:
             # output (300, 300) can never alias the donated (320, 320)
             return (lambda x: x[:300, :300]), (ap,), (0,)
 
+    elif kind == "branch-divergence":
+
+        @register("seeded_divergent_branches")
+        def _bad_branch(ctx):
+            # the two branches issue DIFFERENT collective sequences; a
+            # device disagreeing on the (traced) predicate would park in
+            # a psum the other side never reaches
+            devs = jax.devices("cpu")[:4]
+            mesh = Mesh(np.asarray(devs).reshape(2, 2), ("p", "q"))
+            x = jnp.zeros((4, 4))
+
+            def fn(x):
+                def kernel(t):
+                    def one(v):
+                        return psum_a(v, "p")
+
+                    def two(v):
+                        return v + psum_a(psum_a(v, "p"), "p")
+
+                    return jax.lax.cond(t.sum() > 0, one, two, t)
+
+                return shard_map_compat(
+                    kernel,
+                    mesh=mesh,
+                    in_specs=(P("p", "q"),),
+                    out_specs=P("p", "q"),
+                    check_vma=False,
+                )(x)
+
+            return fn, (x,)
+
+    elif kind == "ppermute-pair":
+        # two halves of the same bug class: a traced ppermute whose perm
+        # targets one destination twice (XLA keeps one payload, drops the
+        # other), and a broken engine-style hop schedule that never
+        # reaches device 3 — the static schedule proof must catch it
+        from .spmd import SEEDED_SCHEDULES
+
+        SEEDED_SCHEDULES.append((
+            "seeded/broken_ring[size=4,root=0]",
+            4, 0,
+            [[(0, 1)], [(1, 2)], [(2, 2)]],
+        ))
+
+        @register("seeded_dropped_pair")
+        def _bad_perm(ctx):
+            devs = jax.devices("cpu")[:4]
+            mesh = Mesh(np.asarray(devs).reshape(2, 2), ("p", "q"))
+            x = jnp.zeros((4, 4))
+
+            def fn(x):
+                def kernel(t):
+                    return jax.lax.ppermute(t, "q", [(0, 1), (1, 1)])
+
+                return shard_map_compat(
+                    kernel,
+                    mesh=mesh,
+                    in_specs=(P("p", "q"),),
+                    out_specs=P("p", "q"),
+                    check_vma=False,
+                )(x)
+
+            return fn, (x,)
+
+    elif kind == "read-after-donate":
+
+        @register("seeded_read_after_donate")
+        def _bad_read(ctx):
+            # the caller donates x into g, then reads x again — XLA may
+            # already have reused the buffer for g's output
+            g = jax.jit(lambda t: t * 2.0, donate_argnums=(0,))
+            x = jnp.zeros((8, 8))
+
+            def fn(x):
+                y = g(x)
+                return y + x
+
+            return fn, (x,)
+
     elif kind == "masked-psum":
         # an AST-pass seed: a synthetic source using the masked-psum
         # broadcast idiom outside comm.py must trip ast-masked-psum-bcast
@@ -135,26 +219,36 @@ def run(argv: List[str] = None) -> int:
     ap.add_argument(
         "--seed-violation",
         default=None,
-        choices=["axis", "precision", "donation", "loop-audit", "masked-psum"],
+        choices=[
+            "axis", "precision", "donation", "loop-audit", "masked-psum",
+            "branch-divergence", "ppermute-pair", "read-after-donate",
+        ],
     )
     args = ap.parse_args(argv)
 
-    if args.skip_trace and args.seed_violation in ("axis", "precision", "loop-audit"):
+    if args.skip_trace and args.seed_violation in (
+        "axis", "precision", "loop-audit", "branch-divergence",
+        "read-after-donate",
+    ):
         # those seeds register trace-pass drivers that --skip-trace never
         # runs: the combination would exit 0 while validating nothing
         ap.error(
             f"--seed-violation {args.seed_violation} requires tracing; "
-            "only 'donation' and 'masked-psum' work with --skip-trace"
+            "only 'donation', 'masked-psum' and 'ppermute-pair' work "
+            "with --skip-trace"
         )
 
     from .ast_checks import SEEDED_SOURCES, check_tree
     from .findings import Finding
     from .grid_checks import run_grid_checks
+    from .spmd import SEEDED_SCHEDULES, check_hop_schedules
     from .waivers import load_waivers
 
     # stale seeds from a previous in-process run() must not leak into
-    # this one (the masked-psum seed appends to a module global)
+    # this one (the masked-psum / ppermute-pair seeds append to module
+    # globals)
     SEEDED_SOURCES.clear()
+    SEEDED_SCHEDULES.clear()
     if args.seed_violation:
         _seed_violation(args.seed_violation)
 
@@ -170,6 +264,9 @@ def run(argv: List[str] = None) -> int:
     findings: List[Finding] = []
     findings += check_tree()
     findings += run_grid_checks()
+    # the broadcast engine's hop schedules proved as data: every
+    # ring/doubling schedule on the registry grid's axis sizes, all roots
+    findings += check_hop_schedules()
 
     import jax
 
@@ -186,6 +283,11 @@ def run(argv: List[str] = None) -> int:
         check_loop_audit,
     )
     from .registry import make_ctx
+    from .spmd import (
+        check_branch_collectives,
+        check_donation_liveness,
+        check_ppermute_bijection,
+    )
 
     ctx = make_ctx()
 
@@ -202,6 +304,7 @@ def run(argv: List[str] = None) -> int:
     n_traced = 0
     if not args.skip_trace:
         allowed = (ROW_AXIS, COL_AXIS)
+        axis_sizes = {ROW_AXIS: ctx.p, COL_AXIS: ctx.q}
         for name, spec in sorted(REGISTRY.items()):
             if args.only and args.only not in name:
                 continue
@@ -221,12 +324,40 @@ def run(argv: List[str] = None) -> int:
             findings += check_dot_precision(closed, where)
             findings += check_comm_upcast(closed, where)
             findings += check_loop_audit(closed, list(records), where)
+            findings += check_branch_collectives(closed, where)
+            findings += check_ppermute_bijection(closed, axis_sizes, where)
+            findings += check_donation_liveness(closed, where)
 
+    from .waivers import (
+        DEFAULT_WAIVER_FILE,
+        LINT_RULES,
+        check_hygiene,
+        check_stale,
+    )
+
+    wpath = args.waivers or DEFAULT_WAIVER_FILE
     waivers = load_waivers(args.waivers)
+    # hygiene first: a typo'd waiver must fail even if nothing matches it
+    findings += check_hygiene(waivers, set(REGISTRY), set(DONATIONS), wpath)
     hard, waived = [], []
     for f in findings:
         w = waivers.match(f)
         (waived if w else hard).append((f, w))
+
+    # staleness is only decidable on a FULL run: --only / --skip-trace /
+    # --seed-violation legitimately leave trace-scoped waivers unused.
+    # Only lint-scoped rules count — contract-rule waivers belong to the
+    # analysis.contracts CLI's full runs.
+    full_run = not (args.only or args.skip_trace or args.seed_violation)
+    if full_run:
+        hard += [(f, None) for f in check_stale(waivers, LINT_RULES, wpath)]
+    else:
+        for w in waivers.unused():
+            print(
+                f"  note: unused waiver at {wpath}:{w.line} "
+                f"({w.rule} | {w.pattern}) — partial run, not checked "
+                "for staleness"
+            )
 
     print(
         f"slate_lint: {n_traced} drivers traced, {len(findings)} finding(s), "
@@ -236,11 +367,6 @@ def run(argv: List[str] = None) -> int:
         print(f"  WAIVED {f.render()}  [{w.reason}]")
     for f, _ in hard:
         print(f"  FAIL   {f.render()}")
-    from .waivers import DEFAULT_WAIVER_FILE
-
-    wpath = args.waivers or DEFAULT_WAIVER_FILE
-    for w in waivers.unused():
-        print(f"  note: unused waiver at {wpath}:{w.line} ({w.rule} | {w.pattern})")
     if hard:
         print(f"slate_lint: FAILED with {len(hard)} unwaived finding(s)")
         return 1

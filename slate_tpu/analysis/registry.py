@@ -9,16 +9,53 @@ tile grid, already a multiple of lcm(2, 4).
 
 Registering a driver is the act of putting it under the invariant gate —
 new distributed kernels should add themselves here.
+
+Entries additionally DECLARE their option contracts (``contracts=``):
+each ``Contract(option, klass, base)`` names an ``Option`` the variant
+consumes and the machine-checkable class its docs/tests claim —
+``off_jaxpr_identical`` (the entry's jaxpr equals its base's, or its own
+re-trace under the option's off-forcing context), ``zero_extra_collectives``
+(audited comm-record multiset equal to the base's), ``bytes_invariant``
+(audited comm volume equal to the base's).  ``python -m
+slate_tpu.analysis.contracts`` proves every declared cell and fails any
+``*_num`` / ``*_ckpt*`` / ``*_abft*`` / ``*_flight`` naming-convention
+variant whose contract is undeclared — a new driver cannot ship with a
+claimed-but-unproven contract.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Sequence, Tuple
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+from ..types import Option
 
 N = 96
 NB = 8
 GRID = (2, 4)
+
+
+@dataclass(frozen=True)
+class Contract:
+    """One auto-proven contract cell: this entry, crossed with one
+    Option it consumes, claims ``klass`` against ``base`` (another
+    registry entry; None compares the entry against its own re-trace
+    under the option's off-forcing context — see contracts._off_context).
+    ``"obs"`` as the option marks the observability layer (not an Option
+    enum member: obs is ambient, forced on via obs.force_enabled)."""
+
+    option: object
+    klass: str
+    base: Optional[str] = None
+
+    def option_name(self) -> str:
+        return self.option.name if isinstance(self.option, Option) else \
+            str(self.option)
+
+
+CONTRACT_CLASSES = (
+    "off_jaxpr_identical", "zero_extra_collectives", "bytes_invariant",
+)
 
 
 @dataclass
@@ -26,6 +63,7 @@ class DriverSpec:
     name: str
     build: Callable  # ctx -> (fn, args)
     tags: Tuple[str, ...] = ()
+    contracts: Tuple[Contract, ...] = ()
 
 
 @dataclass
@@ -38,9 +76,18 @@ REGISTRY: Dict[str, DriverSpec] = {}
 DONATIONS: Dict[str, DonationSpec] = {}
 
 
-def register(name: str, tags: Sequence[str] = ()):
+def register(name: str, tags: Sequence[str] = (),
+             contracts: Sequence[Contract] = ()):
+    for c in contracts:
+        if c.klass not in CONTRACT_CLASSES:
+            raise ValueError(
+                f"{name}: unknown contract class {c.klass!r}; expected "
+                f"one of {CONTRACT_CLASSES}"
+            )
+
     def deco(build):
-        REGISTRY[name] = DriverSpec(name, build, tuple(tags))
+        REGISTRY[name] = DriverSpec(name, build, tuple(tags),
+                                    tuple(contracts))
         return build
 
     return deco
@@ -154,7 +201,10 @@ def _gemm_f32(ctx):
     return (lambda x, y: gemm_summa(1.0, x, y, method=MethodGemm.GemmC)), (a, b)
 
 
-@register("potrf_dist")
+@register("potrf_dist", contracts=(
+    Contract(Option.NumMonitor, "off_jaxpr_identical"),
+    Contract(Option.PanelImpl, "off_jaxpr_identical"),
+))
 def _potrf(ctx):
     from ..parallel.dist_chol import potrf_dist
 
@@ -170,7 +220,10 @@ def _pbtrf(ctx):
     return (lambda x: pbtrf_band_dist(x, 2 * NB)), (a,)
 
 
-@register("getrf_nopiv_dist")
+@register("getrf_nopiv_dist", contracts=(
+    Contract(Option.NumMonitor, "off_jaxpr_identical"),
+    Contract(Option.PanelImpl, "off_jaxpr_identical"),
+))
 def _getrf_nopiv(ctx):
     from ..parallel.dist_lu import getrf_nopiv_dist
 
@@ -178,7 +231,9 @@ def _getrf_nopiv(ctx):
     return getrf_nopiv_dist, (a,)
 
 
-@register("getrf_pp_dist")
+@register("getrf_pp_dist", contracts=(
+    Contract(Option.NumMonitor, "off_jaxpr_identical"),
+))
 def _getrf_pp(ctx):
     from ..parallel.dist_lu import getrf_pp_dist
 
@@ -186,7 +241,9 @@ def _getrf_pp(ctx):
     return getrf_pp_dist, (a,)
 
 
-@register("getrf_tntpiv_dist")
+@register("getrf_tntpiv_dist", contracts=(
+    Contract(Option.NumMonitor, "off_jaxpr_identical"),
+))
 def _getrf_tnt(ctx):
     from ..parallel.dist_lu import getrf_tntpiv_dist
 
@@ -306,7 +363,9 @@ def _norm(ctx):
     return (lambda x: norm_dist(Norm.One, x)), (a,)
 
 
-@register("geqrf_dist")
+@register("geqrf_dist", contracts=(
+    Contract(Option.NumMonitor, "off_jaxpr_identical"),
+))
 def _geqrf(ctx):
     from ..parallel.dist_qr import geqrf_dist
 
@@ -324,7 +383,9 @@ def _unmqr(ctx):
     return unmqr_dist, (f, b)
 
 
-@register("he2hb_dist")
+@register("he2hb_dist", contracts=(
+    Contract(Option.NumMonitor, "off_jaxpr_identical"),
+))
 def _he2hb(ctx):
     from ..parallel.dist_twostage import he2hb_dist
 
@@ -394,7 +455,9 @@ def _don_finale(ctx):
 # ---------------------------------------------------------------------------
 
 
-@register("gemm_summa_la0", tags=("lookahead",))
+@register("gemm_summa_la0", tags=("lookahead",), contracts=(
+    Contract(Option.Lookahead, "bytes_invariant", "gemm_summa_c"),
+))
 def _gemm_la0(ctx):
     from ..parallel.summa import gemm_summa
     from ..types import MethodGemm
@@ -405,7 +468,9 @@ def _gemm_la0(ctx):
     ), (a, b)
 
 
-@register("gemm_summa_la2", tags=("lookahead",))
+@register("gemm_summa_la2", tags=("lookahead",), contracts=(
+    Contract(Option.Lookahead, "bytes_invariant", "gemm_summa_c"),
+))
 def _gemm_la2(ctx):
     from ..parallel.summa import gemm_summa
     from ..types import MethodGemm
@@ -416,7 +481,9 @@ def _gemm_la2(ctx):
     ), (a, b)
 
 
-@register("potrf_dist_la0", tags=("lookahead",))
+@register("potrf_dist_la0", tags=("lookahead",), contracts=(
+    Contract(Option.Lookahead, "bytes_invariant", "potrf_dist"),
+))
 def _potrf_la0(ctx):
     from ..parallel.dist_chol import potrf_dist
 
@@ -424,7 +491,9 @@ def _potrf_la0(ctx):
     return (lambda x: potrf_dist(x, lookahead=0)), (a,)
 
 
-@register("trsm_dist_la2", tags=("lookahead",))
+@register("trsm_dist_la2", tags=("lookahead",), contracts=(
+    Contract(Option.Lookahead, "bytes_invariant", "trsm_dist_lower"),
+))
 def _trsm_la2(ctx):
     from ..parallel.dist_trsm import trsm_dist
     from ..types import Op, Uplo
@@ -436,7 +505,9 @@ def _trsm_la2(ctx):
     ), (a, b)
 
 
-@register("getrf_nopiv_dist_la0", tags=("lookahead",))
+@register("getrf_nopiv_dist_la0", tags=("lookahead",), contracts=(
+    Contract(Option.Lookahead, "bytes_invariant", "getrf_nopiv_dist"),
+))
 def _getrf_nopiv_la0(ctx):
     from ..parallel.dist_lu import getrf_nopiv_dist
 
@@ -444,7 +515,9 @@ def _getrf_nopiv_la0(ctx):
     return (lambda x: getrf_nopiv_dist(x, lookahead=0)), (a,)
 
 
-@register("getrf_pp_dist_la0", tags=("lookahead",))
+@register("getrf_pp_dist_la0", tags=("lookahead",), contracts=(
+    Contract(Option.Lookahead, "bytes_invariant", "getrf_pp_dist"),
+))
 def _getrf_pp_la0(ctx):
     from ..parallel.dist_lu import getrf_pp_dist
 
@@ -484,7 +557,9 @@ def _gemm_psum(ctx):
     ), (a, b)
 
 
-@register("gemm_summa_ring", tags=("bcast",))
+@register("gemm_summa_ring", tags=("bcast",), contracts=(
+    Contract(Option.BcastImpl, "bytes_invariant", "gemm_summa_c"),
+))
 def _gemm_ring(ctx):
     from ..parallel.summa import gemm_summa
     from ..types import MethodGemm
@@ -503,7 +578,9 @@ def _potrf_psum(ctx):
     return _with_impl("psum", potrf_dist), (a,)
 
 
-@register("potrf_dist_ring", tags=("bcast",))
+@register("potrf_dist_ring", tags=("bcast",), contracts=(
+    Contract(Option.BcastImpl, "bytes_invariant", "potrf_dist"),
+))
 def _potrf_ring(ctx):
     from ..parallel.dist_chol import potrf_dist
 
@@ -511,7 +588,9 @@ def _potrf_ring(ctx):
     return _with_impl("ring", potrf_dist), (a,)
 
 
-@register("getrf_nopiv_dist_ring", tags=("bcast",))
+@register("getrf_nopiv_dist_ring", tags=("bcast",), contracts=(
+    Contract(Option.BcastImpl, "bytes_invariant", "getrf_nopiv_dist"),
+))
 def _getrf_nopiv_ring(ctx):
     from ..parallel.dist_lu import getrf_nopiv_dist
 
@@ -519,7 +598,9 @@ def _getrf_nopiv_ring(ctx):
     return _with_impl("ring", getrf_nopiv_dist), (a,)
 
 
-@register("geqrf_dist_ring", tags=("bcast",))
+@register("geqrf_dist_ring", tags=("bcast",), contracts=(
+    Contract(Option.BcastImpl, "bytes_invariant", "geqrf_dist"),
+))
 def _geqrf_ring(ctx):
     """CAQR under the explicit ring lowering (ISSUE 6 satellite: the
     formerly-unthreaded collectives now consume the engine)."""
@@ -529,7 +610,9 @@ def _geqrf_ring(ctx):
     return (lambda x: geqrf_dist(x, bcast_impl="ring")), (a,)
 
 
-@register("stedc_dist_ring", tags=("bcast",))
+@register("stedc_dist_ring", tags=("bcast",), contracts=(
+    Contract(Option.BcastImpl, "bytes_invariant", "stedc_dist"),
+))
 def _stedc_ring(ctx):
     import numpy as np
     import jax.numpy as jnp
@@ -541,7 +624,9 @@ def _stedc_ring(ctx):
     return (lambda dd, ee: stedc_dist(dd, ee, ctx.mesh, bcast_impl="ring")), (d, e)
 
 
-@register("herk_dist_ring", tags=("bcast",))
+@register("herk_dist_ring", tags=("bcast",), contracts=(
+    Contract(Option.BcastImpl, "bytes_invariant", "herk_dist"),
+))
 def _herk_ring(ctx):
     from ..parallel.dist_aux import herk_dist
 
@@ -596,7 +681,9 @@ def _chase_apply_psum(ctx):
         v, t, zz, n, w, ctx.mesh, bcast_impl="psum")), (vs, taus, z)
 
 
-@register("chase_apply_dist_ring", tags=("bcast",))
+@register("chase_apply_dist_ring", tags=("bcast",), contracts=(
+    Contract(Option.BcastImpl, "bytes_invariant", "chase_apply_dist"),
+))
 def _chase_apply_ring(ctx):
     from ..parallel.dist_twostage import chase_apply_dist
 
@@ -610,7 +697,9 @@ def _chase_apply_ring(ctx):
 # ---------------------------------------------------------------------------
 
 
-@register("potrf_dist_obs", tags=("obs",))
+@register("potrf_dist_obs", tags=("obs",), contracts=(
+    Contract("obs", "zero_extra_collectives", "potrf_dist"),
+))
 def _potrf_obs(ctx):
     """potrf_dist traced with observability enabled: proves the obs layer
     (driver spans, TraceAnnotation bridge, comm-audit absorption with
@@ -629,7 +718,9 @@ def _potrf_obs(ctx):
     return fn, (a,)
 
 
-@register("gemm_summa_obs", tags=("obs",))
+@register("gemm_summa_obs", tags=("obs",), contracts=(
+    Contract("obs", "zero_extra_collectives", "gemm_summa_c"),
+))
 def _gemm_obs(ctx):
     from .. import obs
     from ..parallel.summa import gemm_summa
@@ -730,7 +821,10 @@ def _ft_gemm_detect(ctx):
     return _ft_gemm_build(ctx, armed=False)
 
 
-@register("gemm_abft_correct", tags=("ft",))
+@register("gemm_abft_correct", tags=("ft",), contracts=(
+    Contract(Option.FaultTolerance, "zero_extra_collectives",
+             "gemm_abft_detect"),
+))
 def _ft_gemm_correct(ctx):
     return _ft_gemm_build(ctx, armed=True)
 
@@ -740,7 +834,10 @@ def _ft_potrf_detect(ctx):
     return _ft_factor_build(ctx, "potrf", armed=False)
 
 
-@register("potrf_abft_correct", tags=("ft",))
+@register("potrf_abft_correct", tags=("ft",), contracts=(
+    Contract(Option.FaultTolerance, "zero_extra_collectives",
+             "potrf_abft_detect"),
+))
 def _ft_potrf_correct(ctx):
     return _ft_factor_build(ctx, "potrf", armed=True)
 
@@ -750,7 +847,10 @@ def _ft_lu_detect(ctx):
     return _ft_factor_build(ctx, "getrf_nopiv", armed=False)
 
 
-@register("getrf_nopiv_abft_correct", tags=("ft",))
+@register("getrf_nopiv_abft_correct", tags=("ft",), contracts=(
+    Contract(Option.FaultTolerance, "zero_extra_collectives",
+             "getrf_nopiv_abft_detect"),
+))
 def _ft_lu_correct(ctx):
     return _ft_factor_build(ctx, "getrf_nopiv", armed=True)
 
@@ -766,7 +866,9 @@ def _ft_lu_correct(ctx):
 # ---------------------------------------------------------------------------
 
 
-@register("potrf_dist_panel_pallas", tags=("panel",))
+@register("potrf_dist_panel_pallas", tags=("panel",), contracts=(
+    Contract(Option.PanelImpl, "bytes_invariant", "potrf_dist"),
+))
 def _potrf_pallas(ctx):
     from ..parallel.dist_chol import potrf_dist
 
@@ -774,7 +876,9 @@ def _potrf_pallas(ctx):
     return (lambda x: potrf_dist(x, panel_impl="pallas")), (a,)
 
 
-@register("getrf_nopiv_dist_panel_pallas", tags=("panel",))
+@register("getrf_nopiv_dist_panel_pallas", tags=("panel",), contracts=(
+    Contract(Option.PanelImpl, "bytes_invariant", "getrf_nopiv_dist"),
+))
 def _getrf_nopiv_pallas(ctx):
     from ..parallel.dist_lu import getrf_nopiv_dist
 
@@ -785,11 +889,15 @@ def _getrf_nopiv_pallas(ctx):
 @register("gemm_abft_panel_pallas", tags=("panel", "ft"))
 def _ft_gemm_pallas(ctx):
     """The fused trailing-update+checksum SUMMA consume (and its online
-    Huang-Abraham discrepancy reduction) under the gate."""
+    Huang-Abraham discrepancy reduction) under the gate.  No
+    bytes_invariant contract: the fused path's online discrepancy adds
+    one deliberate psum up each mesh column that the XLA lowering skips."""
     return _ft_gemm_build(ctx, armed=False, panel_impl="pallas")
 
 
-@register("potrf_abft_panel_pallas", tags=("panel", "ft"))
+@register("potrf_abft_panel_pallas", tags=("panel", "ft"), contracts=(
+    Contract(Option.PanelImpl, "bytes_invariant", "potrf_abft_detect"),
+))
 def _ft_potrf_pallas(ctx):
     return _ft_factor_build(ctx, "potrf", armed=False, panel_impl="pallas")
 
@@ -844,17 +952,23 @@ def _gesv_mixed(ctx):
     return _mixed_build(ctx, "gesv")
 
 
-@register("posv_mixed_mesh", tags=("mixed",))
+@register("posv_mixed_mesh", tags=("mixed",), contracts=(
+    Contract(Option.NumMonitor, "off_jaxpr_identical"),
+))
 def _posv_mixed(ctx):
     return _mixed_build(ctx, "posv")
 
 
-@register("gesv_mixed_mesh_ring", tags=("mixed", "bcast"))
+@register("gesv_mixed_mesh_ring", tags=("mixed", "bcast"), contracts=(
+    Contract(Option.BcastImpl, "bytes_invariant", "gesv_mixed_mesh"),
+))
 def _gesv_mixed_ring(ctx):
     return _mixed_build(ctx, "gesv", ring=True)
 
 
-@register("posv_mixed_mesh_ring", tags=("mixed", "bcast"))
+@register("posv_mixed_mesh_ring", tags=("mixed", "bcast"), contracts=(
+    Contract(Option.BcastImpl, "bytes_invariant", "posv_mixed_mesh"),
+))
 def _posv_mixed_ring(ctx):
     return _mixed_build(ctx, "posv", ring=True)
 
@@ -927,22 +1041,30 @@ def _flight_build(ctx, op, kind):
     return fn, (a.tiles, k)
 
 
-@register("gemm_summa_flight", tags=("flight",))
+@register("gemm_summa_flight", tags=("flight",), contracts=(
+    Contract("obs", "off_jaxpr_identical"),
+))
 def _gemm_flight(ctx):
     return _flight_build(ctx, "summa", "general")
 
 
-@register("potrf_dist_flight", tags=("flight",))
+@register("potrf_dist_flight", tags=("flight",), contracts=(
+    Contract("obs", "off_jaxpr_identical"),
+))
 def _potrf_flight(ctx):
     return _flight_build(ctx, "potrf", "spd")
 
 
-@register("getrf_nopiv_dist_flight", tags=("flight",))
+@register("getrf_nopiv_dist_flight", tags=("flight",), contracts=(
+    Contract("obs", "off_jaxpr_identical"),
+))
 def _getrf_nopiv_flight(ctx):
     return _flight_build(ctx, "getrf_nopiv", "tril")
 
 
-@register("geqrf_dist_flight", tags=("flight",))
+@register("geqrf_dist_flight", tags=("flight",), contracts=(
+    Contract("obs", "off_jaxpr_identical"),
+))
 def _geqrf_flight(ctx):
     """One full CAQR flight k-step over the MULTI-ARRAY carry (ISSUE 15):
     panel -> three rooted column broadcasts -> trailing update + tree
@@ -966,7 +1088,9 @@ def _geqrf_flight(ctx):
     return fn, (a.tiles, st["tls"], st["tvs"], st["tts"], k)
 
 
-@register("he2hb_flight", tags=("flight",))
+@register("he2hb_flight", tags=("flight",), contracts=(
+    Contract("obs", "off_jaxpr_identical"),
+))
 def _he2hb_flight(ctx):
     """One full he2hb flight k-step (rooted panel-column broadcast + row
     gather -> replicated panel QR -> distributed two-sided update) over
@@ -1088,7 +1212,9 @@ def _posv_packed(ctx):
     return fn, (a1, a2)
 
 
-@register("posv_batched_traced", tags=("serve",))
+@register("posv_batched_traced", tags=("serve",), contracts=(
+    Contract("obs", "off_jaxpr_identical", "posv_batched"),
+))
 def _posv_batched_traced(ctx):
     """The Router's stacked dispatch under an ARMED RequestTrace (ISSUE
     14): the request tracer is host-side only — phase spans, outcome
@@ -1157,6 +1283,65 @@ def _redistribute(ctx):
         t, ctx.mesh, ctx.p, ctx.q, dims, cmap, False)), (a.tiles,)
 
 
+# The Checkpoint OFF contracts (PR 16): every public checkpointed driver
+# with Option.Checkpoint unresolved-to-off must route to the plain fused
+# kernel with an IDENTICAL jaxpr — checkpointing off is free, in the
+# strongest sense the analyzer can state.  Each entry below calls the
+# real ft.ckpt driver with every=None (the registry process sets no
+# SLATE_TPU_CHECKPOINT, so the env chain resolves off) and is proved
+# jaxpr-equal to the corresponding plain entry by analysis.contracts.
+
+
+@register("potrf_ckpt_off", tags=("ckpt",), contracts=(
+    Contract(Option.Checkpoint, "off_jaxpr_identical", "potrf_dist"),
+))
+def _potrf_ckpt_off(ctx):
+    from ..ft.ckpt import potrf_ckpt
+
+    a = ctx.dist(kind="spd", diag_pad=True)
+    return potrf_ckpt, (a,)
+
+
+@register("getrf_nopiv_ckpt_off", tags=("ckpt",), contracts=(
+    Contract(Option.Checkpoint, "off_jaxpr_identical", "getrf_nopiv_dist"),
+))
+def _getrf_nopiv_ckpt_off(ctx):
+    from ..ft.ckpt import getrf_nopiv_ckpt
+
+    a = ctx.dist(kind="tril", diag_pad=True)
+    return getrf_nopiv_ckpt, (a,)
+
+
+@register("getrf_pp_ckpt_off", tags=("ckpt",), contracts=(
+    Contract(Option.Checkpoint, "off_jaxpr_identical", "getrf_pp_dist"),
+))
+def _getrf_pp_ckpt_off(ctx):
+    from ..ft.ckpt import getrf_pp_ckpt
+
+    a = ctx.dist(diag_pad=True)
+    return getrf_pp_ckpt, (a,)
+
+
+@register("geqrf_ckpt_off", tags=("ckpt",), contracts=(
+    Contract(Option.Checkpoint, "off_jaxpr_identical", "geqrf_dist"),
+))
+def _geqrf_ckpt_off(ctx):
+    from ..ft.ckpt import geqrf_ckpt
+
+    a = ctx.dist()
+    return geqrf_ckpt, (a,)
+
+
+@register("he2hb_ckpt_off", tags=("ckpt",), contracts=(
+    Contract(Option.Checkpoint, "off_jaxpr_identical", "he2hb_dist"),
+))
+def _he2hb_ckpt_off(ctx):
+    from ..ft.ckpt import he2hb_ckpt
+
+    a = ctx.dist(kind="spd")
+    return he2hb_ckpt, (a,)
+
+
 @register("potrf_ckpt_seg", tags=("ckpt",))
 def _potrf_ckpt_seg(ctx):
     """One interior checkpoint segment of the mesh Cholesky (steps
@@ -1208,7 +1393,10 @@ def _geqrf_ckpt_seg(ctx):
         (a.tiles, st["tls"], st["tvs"], st["tts"])
 
 
-@register("geqrf_ckpt_seg_num", tags=("ckpt", "num"))
+@register("geqrf_ckpt_seg_num", tags=("ckpt", "num"), contracts=(
+    Contract(Option.NumMonitor, "zero_extra_collectives",
+             "geqrf_ckpt_seg"),
+))
 def _geqrf_ckpt_seg_num(ctx):
     """The MONITORED CAQR segment (ISSUE 14 satellite): the same panel
     steps with the in-carry reflector/τ orthogonality-loss gauge —
@@ -1245,7 +1433,10 @@ def _he2hb_ckpt_seg(ctx):
         "auto")), (a.tiles, st["vqs"], st["tqs"])
 
 
-@register("he2hb_ckpt_seg_num", tags=("ckpt", "num"))
+@register("he2hb_ckpt_seg_num", tags=("ckpt", "num"), contracts=(
+    Contract(Option.NumMonitor, "zero_extra_collectives",
+             "he2hb_ckpt_seg"),
+))
 def _he2hb_ckpt_seg_num(ctx):
     """The MONITORED he2hb segment (ISSUE 15): the same panel steps with
     the in-carry orthogonality-loss gauge — results bitwise, the gauge
@@ -1307,7 +1498,10 @@ def _ft_her2k_detect(ctx):
     return _ft_her2k_build(ctx, armed=False)
 
 
-@register("her2k_abft_correct", tags=("ft",))
+@register("her2k_abft_correct", tags=("ft",), contracts=(
+    Contract(Option.FaultTolerance, "zero_extra_collectives",
+             "her2k_abft_detect"),
+))
 def _ft_her2k_correct(ctx):
     return _ft_her2k_build(ctx, armed=True)
 
@@ -1349,12 +1543,17 @@ def _ft_trsm_detect(ctx):
     return _ft_trsm_build(ctx, armed=False)
 
 
-@register("trsm_abft_correct", tags=("ft",))
+@register("trsm_abft_correct", tags=("ft",), contracts=(
+    Contract(Option.FaultTolerance, "zero_extra_collectives",
+             "trsm_abft_detect"),
+))
 def _ft_trsm_correct(ctx):
     return _ft_trsm_build(ctx, armed=True)
 
 
-@register("potrf_dist_num", tags=("num",))
+@register("potrf_dist_num", tags=("num",), contracts=(
+    Contract(Option.NumMonitor, "zero_extra_collectives", "potrf_dist"),
+))
 def _potrf_num(ctx):
     from ..parallel.dist_chol import potrf_dist
 
@@ -1362,7 +1561,10 @@ def _potrf_num(ctx):
     return (lambda x: potrf_dist(x, num_monitor="on")), (a,)
 
 
-@register("getrf_nopiv_dist_num", tags=("num",))
+@register("getrf_nopiv_dist_num", tags=("num",), contracts=(
+    Contract(Option.NumMonitor, "zero_extra_collectives",
+             "getrf_nopiv_dist"),
+))
 def _getrf_nopiv_num(ctx):
     from ..parallel.dist_lu import getrf_nopiv_dist
 
@@ -1370,7 +1572,10 @@ def _getrf_nopiv_num(ctx):
     return (lambda x: getrf_nopiv_dist(x, num_monitor="on")), (a,)
 
 
-@register("getrf_pp_dist_num", tags=("num",))
+@register("getrf_pp_dist_num", tags=("num",), contracts=(
+    Contract(Option.NumMonitor, "zero_extra_collectives",
+             "getrf_pp_dist"),
+))
 def _getrf_pp_num(ctx):
     from ..parallel.dist_lu import getrf_pp_dist
 
@@ -1378,7 +1583,10 @@ def _getrf_pp_num(ctx):
     return (lambda x: getrf_pp_dist(x, num_monitor="on")), (a,)
 
 
-@register("getrf_tntpiv_dist_num", tags=("num",))
+@register("getrf_tntpiv_dist_num", tags=("num",), contracts=(
+    Contract(Option.NumMonitor, "zero_extra_collectives",
+             "getrf_tntpiv_dist"),
+))
 def _getrf_tnt_num(ctx):
     from ..parallel.dist_lu import getrf_tntpiv_dist
 
@@ -1386,7 +1594,9 @@ def _getrf_tnt_num(ctx):
     return (lambda x: getrf_tntpiv_dist(x, num_monitor="on")), (a,)
 
 
-@register("geqrf_dist_num", tags=("num",))
+@register("geqrf_dist_num", tags=("num",), contracts=(
+    Contract(Option.NumMonitor, "zero_extra_collectives", "geqrf_dist"),
+))
 def _geqrf_num(ctx):
     """The FUSED monitored CAQR loop (ISSUE 15): the per-panel
     reflector/τ orthogonality-loss gauge riding the fori_loop carry —
@@ -1398,7 +1608,9 @@ def _geqrf_num(ctx):
     return (lambda x: geqrf_dist(x, num_monitor="on")), (a,)
 
 
-@register("he2hb_num", tags=("num",))
+@register("he2hb_num", tags=("num",), contracts=(
+    Contract(Option.NumMonitor, "zero_extra_collectives", "he2hb_dist"),
+))
 def _he2hb_num(ctx):
     """The FUSED monitored two-stage eig stage-1 loop (ISSUE 15): the
     first eig-chain gauge — the replicated panel QR's loss proxy in the
@@ -1409,7 +1621,10 @@ def _he2hb_num(ctx):
     return (lambda x: he2hb_dist(x, num_monitor="on")), (a,)
 
 
-@register("posv_mixed_mesh_num", tags=("num", "mixed"))
+@register("posv_mixed_mesh_num", tags=("num", "mixed"), contracts=(
+    Contract(Option.NumMonitor, "zero_extra_collectives",
+             "posv_mixed_mesh"),
+))
 def _posv_mixed_num(ctx):
     """The fused refinement program with the (||r||, ||x||) history
     buffer riding the while_loop carry (Option.NumMonitor=on)."""

@@ -21,6 +21,26 @@ from .findings import Finding
 
 DEFAULT_WAIVER_FILE = os.path.join(os.path.dirname(__file__), "waivers.cfg")
 
+# Every rule id the lint CLI can emit.  A waiver naming anything else is
+# itself a finding (waiver-hygiene): a typo'd rule silently waives
+# nothing while looking like protection.
+LINT_RULES = frozenset({
+    "ast-parse", "ast-shard-map-import", "ast-raw-collective",
+    "ast-kwargs", "ast-masked-psum-bcast",
+    "grid",
+    "axis-name", "precision", "comm-upcast", "loop-audit", "donation",
+    "trace-error",
+    "spmd-divergent-collectives", "spmd-ppermute-bijection",
+    "spmd-donation-liveness",
+})
+# Rule ids the contract-matrix CLI (analysis.contracts) can emit.
+CONTRACT_RULES = frozenset({
+    "contract-off-jaxpr", "contract-extra-collectives", "contract-bytes",
+    "contract-undeclared", "contract-option-unconsumed",
+    "contract-trace-error",
+})
+KNOWN_RULES = LINT_RULES | CONTRACT_RULES
+
 
 @dataclass
 class Waiver:
@@ -72,3 +92,63 @@ def load_waivers(path: Optional[str] = None) -> Waivers:
                 )
             entries.append(Waiver(parts[0], parts[1], "|".join(parts[2:]), lineno))
     return Waivers(entries)
+
+
+def check_hygiene(
+    waivers: Waivers,
+    driver_names,
+    donation_names,
+    path: str,
+) -> List[Finding]:
+    """Waiver-file hygiene: every waiver must name a rule some pass can
+    emit, and a pattern that points at something that exists — a
+    registered driver for ``driver:``/``contract:`` patterns, a package
+    file for path-shaped patterns.  A waiver referencing a renamed rule
+    or a deleted driver is dead protection wearing a live reason."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out: List[Finding] = []
+    for w in waivers.entries:
+        where = f"{path}:{w.line}"
+        if w.rule not in KNOWN_RULES:
+            out.append(Finding("waiver-hygiene", where, (
+                f"waiver names unknown rule {w.rule!r} — no pass emits "
+                "it, so this waiver can never match")))
+            continue
+        pat = w.pattern
+        if pat == "*":
+            continue
+        if pat.startswith("driver:") or pat.startswith("contract:"):
+            name = pat.split(":")[1]
+            if name not in driver_names:
+                out.append(Finding("waiver-hygiene", where, (
+                    f"waiver pattern {pat!r} names driver {name!r}, not "
+                    "in the registry")))
+        elif pat.startswith("donation:"):
+            name = pat.split(":")[1]
+            if name not in donation_names:
+                out.append(Finding("waiver-hygiene", where, (
+                    f"waiver pattern {pat!r} names donation contract "
+                    f"{name!r}, not in the registry")))
+        elif pat.endswith(".py"):
+            if not (
+                os.path.exists(os.path.join(pkg_root, pat))
+                or os.path.exists(os.path.join(pkg_root, "slate_tpu", pat))
+            ):
+                out.append(Finding("waiver-hygiene", where, (
+                    f"waiver pattern {pat!r} looks like a source path "
+                    "but no such file exists in the package")))
+    return out
+
+
+def check_stale(waivers: Waivers, scope_rules, path: str) -> List[Finding]:
+    """After a FULL run (every driver traced, no seeds), a waiver in this
+    CLI's rule scope that matched nothing is stale: the exception it
+    documents no longer occurs, and keeping it pre-waives a future
+    regression.  Stale waivers are hard failures, not notes."""
+    return [
+        Finding("waiver-stale", f"{path}:{w.line}", (
+            f"waiver '{w.rule} | {w.pattern}' matched no finding in a "
+            "full run — the exception it documents is gone; delete it"))
+        for w in waivers.unused()
+        if w.rule in scope_rules
+    ]

@@ -348,6 +348,27 @@ def _bcast_hops(impl: str, size: int, root: int):
     return hops
 
 
+def bcast_hop_schedule(impl: str, size: int, root: int = 0):
+    """The rooted-broadcast hop schedule as plain data: the exact list of
+    ppermute perms ``_rooted_bcast`` traces for ``impl`` on an axis of
+    ``size`` rooted at ``root`` — including the auto/degradation rules
+    (doubling on a non-power-of-two axis degrades to ring).  Exposed for
+    ``slate_tpu.analysis.spmd``, which proves every schedule is a valid
+    store-and-forward relay: pairwise-bijective hops, every source already
+    holding the payload, the union of destinations covering the axis.
+    ``psum`` is not a hop lowering (it has no schedule to prove)."""
+    _check_impl(impl)
+    if impl == "psum":
+        raise ValueError("psum is not a hop lowering; no schedule exists")
+    if size <= 1:
+        return []
+    if impl == "auto":
+        impl = "doubling" if size & (size - 1) == 0 else "ring"
+    elif impl == "doubling" and size & (size - 1):
+        impl = "ring"
+    return _bcast_hops(impl, size, root % size)
+
+
 def _concrete_root(owner, size: int):
     """``owner`` as a Python int when it is trace-time concrete (prologue
     prefetches index with Python ints; some callers pass static owners),

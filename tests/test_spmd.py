@@ -1,0 +1,170 @@
+"""SPMD safety passes (ISSUE 16 tentpole): branch-divergent collectives,
+ppermute bijection, donation liveness, and the broadcast engine's
+hop-schedule relay proof.  Each pass flags its seeded violation
+in-process and stays clean on well-formed kernels; the CLI-level
+``--seed-violation`` gates (which exercise the same seeds through
+``python -m slate_tpu.analysis.lint``) run in ci/run_ci.sh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from conftest import cpu_devices
+
+from slate_tpu.analysis.spmd import (
+    _verify_schedule,
+    check_branch_collectives,
+    check_donation_liveness,
+    check_hop_schedules,
+    check_ppermute_bijection,
+)
+
+
+def _mesh22():
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(cpu_devices(4)).reshape(2, 2), ("p", "q"))
+
+
+def _cond_jaxpr(true_fn, false_fn, shape):
+    """Trace a shard_map'd cond whose branches are the given kernels."""
+    from jax.sharding import PartitionSpec as P
+
+    from slate_tpu.parallel.comm import shard_map_compat
+
+    def fn(x):
+        def kernel(t):
+            return jax.lax.cond(t.sum() > 0, true_fn, false_fn, t)
+
+        return shard_map_compat(
+            kernel,
+            mesh=_mesh22(),
+            in_specs=(P("p", "q"),),
+            out_specs=P("p", "q"),
+            check_vma=False,
+        )(x)
+
+    return jax.make_jaxpr(fn)(jnp.zeros(shape))
+
+
+def test_flags_divergent_branch_collectives():
+    closed = _cond_jaxpr(
+        lambda t: jax.lax.psum(t, "p"),
+        lambda t: jax.lax.psum(jax.lax.psum(t, "p"), "p"),
+        (4, 6),
+    )
+    found = check_branch_collectives(closed, "driver:toy")
+    assert len(found) == 1
+    assert found[0].rule == "spmd-divergent-collectives"
+    assert "deadlock" in found[0].message
+
+
+def test_flags_branch_axis_divergence():
+    # same collective COUNT but a different axis: still a divergent
+    # ordered (op, axes) sequence — devices on "q" would wait forever
+    closed = _cond_jaxpr(
+        lambda t: jax.lax.psum(t, "p"),
+        lambda t: jax.lax.psum(t, "q"),
+        (4, 10),
+    )
+    found = check_branch_collectives(closed, "driver:toy")
+    assert len(found) == 1 and found[0].rule == "spmd-divergent-collectives"
+
+
+def test_accepts_uniform_branches():
+    # different arithmetic, identical collective sequence: safe by
+    # construction whatever the predicate does
+    closed = _cond_jaxpr(
+        lambda t: jax.lax.psum(t * 2.0, "p"),
+        lambda t: jax.lax.psum(t, "p") + 1.0,
+        (4, 14),
+    )
+    assert check_branch_collectives(closed, "driver:toy") == []
+
+
+def _ppermute_jaxpr(perm, shape):
+    from jax.sharding import PartitionSpec as P
+
+    from slate_tpu.parallel.comm import shard_map_compat
+
+    def fn(x):
+        return shard_map_compat(
+            lambda t: jax.lax.ppermute(t, "q", perm),
+            mesh=_mesh22(),
+            in_specs=(P("p", "q"),),
+            out_specs=P("p", "q"),
+            check_vma=False,
+        )(x)
+
+    return jax.make_jaxpr(fn)(jnp.zeros(shape))
+
+
+def test_flags_duplicate_ppermute_destination():
+    # JAX traces this silently; XLA keeps one payload and drops the rest
+    closed = _ppermute_jaxpr([(0, 1), (1, 1)], (4, 18))
+    found = check_ppermute_bijection(closed, {"p": 2, "q": 2}, "driver:toy")
+    assert len(found) == 1
+    assert found[0].rule == "spmd-ppermute-bijection"
+    assert "destination" in found[0].message
+
+
+def test_accepts_bijective_ppermute():
+    closed = _ppermute_jaxpr([(0, 1), (1, 0)], (4, 22))
+    assert check_ppermute_bijection(closed, {"p": 2, "q": 2}, "d:ok") == []
+
+
+def test_engine_hop_schedules_all_valid():
+    """Every ring/doubling schedule the broadcast engine can emit on the
+    registry grid's axis sizes, for every root, is a proven relay."""
+    assert check_hop_schedules() == []
+
+
+def test_schedule_verifier_flags_dropped_device():
+    # a ring that stops one hop short: device 3 never gets the payload
+    hops = [[(0, 1)], [(1, 2)], [(2, 2)]]
+    found = _verify_schedule("toy/broken_ring", 4, 0, hops)
+    assert any("never delivers" in f.message and "[3]" in f.message
+               for f in found)
+
+
+def test_schedule_verifier_flags_stray_source():
+    # hop 0 forwards from device 1, which does not hold the payload yet
+    found = _verify_schedule("toy/stray", 4, 0, [[(1, 2)]])
+    assert any("have not received the payload" in f.message for f in found)
+    assert any("never delivers" in f.message for f in found)
+
+
+def test_flags_read_after_donate():
+    g = jax.jit(lambda t: t * 2.0, donate_argnums=(0,))
+
+    def fn(x):
+        y = g(x)
+        return y + x  # x's buffer may already be reused by XLA
+
+    closed = jax.make_jaxpr(fn)(jnp.zeros((6, 26)))
+    found = check_donation_liveness(closed, "driver:toy")
+    assert len(found) == 1
+    assert found[0].rule == "spmd-donation-liveness"
+    assert "use-after-donate" in found[0].message
+
+
+def test_flags_donated_value_returned():
+    g = jax.jit(lambda t: t + 1.0, donate_argnums=(0,))
+
+    def fn(x):
+        return g(x), x  # returning the donated operand to the caller
+
+    closed = jax.make_jaxpr(fn)(jnp.zeros((6, 30)))
+    found = check_donation_liveness(closed, "driver:toy")
+    assert len(found) == 1 and "returned" in found[0].message
+
+
+def test_accepts_dead_after_donate():
+    g = jax.jit(lambda t: t * 3.0, donate_argnums=(0,))
+
+    def fn(x):
+        y = g(x)
+        return y * 2.0  # x is dead after the donating call: fine
+
+    closed = jax.make_jaxpr(fn)(jnp.zeros((6, 34)))
+    assert check_donation_liveness(closed, "driver:toy") == []
