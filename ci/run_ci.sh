@@ -195,6 +195,26 @@ python -m slate_tpu.serve.stats \
     artifacts/obs_flight/flight_geqrf.flight.json \
     | grep -q 'slate_tpu_sched_model_bytes'
 
+# telemetry spine (ISSUE 17): start the live scrape endpoint, drive a
+# tiny two-tenant Router workload (meshless rounds + one checkpointed/
+# monitored mesh solve), scrape it over HTTP mid-process, and require
+# validator-clean Prometheus text carrying ALL FOUR families (serve.*,
+# sched.*, mem.*, num.*), a validator-clean unified Perfetto trace with
+# >= 3 track types correlated by one request's trace_id, and a fresh
+# ledger entry — obs.live --ci asserts all of it and exits nonzero
+# otherwise.  The ring re-run proves the spine under the non-default
+# broadcast lowering (the sched.link_bytes hop records come from the
+# ring ppermute schedule there).  The ledger seeded from the committed
+# entries then gates the fresh run against the N-run median
+# (--trend); latency quantiles are wall clock and stay ignored.
+python -m slate_tpu.obs.live --ci --out artifacts/obs_live
+SLATE_TPU_BCAST_IMPL=ring python -m slate_tpu.obs.live --ci \
+    --out artifacts/obs_live_ring
+python -m slate_tpu.obs.report --trend artifacts/obs_live/ledger \
+    --ignore '*latency*_s'
+python -m slate_tpu.obs.report --trend artifacts/obs_live_ring/ledger \
+    --ignore '*latency*_s'
+
 # scaling-curve artifact (ISSUE 7 satellite): fold the MULTICHIP round
 # artifacts into one RunReport-schema curve and schema-validate it
 # through the standard CLI (the committed twin lives at

@@ -78,6 +78,9 @@ NAMING_RULES: Tuple[Tuple[str, str, object, str], ...] = (
     ("infix", "_ckpt", Option.Checkpoint, "family"),
     ("infix", "_abft", Option.FaultTolerance, "family"),
     ("suffix", "_flight", "obs", "entry"),
+    # *_traced entries run under an ARMED TraceContext (ISSUE 17): the
+    # request-attribution spine must prove it is host-side only
+    ("suffix", "_traced", "obs", "entry"),
 )
 
 

@@ -1214,6 +1214,7 @@ def _posv_packed(ctx):
 
 @register("posv_batched_traced", tags=("serve",), contracts=(
     Contract("obs", "off_jaxpr_identical", "posv_batched"),
+    Contract("obs", "zero_extra_collectives", "posv_batched"),
 ))
 def _posv_batched_traced(ctx):
     """The Router's stacked dispatch under an ARMED RequestTrace (ISSUE
@@ -1236,6 +1237,59 @@ def _posv_batched_traced(ctx):
                 out = posv_batched(x, y)
             serve_trace.finish(tr, "served")
         return out
+
+    return fn, (a, b)
+
+
+@register("potrf_dist_traced", tags=("serve", "obs"), contracts=(
+    Contract("obs", "off_jaxpr_identical", "potrf_dist"),
+    Contract("obs", "zero_extra_collectives", "potrf_dist"),
+))
+def _potrf_dist_traced(ctx):
+    """potrf_dist under an ARMED, tenant-carrying TraceContext with obs
+    forced on (ISSUE 17): the trace-context spine — trace_id/tenant
+    stamping on spans, StepEvents, mem samples and the tenant tag
+    dimension on every registry write — is host-side only, so the
+    traced program must be byte-for-byte the plain driver's: identical
+    jaxpr AND identical audited comm-record multiset.  NumMonitor is
+    pinned off: obs-on resolves its ``auto`` to the gauge-carrying
+    kernel (NumMonitor's OWN proven cells), which would mask what this
+    cell isolates — the spine."""
+    from .. import obs
+    from ..parallel.dist_chol import potrf_dist
+
+    a = ctx.dist(kind="spd", diag_pad=True)
+    ctx_obj = obs.TraceContext(obs.new_trace_id(), tenant="lint",
+                               klass="friendly", rid=0, op="potrf")
+
+    def fn(x):
+        with obs.force_enabled(), obs.use_context(ctx_obj):
+            with obs.driver_span("lint_traced_probe"):
+                return potrf_dist(x, num_monitor="off")
+
+    return fn, (a,)
+
+
+@register("gemm_summa_traced", tags=("serve", "obs"), contracts=(
+    Contract("obs", "off_jaxpr_identical", "gemm_summa_c"),
+    Contract("obs", "zero_extra_collectives", "gemm_summa_c"),
+))
+def _gemm_summa_traced(ctx):
+    """gemm_summa under the same armed TraceContext — the broadcast-
+    engine kernel family's cell of the spine contract (the hop records
+    the span absorbs into sched.link_bytes are audit-time artifacts,
+    not collectives added to the program)."""
+    from .. import obs
+    from ..parallel.summa import gemm_summa
+    from ..types import MethodGemm
+
+    a, b = ctx.dist(), ctx.dist()
+    ctx_obj = obs.TraceContext(obs.new_trace_id(), tenant="lint",
+                               klass="friendly", rid=1, op="gemm")
+
+    def fn(x, y):
+        with obs.force_enabled(), obs.use_context(ctx_obj):
+            return gemm_summa(1.0, x, y, method=MethodGemm.GemmC)
 
     return fn, (a, b)
 

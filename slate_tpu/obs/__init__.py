@@ -40,6 +40,12 @@ map) fused with xprof-style annotation:
 # ``python -m slate_tpu.obs.report`` runs without runpy's found-in-
 # sys.modules warning; import them as submodules
 # (``from slate_tpu.obs import perfetto, report``).
+from .context import (  # noqa: F401
+    TraceContext,
+    current as current_context,
+    new_trace_id,
+    use_context,
+)
 from .metrics import REGISTRY, MetricsRegistry, flatten_snapshot  # noqa: F401
 from .span import (  # noqa: F401
     FINISHED,
@@ -57,6 +63,10 @@ from .span import (  # noqa: F401
 )
 
 __all__ = [
+    "TraceContext",
+    "current_context",
+    "new_trace_id",
+    "use_context",
     "REGISTRY",
     "MetricsRegistry",
     "flatten_snapshot",

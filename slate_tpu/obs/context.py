@@ -1,0 +1,136 @@
+"""TraceContext: the one request identity every observability surface
+shares (ISSUE 17).
+
+The five surfaces this repo grew one PR at a time — RequestTrace
+(serve/trace.py), driver spans (obs/span.py), flight StepEvents
+(obs/flight.py), memory samples (obs/memory.py) and numerics gauges
+(obs/numerics.py) — each record rich data about *their* layer, but
+nothing correlated a request's p99 blowup with the k-step, comm hop, or
+HBM spike that caused it.  ``TraceContext`` is the missing spine: a
+thread-local ambient record of *whose work is running right now*,
+carrying
+
+- ``trace_id``  — the request's correlation id.  Assigned once at
+  RequestTrace construction, so degradation-ladder retries and resumes
+  (which re-dispatch under the SAME trace object) naturally keep one id
+  across dispatches, while a batch-abort bystander (its own trace)
+  gets its own.
+- ``tenant``    — the submitting tenant, the fair-share attribution
+  dimension.  Bounded cardinality by construction (one value per
+  tenant, not per request), so it is the ONLY context field that may
+  become a metrics-registry tag dimension; ``trace_id`` goes on event
+  records (spans, samples, StepEvents) where volume is already bounded
+  by the event caps.
+- ``klass`` / ``rid`` / ``op`` — the condest-keyed accuracy class and
+  request identity, for export surfaces that want them without a
+  registry round-trip.
+- ``parent``    — the enclosing span name at entry, closing the loop
+  between the request track and the span Gantt.
+
+Propagation contract: the serve layer enters a context around each
+request phase (serve/trace.py ``RequestTrace.phase``); every surface
+below reads ``current()`` at its existing record points.  With the obs
+layer disabled no context is ever entered (``new_trace`` returns None),
+``current()`` is never consulted on any dispatch path, and the whole
+module costs nothing — byte-identical dispatch and jaxpr-identical
+kernels, proven as contract-matrix cells (analysis/registry.py
+``*_traced`` entries).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+_tls = threading.local()
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex-char correlation id (the W3C traceparent shape,
+    halved: collision-safe for any plausible ledger window, short
+    enough to read in a Perfetto args panel)."""
+    return uuid.uuid4().hex[:16]
+
+
+class TraceContext:
+    """One request's ambient identity while its work runs on this
+    thread.  Immutable by convention — enter a fresh context instead of
+    mutating one mid-flight."""
+
+    __slots__ = ("trace_id", "tenant", "klass", "rid", "op", "parent")
+
+    def __init__(self, trace_id: str, tenant: Optional[str] = None,
+                 klass: Optional[str] = None, rid: Optional[int] = None,
+                 op: Optional[str] = None,
+                 parent: Optional[str] = None) -> None:
+        self.trace_id = trace_id
+        self.tenant = tenant
+        self.klass = klass
+        self.rid = rid
+        self.op = op
+        self.parent = parent
+
+    def __repr__(self) -> str:  # debugging/ledger aid
+        bits = [f"trace_id={self.trace_id!r}"]
+        for k in ("tenant", "klass", "rid", "op"):
+            v = getattr(self, k)
+            if v is not None:
+                bits.append(f"{k}={v!r}")
+        return f"TraceContext({', '.join(bits)})"
+
+
+def _stack() -> List[TraceContext]:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current() -> Optional[TraceContext]:
+    """The innermost active context on this thread, or None.  The None
+    case is the permanent fast path for every un-served workload (bench,
+    lint, tests with obs off): one thread-local load and a truthiness
+    test."""
+    st = getattr(_tls, "stack", None)
+    return st[-1] if st else None
+
+
+@contextlib.contextmanager
+def use_context(ctx: Optional[TraceContext]):
+    """Make ``ctx`` the ambient context for the body.  ``None`` is a
+    no-op (the disabled-mode call sites pass straight through without
+    allocating)."""
+    if ctx is None:
+        yield None
+        return
+    st = _stack()
+    st.append(ctx)
+    try:
+        yield ctx
+    finally:
+        st.pop()
+
+
+def event_tags() -> Dict[str, str]:
+    """Context tags for EVENT records (spans, samples, trace exports):
+    trace_id always, tenant when set.  Event streams are bounded by
+    their own caps, so per-request ids are safe here."""
+    ctx = current()
+    if ctx is None:
+        return {}
+    tags = {"trace_id": ctx.trace_id}
+    if ctx.tenant:
+        tags["tenant"] = ctx.tenant
+    return tags
+
+
+def tenant_tags() -> Dict[str, str]:
+    """Context tags for METRIC SERIES (registry counters / gauges /
+    histograms): tenant only — bounded cardinality.  trace_id would mint
+    one series per request and is deliberately excluded."""
+    ctx = current()
+    if ctx is not None and ctx.tenant:
+        return {"tenant": ctx.tenant}
+    return {}

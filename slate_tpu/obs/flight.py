@@ -93,6 +93,11 @@ class StepEvent(NamedTuple):
     t1: float
     bytes: float
     flops: float
+    # request attribution (ISSUE 17): the ambient TraceContext at the
+    # fenced dispatch, empty for un-served flights.  Trailing defaulted
+    # fields keep every positional construction site unchanged.
+    trace_id: str = ""
+    tenant: str = ""
 
 
 class FlightRecorder:
@@ -110,23 +115,33 @@ class FlightRecorder:
 
     def record_phase(self, op, k, phase, t0, t1, nbytes, flops, coords,
                      hops=None, root_k=None) -> None:
+        # fenced dispatches run on the host thread that holds the
+        # request's TraceContext (ISSUE 17) — stamp it so a flight Gantt
+        # row is joinable against the request track it served
+        from . import context as _context
+
+        ctx = _context.current()
+        trace_id = ctx.trace_id if ctx is not None else ""
+        tenant = (ctx.tenant or "") if ctx is not None else ""
         share = max(1, len(coords))
         if len(self.events) + share <= _EVENT_CAP:
             for rc in coords:
                 self.events.append(StepEvent(
                     op, int(k), phase, tuple(rc), float(t0), float(t1),
                     float(nbytes) / share, float(flops) / share,
+                    trace_id, tenant,
                 ))
         if hops and len(self.hop_events) < _EVENT_CAP:
             # root_k: the LOGICAL step that owns the broadcast, which
             # rotates the audited root-0 hop pairs in the Perfetto
             # export.  Differs from the dispatch index k only for
             # backward solves (trsm upper/notrans: logical nt-1-k).
-            self.hop_events.append(
-                {"op": op, "k": int(k), "phase": phase,
-                 "root_k": int(k if root_k is None else root_k),
-                 "t0": float(t0), "t1": float(t1), "hops": hops}
-            )
+            he = {"op": op, "k": int(k), "phase": phase,
+                  "root_k": int(k if root_k is None else root_k),
+                  "t0": float(t0), "t1": float(t1), "hops": hops}
+            if trace_id:
+                he["trace_id"] = trace_id
+            self.hop_events.append(he)
 
     def note_run(self, **meta) -> None:
         self.runs.append(meta)
@@ -1184,7 +1199,12 @@ def run_flight(op: str, n: int = 96, nb: int = 8, depth: Optional[int] = None,
     events = [
         {"op": e.op, "k": e.k, "phase": e.phase,
          "device": list(e.device_coord), "t0_s": e.t0 - base,
-         "t1_s": e.t1 - base, "bytes": e.bytes, "flops": e.flops}
+         "t1_s": e.t1 - base, "bytes": e.bytes, "flops": e.flops,
+         # request attribution rides into the report rows only when a
+         # context was ambient (served flights); un-served flight
+         # artifacts keep their exact historical row shape
+         **({"trace_id": e.trace_id} if e.trace_id else {}),
+         **({"tenant": e.tenant} if e.tenant else {})}
         for e in rec.events
     ]
     hop_events = [

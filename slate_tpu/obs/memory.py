@@ -261,6 +261,8 @@ def sample(tag: str, **extra) -> dict:
     allocator stats, into the bounded sample stream, the metrics
     registry (``mem.*`` gauges), and the running maxima the RunReport
     ``mem`` section carries."""
+    from . import context as _context
+
     live, per_live = device_live_bytes()
     stats = device_memory_stats()
     s = {
@@ -273,13 +275,24 @@ def sample(tag: str, **extra) -> dict:
         "peak_bytes_in_use": {d: v.get("peak_bytes_in_use", 0.0)
                               for d, v in stats.items()},
     }
+    # request attribution (ISSUE 17): a sample taken under a request's
+    # ambient TraceContext joins the unified Perfetto export by
+    # trace_id; the tenant (bounded cardinality) also tags the gauges
+    ctx = _context.current()
+    if ctx is not None:
+        s.setdefault("trace_id", ctx.trace_id)
+        if ctx.tenant:
+            s.setdefault("tenant", ctx.tenant)
     s.update(extra)
-    REGISTRY.gauge_set("mem.live_bytes", live, span=tag)
+    tt = {"tenant": ctx.tenant} if ctx is not None and ctx.tenant else {}
+    REGISTRY.gauge_set("mem.live_bytes", live, span=tag, **tt)
     in_use_max = max(s["bytes_in_use"].values(), default=0.0)
     peak_max = max(s["peak_bytes_in_use"].values(), default=0.0)
     if stats:
-        REGISTRY.gauge_set("mem.bytes_in_use_max", in_use_max, span=tag)
-        REGISTRY.gauge_set("mem.peak_bytes_in_use_max", peak_max, span=tag)
+        REGISTRY.gauge_set("mem.bytes_in_use_max", in_use_max, span=tag,
+                           **tt)
+        REGISTRY.gauge_set("mem.peak_bytes_in_use_max", peak_max, span=tag,
+                           **tt)
     with _lock:
         _STATE["samples"] += 1
         _STATE["live_bytes_max"] = max(_STATE["live_bytes_max"], live)
@@ -289,6 +302,11 @@ def sample(tag: str, **extra) -> dict:
             _STATE["peak_bytes_in_use_max"], peak_max)
         if len(SAMPLES) < _SAMPLE_CAP:
             SAMPLES.append(s)
+    # live telemetry bus (ISSUE 17): sys.modules probe — free unless an
+    # endpoint/test imported obs.live
+    _live = sys.modules.get(__package__ + ".live")
+    if _live is not None:
+        _live.publish("mem", s)
     return s
 
 

@@ -84,10 +84,21 @@ class GrowthAbort(Exception):
         )
 
 
+def _tenant_tags() -> Dict[str, str]:
+    """Per-tenant attribution on the num.* gauge/counter series
+    (ISSUE 17): the ambient TraceContext's tenant when one is set,
+    nothing otherwise.  Tenant only — per-request trace_ids would mint
+    unbounded gauge series; numwatch and the un-served monitors run
+    context-free and keep their exact historical series."""
+    from . import context as _context
+
+    return _context.tenant_tags()
+
+
 def record_growth_abort(op: str, growth: float) -> None:
     """Count one mid-loop growth abort (an alarm that ACTED — distinct
     from ``num.growth_alarms``, which records post-hoc observations)."""
-    REGISTRY.counter_add("num.growth_aborts", 1.0, op=op)
+    REGISTRY.counter_add("num.growth_aborts", 1.0, op=op, **_tenant_tags())
     with _lock:
         _STATE["growth_aborts"] += 1
         _STATE["lu_growth_max"] = max(_STATE["lu_growth_max"], float(growth))
@@ -244,14 +255,14 @@ def record_lu_growth(op: str, amax, gmax) -> None:
         return
     a, g = c
     growth = g / a if a > 0 else 0.0
-    REGISTRY.gauge_set("num.lu_amax", a, op=op)
-    REGISTRY.gauge_set("num.lu_growth", growth, op=op)
+    REGISTRY.gauge_set("num.lu_amax", a, op=op, **_tenant_tags())
+    REGISTRY.gauge_set("num.lu_growth", growth, op=op, **_tenant_tags())
     _note(op, {"amax": a, "gmax": g, "growth": growth})
     with _lock:
         _STATE["lu_growth_max"] = max(_STATE["lu_growth_max"], growth)
         if growth > GROWTH_THRESHOLD:
             _STATE["growth_alarms"] += 1
-            REGISTRY.counter_add("num.growth_alarms", 1.0, op=op)
+            REGISTRY.counter_add("num.growth_alarms", 1.0, op=op, **_tenant_tags())
 
 
 def record_chol_gauges(op: str, margin, lmin, lmax) -> None:
@@ -265,9 +276,9 @@ def record_chol_gauges(op: str, margin, lmin, lmax) -> None:
     if c is None:
         return
     m, lo, hi = c
-    REGISTRY.gauge_set("num.chol_margin", m, op=op)
-    REGISTRY.gauge_set("num.chol_diag_min", lo, op=op)
-    REGISTRY.gauge_set("num.chol_diag_max", hi, op=op)
+    REGISTRY.gauge_set("num.chol_margin", m, op=op, **_tenant_tags())
+    REGISTRY.gauge_set("num.chol_diag_min", lo, op=op, **_tenant_tags())
+    REGISTRY.gauge_set("num.chol_diag_max", hi, op=op, **_tenant_tags())
     _note(op, {"margin": m, "diag_min": lo, "diag_max": hi})
     with _lock:
         if not _MARGIN_SEEN[0]:
@@ -288,7 +299,7 @@ def record_qr_orth(op: str, loss) -> None:
     if c is None:
         return
     val = c[0]
-    REGISTRY.gauge_set("num.qr_orth_margin", val, op=op)
+    REGISTRY.gauge_set("num.qr_orth_margin", val, op=op, **_tenant_tags())
     _note(op, {"qr_orth_loss": val})
     with _lock:
         _STATE["qr_orth_loss_max"] = max(_STATE["qr_orth_loss_max"], val)
@@ -307,7 +318,7 @@ def record_he2hb_orth(op: str, loss) -> None:
     if c is None:
         return
     val = c[0]
-    REGISTRY.gauge_set("num.he2hb_orth_margin", val, op=op)
+    REGISTRY.gauge_set("num.he2hb_orth_margin", val, op=op, **_tenant_tags())
     _note(op, {"he2hb_orth_loss": val})
     with _lock:
         _STATE["he2hb_orth_loss_max"] = max(_STATE["he2hb_orth_loss_max"],
@@ -323,7 +334,7 @@ def record_condest(op: str, rcond) -> None:
         return
     rc = c[0]
     cond = (1.0 / rc) if rc > 0 else float("inf")
-    REGISTRY.gauge_set("num.condest", cond, op=op)
+    REGISTRY.gauge_set("num.condest", cond, op=op, **_tenant_tags())
     _note(op, {"rcond": rc, "cond": cond})
     with _lock:
         _STATE["condest_solves"] += 1
@@ -331,13 +342,13 @@ def record_condest(op: str, rcond) -> None:
             _STATE["condest_max"] = cond
         if cond > CONDEST_THRESHOLD:
             _STATE["condest_alarms"] += 1
-            REGISTRY.counter_add("num.condest_alarms", 1.0, op=op)
+            REGISTRY.counter_add("num.condest_alarms", 1.0, op=op, **_tenant_tags())
 
 
 def record_routed_gmres(op: str) -> None:
     """The auto ladder skipped the IR tier on measured health (growth /
     condest alarm) and entered at GMRES-IR."""
-    REGISTRY.counter_add("num.routed_gmres", 1.0, op=op)
+    REGISTRY.counter_add("num.routed_gmres", 1.0, op=op, **_tenant_tags())
     with _lock:
         _STATE["routed_gmres"] += 1
 
@@ -363,8 +374,10 @@ def record_ir_history(op: str, hist, iters) -> None:
     with _lock:
         _LAST_HISTORY[op] = rows
     for i, (rn, xn) in enumerate(rows):
-        REGISTRY.gauge_set("ir.residual_history", rn, op=op, iter=i)
-        REGISTRY.gauge_set("ir.xnorm_history", xn, op=op, iter=i)
+        REGISTRY.gauge_set("ir.residual_history", rn, op=op, iter=i,
+                           **_tenant_tags())
+        REGISTRY.gauge_set("ir.xnorm_history", xn, op=op, iter=i,
+                           **_tenant_tags())
 
 
 def route_entry_tier(kind: str, gauges: Dict[str, float],

@@ -25,6 +25,7 @@ def chrome_trace_events(
     spans: Optional[Iterable[dict]] = None,
     legacy_events: Optional[Iterable[tuple]] = None,
     legacy_t0: Optional[float] = None,
+    base: Optional[float] = None,
 ) -> List[dict]:
     """Build the traceEvents list.  ``spans`` defaults to the finished
     span stream; ``legacy_events`` takes utils.trace.Trace event tuples
@@ -40,7 +41,8 @@ def chrome_trace_events(
         {"name": "process_name", "ph": "M", "pid": PID, "tid": 0,
          "args": {"name": "slate_tpu"}},
     ]
-    base = min((s["t0"] for s in spans), default=0.0)
+    if base is None:
+        base = min((s["t0"] for s in spans), default=0.0)
     if legacy_events:
         legacy_events = list(legacy_events)
     link_total = 0.0
@@ -167,22 +169,25 @@ def memory_counter_events(samples: Iterable[dict], base: float = 0.0,
         if t is None:
             continue
         ts = max(0.0, (float(t) - (base if time_key == "t" else 0.0))) * _US
+        # request attribution (ISSUE 17): samples taken under an active
+        # TraceContext carry the emitting request's trace_id/tenant
+        attr = {k: s[k] for k in ("trace_id", "tenant") if s.get(k)}
         evs.append(
             {"name": "mem.live_bytes", "cat": "mem", "ph": "C",
              "pid": PID, "tid": tid, "ts": ts,
-             "args": {"bytes": s.get("live_bytes", 0.0)}}
+             "args": {"bytes": s.get("live_bytes", 0.0), **attr}}
         )
         for dev, b in sorted((s.get("bytes_in_use") or {}).items()):
             evs.append(
                 {"name": f"mem.bytes_in_use[{dev}]", "cat": "mem",
                  "ph": "C", "pid": PID, "tid": tid, "ts": ts,
-                 "args": {"bytes": b}}
+                 "args": {"bytes": b, **attr}}
             )
         for dev, b in sorted((s.get("live_per_device") or {}).items()):
             evs.append(
                 {"name": f"mem.live_bytes[{dev}]", "cat": "mem",
                  "ph": "C", "pid": PID, "tid": tid, "ts": ts,
-                 "args": {"bytes": b}}
+                 "args": {"bytes": b, **attr}}
             )
     return evs
 
@@ -321,7 +326,7 @@ def validate_chrome_trace(obj) -> List[str]:
     return errs
 
 
-def request_trace_events(traces) -> List[dict]:
+def request_trace_events(traces, base: Optional[float] = None) -> List[dict]:
     """Per-request serving timelines (ISSUE 14): one track per ACCURACY
     CLASS (the condest-keyed friendly/hostile partition is the SLA
     partition, so a class's track is its latency story at a glance), one
@@ -332,7 +337,8 @@ def request_trace_events(traces) -> List[dict]:
 
     ``traces`` are finished ``serve.trace.RequestTrace`` objects; phase
     timestamps are perf_counter absolutes rebased to the earliest
-    request start."""
+    request start (or to ``base`` when given — the unified export passes
+    a timebase shared with the span/mem tracks)."""
     traces = [t for t in traces if t is not None]
     classes = sorted({t.klass or "friendly" for t in traces})
     tid_of = {kl: 300 + i for i, kl in enumerate(classes)}
@@ -345,7 +351,8 @@ def request_trace_events(traces) -> List[dict]:
             {"name": "thread_name", "ph": "M", "pid": PID,
              "tid": tid_of[kl], "args": {"name": f"serve[{kl}]"}}
         )
-    base = min((t.t0 for t in traces), default=0.0)
+    if base is None:
+        base = min((t.t0 for t in traces), default=0.0)
     flow_id = 50_000
     for t in traces:
         tid = tid_of[t.klass or "friendly"]
@@ -353,7 +360,10 @@ def request_trace_events(traces) -> List[dict]:
         for ph in phases:
             args = {"rid": t.rid, "op": t.op, "n": t.n,
                     "outcome": t.outcome, "phase": ph["name"],
-                    "depth": ph["depth"]}
+                    "depth": ph["depth"],
+                    "trace_id": getattr(t, "trace_id", "")}
+            if getattr(t, "tenant", None):
+                args["tenant"] = t.tenant
             if ph["parent"]:
                 args["parent"] = ph["parent"]
             args.update({k: str(v) for k, v in ph.get("meta", {}).items()})
@@ -432,3 +442,94 @@ def numerics_counter_events(history, op: str = "", tid: int = 0,
              "pid": PID, "tid": tid, "ts": ts, "args": {"xnorm": xn}}
         )
     return evs
+
+
+def unified_trace_events(
+    traces,
+    spans: Optional[Iterable[dict]] = None,
+    flight_events: Optional[Iterable[dict]] = None,
+    flight_hop_events: Optional[Iterable[dict]] = None,
+    grid: Optional[tuple] = None,
+) -> List[dict]:
+    """ONE trace per serving run (ISSUE 17): the request track
+    (tid 300+), the driver-span Gantt + absorbed hop instants (tid 0),
+    the memory counter track, and optionally a flight-recorder Gantt
+    (tid 200+) — all on one shared perf_counter timebase, with
+    ``trace_id`` flow arrows tying each request's track event to every
+    driver span it dispatched.  Request phases, spans and mem samples
+    all stamp perf_counter absolutes, so the shared base is just their
+    minimum; flight events carry report-relative stamps and keep their
+    own zero (their correlation is the trace_id in the args, not the
+    clock).
+
+    ``traces`` are finished RequestTrace objects; ``spans`` defaults to
+    the finished span stream (whose tags already carry trace_id/tenant
+    when recorded under a request's TraceContext — obs/span.py)."""
+    import sys as _sys
+
+    traces = [t for t in traces if t is not None]
+    spans = list(_span.FINISHED) if spans is None else list(spans)
+    _mem = _sys.modules.get(__package__ + ".memory")
+    mem_samples = list(_mem.SAMPLES) if _mem is not None else []
+    bases = ([t.t0 for t in traces] + [s["t0"] for s in spans]
+             + [float(s["t"]) for s in mem_samples if s.get("t") is not None])
+    base = min(bases, default=0.0)
+
+    evs: List[dict] = list(request_trace_events(traces, base=base))
+    # the span/mem half: chrome_trace_events appends the mem counter
+    # track itself (same sys.modules probe), on the same shared base
+    evs.extend(e for e in chrome_trace_events(spans, base=base)
+               if e.get("ph") != "M" or e.get("name") != "process_name")
+    if flight_events:
+        evs.extend(e for e in flight_trace_events(
+            flight_events, flight_hop_events, grid)
+            if e.get("ph") != "M" or e.get("name") != "process_name")
+    # trace_id flow arrows: one arrow per (request, dispatched span) —
+    # ph "s" anchored at the request's first phase on its class track,
+    # ph "f" at the span on the driver track.  This is the correlation
+    # the UI renders; the args carry the id for machine consumers.
+    tid_of = {e["args"]["name"]: e["tid"] for e in evs
+              if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    span_evs = [e for e in evs
+                if e.get("cat") == "driver" and e.get("ph") == "X"
+                and (e.get("args") or {}).get("trace_id")]
+    flow_id = 90_000
+    for t in traces:
+        tr_id = getattr(t, "trace_id", "")
+        if not tr_id or not t.phases:
+            continue
+        klass = t.klass or "friendly"
+        rtid = tid_of.get(f"serve[{klass}]", 300)
+        ts0 = (min(ph["t0"] for ph in t.phases) - base) * _US
+        for se in span_evs:
+            if se["args"].get("trace_id") != tr_id:
+                continue
+            flow_id += 1
+            common = {"cat": "traceflow", "pid": PID, "id": flow_id,
+                      "name": f"trace:{tr_id[:8]}"}
+            evs.append(dict(common, ph="s", tid=rtid, ts=max(0.0, ts0),
+                            args={"trace_id": tr_id, "rid": t.rid,
+                                  "span": se["name"]}))
+            evs.append(dict(common, ph="f", bp="e", tid=se["tid"],
+                            ts=se["ts"], args={"trace_id": tr_id}))
+    evs.insert(0, {"name": "process_name", "ph": "M", "pid": PID, "tid": 0,
+                   "args": {"name": "slate_tpu.unified"}})
+    return evs
+
+
+def unified_chrome_trace(traces, spans=None, flight_events=None,
+                         flight_hop_events=None, grid=None) -> dict:
+    return {
+        "traceEvents": unified_trace_events(traces, spans, flight_events,
+                                            flight_hop_events, grid),
+        "displayTimeUnit": "ms",
+        "otherData": {"producer": "slate_tpu.obs.unified"},
+    }
+
+
+def write_unified_trace(path: str, traces, spans=None, flight_events=None,
+                        flight_hop_events=None, grid=None) -> str:
+    with open(path, "w") as f:
+        json.dump(unified_chrome_trace(traces, spans, flight_events,
+                                       flight_hop_events, grid), f, indent=1)
+    return path

@@ -10,10 +10,19 @@ CLI::
 
     python -m slate_tpu.obs.report REPORT.json              # pretty-print
     python -m slate_tpu.obs.report --check NEW.json OLD.json [--threshold 1.5]
+    python -m slate_tpu.obs.report --trend LEDGER_DIR [--last 8]
 
 ``--check`` exits 1 when any shared metric regressed by more than the
 ratio threshold (direction inferred per metric: *_seconds / *_bytes /
 *_error are lower-is-better, throughput-style names higher-is-better).
+
+``--trend`` (ISSUE 17) gates the NEWEST entry of an obs.live RunReport
+ledger (``artifacts/obs/ledger/``) against the per-key MEDIAN of the
+prior entries — N-run regression detection instead of a single
+pairwise diff, so one historically-slow run cannot mask (or fake) a
+regression.  Exit codes match --check: 0 pass, 1 regression, 2
+inconclusive (fewer than 3 usable entries, or nothing shared to
+compare).
 """
 
 from __future__ import annotations
@@ -130,8 +139,17 @@ def make_report(
     from ..ft.policy import ft_counter_values
     from ..linalg.refine import ir_counter_values
     from ..serve.metrics import serve_counter_values
+    from .context import current as _ctx_current
     from .memory import mem_counter_values
     from .numerics import num_counter_values
+
+    cfg = dict(config or {})
+    # RunReport-meta trace_id (ISSUE 17): a report emitted under an
+    # active TraceContext is joinable against that request's spans,
+    # ledger entries and bus events (ledger_append mints one otherwise)
+    ctx = _ctx_current()
+    if ctx is not None and "trace_id" not in cfg:
+        cfg["trace_id"] = ctx.trace_id
 
     return {
         "schema": SCHEMA,
@@ -139,7 +157,7 @@ def make_report(
         "name": name,
         "created_unix": time.time(),
         "env": _env_info(),
-        "config": dict(config or {}),
+        "config": cfg,
         "values": {k: float(v) for k, v in (values or {}).items()},
         # fault-tolerance outcome totals (ft.* counters): detections /
         # corrections / recomputes / uncorrectables accumulated this run
@@ -380,6 +398,26 @@ def check_regression(
     return failures, compared
 
 
+def trend_baseline(
+    history: List[Dict[str, float]], min_runs: int = 2
+) -> Tuple[Dict[str, float], List[str]]:
+    """Per-key median over the history runs that carry the key — the
+    robust N-run baseline ``--trend`` gates against (one outlier run
+    cannot drag it).  Keys carried by fewer than ``min_runs`` history
+    entries come back separately as thin: one prior run is a pair, not
+    a trend, so those keys are per-key inconclusive."""
+    from statistics import median
+
+    carriers: Dict[str, List[float]] = {}
+    for vals in history:
+        for k, v in vals.items():
+            carriers.setdefault(k, []).append(v)
+    base = {k: float(median(vs)) for k, vs in carriers.items()
+            if len(vs) >= min_runs}
+    thin = sorted(k for k, vs in carriers.items() if len(vs) < min_runs)
+    return base, thin
+
+
 def _pretty(rep: dict) -> str:
     lines = [f"RunReport {rep.get('name')!r} (schema {rep.get('schema')} "
              f"v{rep.get('version')})"]
@@ -427,6 +465,13 @@ def main(argv=None) -> int:
     ap.add_argument("report", nargs="?", help="RunReport JSON to pretty-print")
     ap.add_argument("--check", nargs=2, metavar=("NEW", "OLD"),
                     help="compare NEW against OLD (RunReport or BENCH_*.json)")
+    ap.add_argument("--trend", metavar="LEDGER_DIR",
+                    help="gate the newest entry of an obs.live report "
+                         "ledger against the per-key median of the prior "
+                         "entries (N-run regression detection)")
+    ap.add_argument("--last", type=int, default=8,
+                    help="--trend window: newest N ledger entries to "
+                         "consider (default 8)")
     ap.add_argument("--threshold", type=float, default=1.5,
                     help="worse-than ratio that fails --check (default 1.5)")
     ap.add_argument("--all-metrics", action="store_true",
@@ -441,6 +486,59 @@ def main(argv=None) -> int:
                          "skipping millisecond wall-clock keys a slower "
                          "CI machine would flake")
     args = ap.parse_args(argv)
+
+    if args.trend:
+        import fnmatch
+
+        from . import live as _live
+
+        docs = _live.ledger_load(args.trend, last=max(3, args.last))
+        usable = []
+        for d in docs:
+            try:
+                vals = load_values(d, args.all_metrics)
+            except ValueError:
+                continue  # timed-out/unrecognized entries stay out
+            if args.ignore:
+                vals = {k: v for k, v in vals.items()
+                        if not any(fnmatch.fnmatch(k, g)
+                                   for g in args.ignore)}
+            usable.append((d, vals))
+        if len(usable) < 3:
+            print(f"obs.report: trend inconclusive — {len(usable)} usable "
+                  f"ledger entr{'y' if len(usable) == 1 else 'ies'} under "
+                  f"{args.trend} (need >= 3: a latest run plus >= 2 of "
+                  "history)")
+            return 2
+        latest_doc, latest_vals = usable[-1]
+        history = [v for _, v in usable[:-1]]
+        baseline, thin = trend_baseline(history)
+        where = latest_doc.get("_ledger_path", "<latest>")
+        tr = (latest_doc.get("config") or {}).get("trace_id", "")
+        print(f"obs.report: trend — gating {where}"
+              + (f" (trace_id {tr})" if tr else "")
+              + f" against the median of {len(history)} prior run(s)")
+        for key in sorted(set(latest_vals) - set(baseline)):
+            # thin (one prior carrier) or brand-new keys alike: one or
+            # zero prior points is a pair at best, not a trend
+            print(f"  INCONCLUSIVE {key} = {latest_vals[key]:.6g} — "
+                  f"carried by {'1' if key in thin else '0'} prior "
+                  "ledger entr" + ("y" if key in thin else "ies"))
+        failures, compared = check_regression(
+            latest_vals, baseline, args.threshold)
+        if compared == 0:
+            print("obs.report: trend inconclusive — no metric shared by "
+                  "the latest entry and >= 2 prior ones")
+            return 2
+        if failures:
+            print(f"obs.report: trend — {len(failures)} regression(s) over "
+                  f"{compared} gated metric(s):")
+            for msg in failures:
+                print(f"  FAIL {msg}")
+            return 1
+        print(f"obs.report: trend OK — {compared} metric(s) within "
+              f"{args.threshold}x of the {len(history)}-run median")
+        return 0
 
     if args.check:
         new_path, old_path = args.check

@@ -173,9 +173,19 @@ def driver_span(name: str, **tags):
         return
 
     from ..parallel import comm  # lazy: obs must not import parallel at module load
+    from . import context as _context
 
     st = _stack()
     parent = st[-1] if st else None
+    # request/tenant attribution (ISSUE 17): a span opened while a
+    # TraceContext is ambient carries the request's trace_id (and tenant)
+    # in its tags — the join key the unified Perfetto export correlates
+    # tracks by.  setdefault: an explicit caller-provided id wins.
+    ctx = _context.current()
+    if ctx is not None:
+        tags.setdefault("trace_id", ctx.trace_id)
+        if ctx.tenant:
+            tags.setdefault("tenant", ctx.tenant)
     span = Span(name, tags, len(st), parent.name if parent else None)
     st.append(span)
 
@@ -215,13 +225,31 @@ def driver_span(name: str, **tags):
 
         dur = span.t1 - span.t0
         span.metrics.setdefault("wall_seconds", dur)
-        REGISTRY.counter_add("span_count", 1, span=name)
-        REGISTRY.observe("span_seconds", dur, span=name)
+        # tenant tag dimension (ISSUE 17): per-tenant span/comm series
+        # when a tenant-carrying context is ambient.  Tenant-less runs
+        # (bench, lint, the whole pre-serving surface) keep their exact
+        # historical tag sets.
+        tt = {"tenant": ctx.tenant} if ctx is not None and ctx.tenant else {}
+        REGISTRY.counter_add("span_count", 1, span=name, **tt)
+        REGISTRY.observe("span_seconds", dur, span=name, **tt)
         total_comm = 0.0
         for op, nbytes in _comm_bytes(records).items():
-            REGISTRY.counter_add("comm_bytes", nbytes, span=name, op=op)
+            REGISTRY.counter_add("comm_bytes", nbytes, span=name, op=op,
+                                 **tt)
             total_comm += nbytes
         span.metrics["comm_bytes"] = total_comm
+        # live schedule surface (ISSUE 17): the absorbed schedule-audit
+        # records also land as sched.* counter series — per-hop ppermute
+        # LINK bytes where the impl has hop pairs (ring/binomial),
+        # collective payload bytes otherwise (psum) — so a scrape of the
+        # LIVE registry carries the schedule family under either
+        # lowering (the offline twin is the FlightReport's flat sched.*
+        # values)
+        for rec_op, rec_bytes, rec_mult, _ph, _st2, rec_pairs in sched_records:
+            REGISTRY.counter_add(
+                "sched.link_bytes" if rec_pairs else "sched.coll_bytes",
+                float(rec_bytes) * rec_mult,
+                span=name, op=rec_op.split("[")[0], **tt)
         # per-hop LINK records (ppermute pairs) for the Perfetto
         # exporter's hop events; bounded per span
         # step None marks an in-loop broadcast whose owner was a tracer:
@@ -243,20 +271,27 @@ def driver_span(name: str, **tags):
             _memory.sample_span(span)
         except Exception:
             pass
+        record = {
+            "name": name,
+            "tags": {k: str(v) for k, v in tags.items()},
+            "t0": span.t0,
+            "t1": span.t1,
+            "depth": span.depth,
+            "parent": span.parent,
+            "metrics": dict(span.metrics),
+            "hops": hops,
+        }
         with _finished_lock:
             if len(FINISHED) < _EVENT_CAP:
-                FINISHED.append(
-                    {
-                        "name": name,
-                        "tags": {k: str(v) for k, v in tags.items()},
-                        "t0": span.t0,
-                        "t1": span.t1,
-                        "depth": span.depth,
-                        "parent": span.parent,
-                        "metrics": dict(span.metrics),
-                        "hops": hops,
-                    }
-                )
+                FINISHED.append(record)
+        # live telemetry bus (ISSUE 17): only when obs.live was imported
+        # by someone (an endpoint, a test) — a sys.modules probe keeps
+        # the bus entirely out of runs that never asked for it
+        import sys as _sys
+
+        _live = _sys.modules.get(__package__ + ".live")
+        if _live is not None:
+            _live.publish("span", record)
 
 
 def _default_tags(args) -> Dict[str, Any]:

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..types import Norm, Options, SlateError
 from . import trace as rtrace
 from .batch import DEFAULT_BINS, bin_for, pad_rhs_to_bin, pad_to_bin, \
@@ -191,13 +192,20 @@ class Router:
         # Lookahead for the packed solve).
         return make_key(f"{op}_{variant}", args, batch=batch, mesh=None)
 
-    def solve_batch(self, requests: Sequence[Tuple[str, jax.Array, jax.Array]]
+    def solve_batch(self, requests: Sequence[Tuple[str, jax.Array, jax.Array]],
+                    tenants: Optional[Sequence[Optional[str]]] = None
                     ) -> List[jax.Array]:
         """Serve a list of (op, a, b) requests (op in {"posv", "gesv"}).
         Returns per-request solutions in order.  Same-class requests
         sharing a bin run as ONE stacked compiled program (ragged sizes
         identity-pad to the bin; the padded rows solve an appended
         identity system and never touch data rows).
+
+        ``tenants`` optionally names the submitting tenant per request
+        (ISSUE 17): with the obs layer on, every metric, span, sample
+        and gauge recorded under that request's phases carries the
+        tenant tag (and the request's trace_id on event records); with
+        obs off the argument is inert — no trace, no context, no tag.
 
         With the obs layer enabled, every request carries a
         ``RequestTrace`` (serve/trace.py) across its whole lifecycle —
@@ -212,20 +220,22 @@ class Router:
         exit."""
         traces: List[Optional[rtrace.RequestTrace]] = [None] * len(requests)
         try:
-            return self._solve_batch_inner(requests, traces)
+            return self._solve_batch_inner(requests, traces, tenants)
         except Exception:
             for tr in traces:
                 if tr is not None and tr.outcome is None:
                     tr.finish("reject_batch_abort")
             raise
 
-    def _solve_batch_inner(self, requests, traces):
+    def _solve_batch_inner(self, requests, traces, tenants=None):
         groups: Dict[Tuple, List[int]] = {}
         padded: List[Optional[Tuple[jax.Array, jax.Array]]] = [None] * len(requests)
         for i, (op, a, b) in enumerate(requests):
             serve_count("requests")
             n = a.shape[0]
-            tr = traces[i] = rtrace.new_trace(op, n, self.nb, str(a.dtype))
+            tr = traces[i] = rtrace.new_trace(
+                op, n, self.nb, str(a.dtype),
+                tenant=tenants[i] if tenants else None)
             try:
                 with rtrace.phase(tr, "admission"):
                     m = bin_for(n, self.bins)
@@ -286,12 +296,22 @@ class Router:
                         key, lambda op=op, klass=klass: _build_batched(
                             op, klass))
                 with rtrace.phase_all(trs, "solve"):
-                    xs, info = prog(a_stack, b_stack)
-                    if live:
-                        # fence so the span (and the SLA latency) covers
-                        # the execution, not just the dispatch — the
-                        # untraced path keeps JAX's async semantics
-                        jax.block_until_ready(xs)
+                    # the dispatch itself runs inside a driver span
+                    # (ISSUE 17): with obs on, the batched path gets a
+                    # span record (and its depth-0 memory sample)
+                    # carrying the ambient trace_id/tenant — the join
+                    # point the unified Perfetto export correlates the
+                    # request track against; with obs off this is the
+                    # shared null span and dispatch is untouched
+                    with obs.driver_span("serve.dispatch", op=op,
+                                         klass=klass, batch=len(idxs)):
+                        xs, info = prog(a_stack, b_stack)
+                        if live:
+                            # fence so the span (and the SLA latency)
+                            # covers the execution, not just the
+                            # dispatch — the untraced path keeps JAX's
+                            # async semantics
+                            jax.block_until_ready(xs)
             serve_count("batches")
             serve_count("batched_solves", len(idxs))
             infos = np.asarray(info)
@@ -312,9 +332,11 @@ class Router:
                 rtrace.finish(traces[i])  # note-attributed served terminal
         return out  # type: ignore[return-value]
 
-    def solve(self, op: str, a: jax.Array, b: jax.Array) -> jax.Array:
+    def solve(self, op: str, a: jax.Array, b: jax.Array,
+              tenant: Optional[str] = None) -> jax.Array:
         """One request through the full policy (a batch of one)."""
-        return self.solve_batch([(op, a, b)])[0]
+        return self.solve_batch([(op, a, b)],
+                                tenants=[tenant] if tenant else None)[0]
 
     # -- graceful degradation (ISSUE 12 satellite) -------------------------
     #

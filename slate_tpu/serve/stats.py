@@ -1,5 +1,11 @@
 """Metrics export surface: Prometheus-style text + JSON snapshots of the
-serving registry (ISSUE 14).
+serving registry (ISSUE 14; canonical implementation moved to
+obs/live.py in ISSUE 17).
+
+This module keeps the historical import path and CLI, delegating to
+``slate_tpu.obs.live`` — there is ONE Prometheus formatter and ONE
+source for family naming, shared by this offline/embedding surface and
+the live scrape endpoint (``python -m slate_tpu.obs.live``).
 
 The library half is ``stats_snapshot()`` / ``prometheus_text()`` — a
 server embedding the Router exposes its scrape endpoint by returning
@@ -26,148 +32,17 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import Dict, List, Optional
 
-from .metrics import _sanitize_key, serve_counter_values
-
-_PREFIX = "slate_tpu_serve"
-
-
-# metric-name prefixes one scrape surfaces (ISSUE 15): the serving
-# counters/latencies plus the schedule (sched.*), accuracy-health
-# (num.*), and refinement-trajectory (ir.*) families — latency,
-# schedule, and health together in one exposition
-_SCRAPE_PREFIXES = ("serve.", "sched.", "num.", "ir.")
-
-
-def stats_snapshot() -> dict:
-    """JSON-able snapshot of the live serving surface: the serve.*
-    counter section (with the SLA reduction merged in), the exact
-    outcome-attribution totals, the num.* accuracy-health totals, and
-    every ``serve.``/``sched.``/``num.``/``ir.``-named metric series in
-    the shared registry."""
-    from ..obs import REGISTRY
-    from ..obs import numerics as _numerics
-    from . import trace as _trace
-
-    snap = REGISTRY.snapshot()
-    scrape_metrics = {
-        kind: [e for e in entries
-               if str(e.get("name", "")).startswith(_SCRAPE_PREFIXES)]
-        for kind, entries in snap.items()
-    }
-    # the num section (the RunReport twin): all-zero (nothing monitored
-    # this process) stays out, exactly like the report surface
-    num = _numerics.num_counter_values()
-    return {
-        "serve": serve_counter_values(),
-        "sla": _trace.sla_values(),
-        "num": (num if any(num.values()) else {}),
-        "finished_requests": len(_trace.finished_traces()),
-        "metrics": scrape_metrics,
-    }
-
-
-def _fmt_tags(tags: Dict[str, str], extra: Optional[Dict[str, str]] = None
-              ) -> str:
-    items = dict(tags or {})
-    if extra:
-        items.update(extra)
-    if not items:
-        return ""
-    body = ",".join(f'{_sanitize_key(k)}="{v}"'
-                    for k, v in sorted(items.items()))
-    return "{" + body + "}"
-
-
-def prometheus_text(snapshot: Optional[dict] = None) -> str:
-    """Prometheus exposition-format text of a ``stats_snapshot()``
-    (taken live when not given).  Rows are grouped per metric NAME with
-    exactly one ``# TYPE`` header each — multiple tag sets of one
-    metric (the (op, klass, outcome) latency series) are one metric
-    family to Prometheus, and a repeated TYPE line is a parse error."""
-    snap = snapshot if snapshot is not None else stats_snapshot()
-    # family name -> (kind, [sample rows]); insertion-ordered
-    families: Dict[str, tuple] = {}
-
-    def emit(name: str, kind: str, rows) -> None:
-        fam = families.setdefault(name, (kind, []))
-        fam[1].extend(rows)
-
-    # flat serve counters (+ merged SLA keys): the RunReport serve section
-    for key, val in sorted((snap.get("serve") or {}).items()):
-        name = f"{_PREFIX}_{_sanitize_key(key)}"
-        emit(name, "gauge" if "latency" in key or "rate" in key
-             else "counter", [f"{name} {val:.10g}"])
-    # flat num.* accuracy-health totals (ISSUE 15): worst-case gauges are
-    # gauges, event totals counters — the RunReport num section's scrape
-    for key, val in sorted((snap.get("num") or {}).items()):
-        name = f"slate_tpu_num_{_sanitize_key(key)}"
-        kind = ("gauge" if any(t in key for t in ("_max", "_min", "margin",
-                                                  "cond", "_s"))
-                else "counter")
-        emit(name, kind, [f"{name} {val:.10g}"])
-    # flat sched.* keys (a formatted FlightReport's values — the offline
-    # schedule surface; live registries carry sched series below instead)
-    for key, val in sorted((snap.get("sched") or {}).items()):
-        name = f"slate_tpu_{_sanitize_key(key)}"
-        emit(name, "gauge", [f"{name} {val:.10g}"])
-    # registry series (tagged counters/gauges/histograms)
-    m = snap.get("metrics") or {}
-    for e in m.get("counters", []):
-        name = f"slate_tpu_{_sanitize_key(e['name'])}_total"
-        emit(name, "counter",
-             [f"{name}{_fmt_tags(e.get('tags'))} {e['value']:.10g}"])
-    for e in m.get("gauges", []):
-        name = f"slate_tpu_{_sanitize_key(e['name'])}"
-        emit(name, "gauge",
-             [f"{name}{_fmt_tags(e.get('tags'))} {e['value']:.10g}"])
-    for e in m.get("histograms", []):
-        name = f"slate_tpu_{_sanitize_key(e['name'])}"
-        rows = [
-            f"{name}_count{_fmt_tags(e.get('tags'))} {e['count']}",
-            f"{name}_sum{_fmt_tags(e.get('tags'))} {e['sum']:.10g}",
-        ]
-        for label, qkey in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
-            qv = e.get(qkey)
-            if qv is not None:
-                rows.append(
-                    f"{name}{_fmt_tags(e.get('tags'), {'quantile': label})}"
-                    f" {qv:.10g}")
-        emit(name, "summary", rows)
-    lines: List[str] = []
-    for name, (kind, rows) in families.items():
-        lines.append(f"# TYPE {name} {kind}")
-        lines.extend(rows)
-    return "\n".join(lines) + "\n"
-
-
-def snapshot_from_report(rep: dict) -> dict:
-    """Rebuild the stats surface from a committed RunReport or
-    FlightReport (the offline twin of the live snapshot): the serve
-    section plus the num section and any ``num.*``/``sched.*`` headline
-    values (numwatch / flight artifacts format through the same
-    exposition — ISSUE 15)."""
-    metrics = rep.get("metrics") or {}
-    values = rep.get("values") or {}
-    num = dict(rep.get("num") or {})
-    num.update({k[len("num."):]: v for k, v in values.items()
-                if isinstance(v, (int, float)) and k.startswith("num.")})
-    sched = {k: v for k, v in values.items()
-             if isinstance(v, (int, float)) and k.startswith("sched.")}
-    return {
-        "serve": dict(rep.get("serve") or {}),
-        "sla": {k: v for k, v in (rep.get("serve") or {}).items()
-                if k.startswith(("latency_", "outcome_"))},
-        "num": num,
-        "sched": sched,
-        "finished_requests": None,
-        "metrics": {
-            kind: [e for e in metrics.get(kind, [])
-                   if str(e.get("name", "")).startswith(_SCRAPE_PREFIXES)]
-            for kind in ("counters", "gauges", "histograms")
-        },
-    }
+from ..obs.live import (  # noqa: F401
+    _PREFIX,
+    _SCRAPE_PREFIXES,
+    _fmt_tags,
+    prometheus_text,
+    sanitize_key as _sanitize_key,
+    snapshot_from_report,
+    stats_snapshot,
+    validate_prometheus_text,
+)
 
 
 def _run_demo() -> None:

@@ -45,6 +45,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..obs import REGISTRY, enabled
+from ..obs import context as _obs_context
 
 # terminal outcomes: every request ends in EXACTLY one of these
 TERMINALS = (
@@ -88,10 +89,19 @@ class RequestTrace:
     notes, and the single terminal outcome."""
 
     __slots__ = ("rid", "op", "n", "nb", "dtype", "klass", "bin", "batch",
-                 "t0", "t1", "phases", "notes", "outcome", "_stack")
+                 "t0", "t1", "phases", "notes", "outcome", "_stack",
+                 "trace_id", "tenant")
 
-    def __init__(self, op: str, n: int, nb: int, dtype: str) -> None:
+    def __init__(self, op: str, n: int, nb: int, dtype: str,
+                 tenant: Optional[str] = None) -> None:
         self.rid = next(_IDS)
+        # the correlation id every surface below joins on (ISSUE 17):
+        # assigned ONCE here, so degradation-ladder retries/resumes —
+        # which re-dispatch under this same trace — keep one trace_id
+        # across dispatches, while a batch-abort bystander (its own
+        # RequestTrace) gets its own
+        self.trace_id = _obs_context.new_trace_id()
+        self.tenant = tenant
         self.op = op
         self.n = int(n)
         self.nb = int(nb)
@@ -116,8 +126,16 @@ class RequestTrace:
                "parent": self._stack[-1] if self._stack else None,
                "meta": dict(meta)}
         self._stack.append(name)
+        # every surface beneath this phase (driver spans, flight
+        # StepEvents, mem samples, num gauges) reads the ambient
+        # TraceContext at its own record points — this phase boundary is
+        # the ONE propagation choke point (ISSUE 17)
+        ctx = _obs_context.TraceContext(
+            self.trace_id, tenant=self.tenant, klass=self.klass,
+            rid=self.rid, op=self.op)
         try:
-            yield rec
+            with _obs_context.use_context(ctx):
+                yield rec
         finally:
             self._stack.pop()
             rec["t1"] = time.perf_counter()
@@ -125,9 +143,13 @@ class RequestTrace:
             # unconditional: a trace only exists because obs was on at
             # admission, and flipping obs off mid-request must not
             # desynchronize the phase/latency surfaces from the exact
-            # outcome counts
+            # outcome counts.  The tenant tag joins only when a tenant
+            # was declared, so tenant-less request streams keep their
+            # exact historical tag sets (and the committed SLA artifact
+            # its exact series).
+            tt = {"tenant": self.tenant} if self.tenant else {}
             REGISTRY.observe("serve.phase_s", rec["t1"] - rec["t0"],
-                             op=self.op, phase=name)
+                             op=self.op, phase=name, **tt)
 
     def note(self, kind: str) -> None:
         """Record one degradation event (ft_retry / resume /
@@ -167,10 +189,26 @@ class RequestTrace:
         # histogram MUST stay in lockstep with the exact outcome counts
         # above — an obs.disable() racing a request in flight must not
         # leave outcome totals exceeding latency counts
+        tt = {"tenant": self.tenant} if self.tenant else {}
         REGISTRY.observe("serve.latency_s", self.t1 - self.t0,
-                         op=self.op, klass=klass, outcome=outcome)
+                         op=self.op, klass=klass, outcome=outcome, **tt)
         REGISTRY.counter_add("serve.outcomes", 1.0, op=self.op,
-                             klass=klass, outcome=outcome)
+                             klass=klass, outcome=outcome, **tt)
+        # live telemetry bus (ISSUE 17): publish the terminated request
+        # when the bus module is loaded (sys.modules probe — zero cost
+        # for runs that never imported obs.live)
+        import sys as _sys
+
+        _live = _sys.modules.get(
+            __package__.rsplit(".", 1)[0] + ".obs.live")
+        if _live is not None:
+            _live.publish("request", {
+                "rid": self.rid, "trace_id": self.trace_id,
+                "tenant": self.tenant, "op": self.op, "n": self.n,
+                "klass": klass, "outcome": outcome,
+                "latency_s": self.t1 - self.t0,
+                "notes": list(self.notes),
+            })
 
 
 # ---------------------------------------------------------------------------
@@ -179,12 +217,15 @@ class RequestTrace:
 # ---------------------------------------------------------------------------
 
 
-def new_trace(op: str, n: int, nb: int, dtype: str) -> Optional[RequestTrace]:
+def new_trace(op: str, n: int, nb: int, dtype: str,
+              tenant: Optional[str] = None) -> Optional[RequestTrace]:
     """A live trace while the obs layer is enabled, else None — the
-    zero-allocation disabled contract."""
+    zero-allocation disabled contract (which also means NO TraceContext
+    is ever entered with obs off: the context spine is invisible to the
+    disabled dispatch path)."""
     if not enabled():
         return None
-    return RequestTrace(op, n, nb, dtype)
+    return RequestTrace(op, n, nb, dtype, tenant=tenant)
 
 
 def phase(tr: Optional[RequestTrace], name: str, **meta):

@@ -1,0 +1,374 @@
+"""Telemetry-spine tests (ISSUE 17): one TraceContext from socket to
+step — trace_id constancy across the degradation ladder, batch-abort
+bystander identity, tenant-tagged attribution isolation, the live
+telemetry bus + HTTP scrape endpoint, the unified Perfetto export, the
+rotating RunReport ledger, and ``obs.report --trend`` exit codes.
+
+Budget notes: the mesh cases reuse test_serve's exact router opts and
+shapes (n = 64, nb = 8 on the 2x4 mesh — programs already compiled by
+the degradation-ladder suite); everything else is meshless n = 32 or
+pure-host (bus/ledger/trend).  The flight StepEvent case re-runs step
+dispatch and rides at ``-m slow``.
+"""
+
+import json
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from slate_tpu import obs
+from slate_tpu.obs import live
+from slate_tpu.obs import report as obs_report
+from slate_tpu.obs.metrics import REGISTRY
+from slate_tpu.parallel.mesh import make_mesh
+from slate_tpu.serve import trace as rtrace
+from slate_tpu.serve.router import Router
+from slate_tpu.types import Option, SlateError
+
+from conftest import cpu_devices
+
+
+def mesh24():
+    return make_mesh(2, 4, devices=cpu_devices(8))
+
+
+def _resilient_router(opts):
+    return Router(mesh=mesh24(), nb=8, bins=(64,), opts=opts)
+
+
+def _spd_one(rng, n=64):
+    g = rng.standard_normal((n, n))
+    return jnp.asarray(g @ g.T / n + 2 * np.eye(n))
+
+
+def _hex16(s):
+    return isinstance(s, str) and len(s) == 16 and all(
+        c in "0123456789abcdef" for c in s)
+
+
+# ---------------------------------------------------------------------------
+# trace_id constancy: the degradation ladder keeps ONE id
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_resume_keeps_one_trace_id(rng):
+    """A preempted-then-resumed request re-dispatches under the SAME
+    RequestTrace, so every driver span recorded across both dispatches
+    carries the one trace_id (and the submitting tenant) — the resume
+    is one request's story, not two."""
+    from slate_tpu.ft import inject
+
+    router = _resilient_router({Option.Checkpoint: 3,
+                                Option.NumMonitor: "off"})
+    a = _spd_one(rng)
+    b = jnp.asarray(rng.standard_normal((64, 2)))
+    with obs.force_enabled(True):
+        before_tr = len(rtrace.finished_traces())
+        before_sp = len(obs.FINISHED)
+        with inject.fault_scope(
+            inject.FaultPlan([inject.KillFault("potrf", 4)])
+        ):
+            router.solve("posv", a, b, tenant="acme")
+        traces = rtrace.finished_traces()[before_tr:]
+        spans = obs.FINISHED[before_sp:]
+    assert len(traces) == 1
+    tr = traces[0]
+    assert tr.outcome == "served_resume"
+    assert _hex16(tr.trace_id)
+    tagged = [s for s in spans if s["tags"].get("trace_id")]
+    # both dispatches (pre-kill + resume) record spans under the request
+    assert len(tagged) >= 2
+    assert {s["tags"]["trace_id"] for s in tagged} == {tr.trace_id}
+    assert {s["tags"].get("tenant") for s in tagged} == {"acme"}
+
+
+def test_batch_abort_bystander_gets_own_trace_id(rng):
+    """The failing request and its batch-abort bystander are DIFFERENT
+    requests: distinct trace_ids, each cause attributed to its own."""
+    n = 32
+    router = Router(bins=(32,), hbm_budget=1 << 30)
+    g = rng.standard_normal((n, n))
+    good = jnp.asarray(g @ g.T / n + 2 * np.eye(n))
+    b = jnp.asarray(rng.standard_normal((n, 2)))
+    with obs.force_enabled(True):
+        before = len(rtrace.finished_traces())
+        with pytest.raises(SlateError, match="nonzero info"):
+            router.solve_batch([("posv", good, b),
+                                ("posv", jnp.asarray(-np.eye(n)), b)],
+                               tenants=["acme", "zeta"])
+        traces = rtrace.finished_traces()[before:]
+    assert sorted(t.outcome for t in traces) \
+        == ["failed_info", "reject_batch_abort"]
+    ids = {t.trace_id for t in traces}
+    assert len(ids) == 2 and all(_hex16(i) for i in ids)
+    by_outcome = {t.outcome: t for t in traces}
+    assert by_outcome["failed_info"].tenant != \
+        by_outcome["reject_batch_abort"].tenant
+
+
+# ---------------------------------------------------------------------------
+# tenant attribution: isolated registry series, tenant-free SLA pools
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_histogram_isolation(rng):
+    """Each tenant's served latency lands in its OWN
+    (op, klass, outcome, tenant) series; a tenant-less request keeps the
+    exact historical tag set (no tenant key); and the pooled SLA
+    reduction stays tenant-free."""
+    n = 32
+    router = Router(bins=(32,), hbm_budget=1 << 30)
+    good = jnp.asarray(rng.standard_normal((n, n)) + n * np.eye(n))
+    b = jnp.asarray(rng.standard_normal((n, 2)))
+    with obs.force_enabled(True):
+        router.solve("gesv", good, b, tenant="acme")
+        router.solve("gesv", good, b, tenant="zeta")
+        router.solve("gesv", good, b)  # tenant-less
+    series = REGISTRY.histogram_series("serve.latency_s")
+    served = [h for h in series if h["tags"].get("op") == "gesv"
+              and h["tags"].get("outcome") == "served"]
+    tenants = {h["tags"].get("tenant") for h in served}
+    assert {"acme", "zeta"} <= tenants
+    # the tenant-less stream keeps its historical tag set exactly
+    bare = [h for h in served if "tenant" not in h["tags"]]
+    assert bare and all(set(h["tags"]) == {"op", "klass", "outcome"}
+                        for h in bare)
+    # per-tenant series are isolated: distinct series objects, each with
+    # its own count
+    for t in ("acme", "zeta"):
+        own = [h for h in served if h["tags"].get("tenant") == t]
+        assert own and own[-1]["count"] >= 1
+    # pooled SLA keys never grow a tenant dimension
+    assert not any("acme" in k or "zeta" in k for k in rtrace.sla_values())
+
+
+# ---------------------------------------------------------------------------
+# the live bus + scrape endpoint
+# ---------------------------------------------------------------------------
+
+
+def test_bus_carries_span_request_mem_events(rng):
+    """With obs.live imported, span exits / trace finishes / memory
+    samples publish onto the bus, all carrying the request's
+    trace_id."""
+    from slate_tpu.obs import memory
+
+    n = 32
+    router = Router(bins=(32,), hbm_budget=1 << 30)
+    good = jnp.asarray(rng.standard_normal((n, n)) + n * np.eye(n))
+    b = jnp.asarray(rng.standard_normal((n, 2)))
+    since = live.BUS.last_seq()
+    with obs.force_enabled(True), memory.force_sampling(True):
+        before = len(rtrace.finished_traces())
+        router.solve("gesv", good, b, tenant="acme")
+        tr = rtrace.finished_traces()[before:][0]
+    evs = live.BUS.events(since=since)
+    kinds = {e["kind"] for e in evs}
+    assert {"span", "request", "mem"} <= kinds
+    req = [e for e in evs if e["kind"] == "request"]
+    assert any(e["data"].get("trace_id") == tr.trace_id for e in req)
+    sp = [e for e in evs if e["kind"] == "span"
+          and e["data"]["tags"].get("trace_id") == tr.trace_id]
+    assert sp
+    mem_evs = [e for e in evs if e["kind"] == "mem"]
+    assert any(e["data"].get("trace_id") == tr.trace_id for e in mem_evs)
+
+
+def test_scrape_endpoint_serves_validated_text(rng):
+    """The stdlib-http endpoint scrapes the LIVE registry: /metrics is
+    validator-clean Prometheus text, /snapshot.json and /events.json
+    parse, /healthz answers."""
+    n = 32
+    router = Router(bins=(32,), hbm_budget=1 << 30)
+    good = jnp.asarray(rng.standard_normal((n, n)) + n * np.eye(n))
+    b = jnp.asarray(rng.standard_normal((n, 2)))
+    since = live.BUS.last_seq()
+    with obs.force_enabled(True):
+        router.solve("gesv", good, b, tenant="acme")
+    srv, _thread, port = live.start_server(port=0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert live.validate_prometheus_text(text) == []
+        assert "slate_tpu_serve_requests" in text
+        assert 'tenant="acme"' in text
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/snapshot.json", timeout=10) as r:
+            snap = json.loads(r.read().decode())
+        assert snap["finished_requests"] >= 1
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/events.json?since={since}",
+                timeout=10) as r:
+            page = json.loads(r.read().decode())
+        assert page["events"] and page["last_seq"] > since
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            assert r.read().decode().strip() == "ok"
+    finally:
+        srv.shutdown()
+
+
+def test_bus_bounded_ring_semantics():
+    """The bus is a bounded ring: capped length, dropped counter,
+    monotonic seq, since-filtering."""
+    bus = live.TelemetryBus(cap=8)
+    seqs = [bus.publish("t", {"i": i}) for i in range(12)]
+    assert seqs == sorted(seqs) and len(set(seqs)) == 12
+    assert len(bus) == 8
+    assert bus.dropped == 4
+    evs = bus.events()
+    assert [e["data"]["i"] for e in evs] == list(range(4, 12))
+    tail = bus.events(since=seqs[-3])
+    assert [e["data"]["i"] for e in tail] == [10, 11]
+    assert bus.events(since=bus.last_seq()) == []
+
+
+# ---------------------------------------------------------------------------
+# the unified Perfetto export
+# ---------------------------------------------------------------------------
+
+
+def test_unified_trace_correlates_tracks(rng):
+    """ONE trace: request track + driver spans + mem counters, tied by
+    trace_id flow arrows — >= 3 track categories correlated by the one
+    request's id, validator-clean."""
+    from slate_tpu.obs import memory, perfetto
+
+    n = 32
+    router = Router(bins=(32,), hbm_budget=1 << 30)
+    good = jnp.asarray(rng.standard_normal((n, n)) + n * np.eye(n))
+    b = jnp.asarray(rng.standard_normal((n, 2)))
+    with obs.force_enabled(True), memory.force_sampling(True):
+        before = len(rtrace.finished_traces())
+        router.solve("gesv", good, b, tenant="acme")
+        traces = rtrace.finished_traces()[before:]
+    tr = traces[0]
+    doc = perfetto.unified_chrome_trace(traces)
+    assert perfetto.validate_chrome_trace(doc) == []
+    cats = {e.get("cat") for e in doc["traceEvents"]
+            if (e.get("args") or {}).get("trace_id") == tr.trace_id}
+    assert len(cats) >= 3, cats
+    assert "traceflow" in cats  # the flow arrows that tie it together
+    flows = [e for e in doc["traceEvents"] if e.get("cat") == "traceflow"]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+
+
+# ---------------------------------------------------------------------------
+# ledger rotation + --trend exit codes
+# ---------------------------------------------------------------------------
+
+
+def _mini_report(i, values):
+    return {
+        "schema": obs_report.SCHEMA, "version": obs_report.VERSION,
+        "name": "spine_t", "created_unix": 1000.0 + i, "env": {},
+        "config": {}, "values": dict(values),
+        "metrics": {"counters": [], "gauges": [], "histograms": []},
+        "spans": [],
+    }
+
+
+def test_ledger_rotation_and_trace_id_stamp(tmp_path):
+    d = str(tmp_path / "ledger")
+    for i in range(5):
+        live.ledger_append(_mini_report(i, {"x": float(i)}), d, cap=3)
+    paths = live.ledger_paths(d)
+    assert len(paths) == 3  # rotated: oldest two pruned
+    docs = live.ledger_load(d)
+    assert [doc["values"]["x"] for doc in docs] == [2.0, 3.0, 4.0]
+    for doc in docs:
+        assert _hex16(doc["config"]["trace_id"])
+        # the filename embeds the stamped id's prefix (joinability)
+        assert doc["config"]["trace_id"][:8] in doc["_ledger_path"]
+
+
+def test_trend_gate_exit_codes(tmp_path, capsys):
+    """--trend: < 3 usable entries => 2 (inconclusive); stable history
+    => 0; a regressed newest entry => 1."""
+    d = str(tmp_path / "ledger")
+    vals = {"spine_seconds": 1.0, "spine_gflops": 10.0}
+    live.ledger_append(_mini_report(0, vals), d)
+    live.ledger_append(_mini_report(1, vals), d)
+    assert obs_report.main(["--trend", d]) == 2  # too thin to gate
+    live.ledger_append(_mini_report(2, vals), d)
+    live.ledger_append(_mini_report(3, vals), d)
+    assert obs_report.main(["--trend", d]) == 0  # stable vs median
+    live.ledger_append(
+        _mini_report(4, {"spine_seconds": 10.0, "spine_gflops": 10.0}), d)
+    assert obs_report.main(["--trend", d]) == 1  # 10x slower than median
+    out = capsys.readouterr().out
+    assert "spine_seconds" in out and "regression" in out
+
+
+def test_trend_new_key_inconclusive_not_fatal(tmp_path, capsys):
+    """A key present only in the newest entry cannot have a trend — it
+    reports INCONCLUSIVE, it does not fail the gate."""
+    d = str(tmp_path / "ledger")
+    for i in range(3):
+        live.ledger_append(_mini_report(i, {"spine_seconds": 1.0}), d)
+    live.ledger_append(
+        _mini_report(3, {"spine_seconds": 1.0, "fresh_bytes": 5.0}), d)
+    assert obs_report.main(["--trend", d]) == 0
+    assert "fresh_bytes" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# one formatter + off-mode honesty
+# ---------------------------------------------------------------------------
+
+
+def test_stats_shim_delegates_to_live():
+    """serve.stats is a delegating shim: ONE Prometheus formatter lives
+    in obs.live (identity, not copies)."""
+    from slate_tpu.serve import stats
+
+    assert stats.prometheus_text is live.prometheus_text
+    assert stats.stats_snapshot is live.stats_snapshot
+    assert stats.validate_prometheus_text is live.validate_prometheus_text
+    assert stats.snapshot_from_report is live.snapshot_from_report
+
+
+def test_context_off_mode_costs_nothing(rng):
+    """Obs off: no trace, no ambient context, use_context(None) is a
+    pass-through, and driver spans record nothing — the spine is
+    host-side only and fully dark when disabled."""
+    from slate_tpu.obs import context as obs_context
+
+    with obs.force_enabled(False):
+        assert rtrace.new_trace("gesv", 32, 8, "float64") is None
+        assert obs_context.current() is None
+        with obs_context.use_context(None) as ctx:
+            assert ctx is None and obs_context.current() is None
+        assert obs_context.event_tags() == {}
+        before = len(obs.FINISHED)
+        with obs.driver_span("spine_off_probe"):
+            pass
+        assert len(obs.FINISHED) == before
+
+
+# ---------------------------------------------------------------------------
+# flight StepEvents join the spine (step dispatch re-run: slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_flight_step_events_carry_trace_id(rng):
+    """StepEvents recorded while a TraceContext is ambient stamp the
+    request's trace_id + tenant — the flight Gantt joins the unified
+    trace by id."""
+    from slate_tpu.obs import flight
+    from slate_tpu.parallel import from_dense
+    from slate_tpu.parallel.dist_chol import potrf_dist
+
+    a = from_dense(_spd_one(rng), mesh24(), 8, diag_pad_one=True)
+    ctx = obs.TraceContext(obs.new_trace_id(), tenant="acme",
+                           klass="friendly", rid=0, op="potrf")
+    with obs.force_enabled(True), obs.use_context(ctx):
+        with flight.flight_scope() as rec:
+            potrf_dist(a)
+    assert rec.events
+    assert {e.trace_id for e in rec.events} == {ctx.trace_id}
+    assert {e.tenant for e in rec.events} == {"acme"}

@@ -17,10 +17,19 @@ crash, the r02-r05 empty tails) are recorded under ``config.missing``
 with their rc — absence of data is part of the trajectory, not silently
 dropped.
 
+The single-chip BENCH_r0N.json partials fold in too (ISSUE 17): r04's
+fully-parsed headline + extras, and the ``[bench ...s] extra: k = v``
+progress lines recovered from r05's rc=124 timeout tail — a killed run's
+completed phases are data, not garbage.  The report's config is stamped
+with the emitting trace_id (the RunReport-meta convention the obs.live
+ledger uses), so this artifact is joinable against traces and ledger
+entries.
+
 Usage::
 
     python tools/scaling_report.py [--out artifacts/obs/scaling.report.json]
-        [--glob 'MULTICHIP_r*.json'] [--partial multichip_partial.json]
+        [--glob 'MULTICHIP_r*.json'] [--bench-glob 'BENCH_r*.json']
+        [--partial multichip_partial.json]
 """
 
 from __future__ import annotations
@@ -75,6 +84,39 @@ def parse_round(path: str):
     return tag, None, rc
 
 
+# a completed incremental metric in a bench run's progress log:
+# "[bench  653.7s] extra: potrf_f64_gflops_n8192 = 700.8"
+_BENCH_EXTRA_RE = re.compile(
+    r"\[bench\s+[\d.]+s\]\s+extra:\s+(\w+)\s*=\s*([-+\d.eE]+)")
+
+
+def parse_bench_round(path: str):
+    """(round_tag, values_dict, rc, recovered): the headline + extras of
+    a parsed BENCH wrapper, or — when the run died before the headline
+    (r05's rc=124 timeout) — every completed ``extra: k = v`` progress
+    line recovered from the tail."""
+    tag = re.sub(r"\.json$", "", os.path.basename(path))
+    with open(path) as f:
+        doc = json.load(f)
+    rc = doc.get("rc")
+    vals = {}
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        metric = parsed.get("metric")
+        if metric and isinstance(parsed.get("value"), (int, float)):
+            vals[str(metric)] = float(parsed["value"])
+        for k, v in (parsed.get("extras") or {}).items():
+            if isinstance(v, (int, float)):
+                vals[str(k)] = float(v)
+        return tag, vals, rc, False
+    for m in _BENCH_EXTRA_RE.finditer(doc.get("tail") or ""):
+        try:
+            vals[m.group(1)] = float(m.group(2))
+        except ValueError:
+            continue
+    return tag, vals, rc, bool(vals)
+
+
 def _rows_for(tag, phases):
     rows = []
     for name, vals in phases.items():
@@ -97,7 +139,7 @@ def _rows_for(tag, phases):
     return rows
 
 
-def build(paths, partial=None) -> dict:
+def build(paths, partial=None, bench_paths=()) -> dict:
     rows, missing = [], []
     for path in paths:
         tag, phases, rc = parse_round(path)
@@ -118,10 +160,27 @@ def build(paths, partial=None) -> dict:
         if isinstance(row.get("gflops"), (int, float)):
             values[f"{key}_gflops"] = float(row["gflops"])
 
+    # single-chip bench partials: headline + extras per round, recovered
+    # progress lines for rounds that died mid-run
+    bench_rounds = []
+    for path in bench_paths:
+        tag, bvals, rc, recovered = parse_bench_round(path)
+        if not bvals:
+            missing.append({"round": tag, "rc": rc})
+            continue
+        bench_rounds.append({"round": tag, "rc": rc,
+                             "recovered_from_tail": recovered,
+                             "n_metrics": len(bvals)})
+        low = tag.lower()
+        for k, v in bvals.items():
+            values[f"{low}_{k}"] = v
+
+    from slate_tpu.obs.context import current as _ctx_current, new_trace_id
     from slate_tpu.obs.report import SCHEMA, VERSION, _env_info
 
     import time
 
+    ctx = _ctx_current()
     return {
         "schema": SCHEMA,
         "version": VERSION,
@@ -131,7 +190,11 @@ def build(paths, partial=None) -> dict:
         "config": {
             "n": N, "nrhs": NRHS, "harness": "__graft_entry__.dryrun_multichip",
             "rounds": sorted({r["round"] for r in rows}),
+            "bench_rounds": bench_rounds,
             "missing": missing,
+            # the emitting trace_id (RunReport-meta convention, ISSUE
+            # 17): joinable against the obs.live ledger and traces
+            "trace_id": ctx.trace_id if ctx is not None else new_trace_id(),
         },
         "values": values,
         # the curve proper: phase x n_devices x GF/s (every harness round
@@ -150,6 +213,8 @@ def main(argv=None) -> int:
                     default=os.path.join(REPO, "artifacts", "obs",
                                          "scaling.report.json"))
     ap.add_argument("--glob", default=os.path.join(REPO, "MULTICHIP_r*.json"))
+    ap.add_argument("--bench-glob", default=os.path.join(REPO,
+                                                         "BENCH_r*.json"))
     ap.add_argument("--partial",
                     default=os.path.join(REPO, "multichip_partial.json"))
     args = ap.parse_args(argv)
@@ -158,7 +223,8 @@ def main(argv=None) -> int:
     if not paths:
         print(f"scaling_report: no artifacts match {args.glob}")
         return 2
-    rep = build(paths, args.partial)
+    bench_paths = sorted(glob.glob(args.bench_glob)) if args.bench_glob else []
+    rep = build(paths, args.partial, bench_paths)
 
     from slate_tpu.obs.report import validate_report
 
@@ -171,8 +237,14 @@ def main(argv=None) -> int:
         json.dump(rep, f, indent=1)
     n_rows = len(rep["curve"])
     n_missing = len(rep["config"]["missing"])
+    n_bench = len(rep["config"]["bench_rounds"])
     print(f"scaling_report: {len(paths)} round artifact(s) -> {n_rows} "
-          f"phase row(s), {n_missing} round(s) without data; wrote {args.out}")
+          f"phase row(s), {n_bench} bench round(s) folded, "
+          f"{n_missing} round(s) without data; wrote {args.out}")
+    for br in rep["config"]["bench_rounds"]:
+        how = ("recovered from rc=%s tail" % br["rc"]
+               if br["recovered_from_tail"] else "parsed headline")
+        print(f"  {br['round']}: {br['n_metrics']} metric(s), {how}")
     for row in rep["curve"]:
         bits = [f"{row['phase']:<16} {row['round']}"]
         if "seconds" in row:
