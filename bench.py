@@ -390,6 +390,40 @@ def _bench_serve_batched():
     return thr["batched_solves_per_s"]
 
 
+# Service layer (ISSUE 19): end-to-end requests/s through the batch-
+# window queue — submit-side binning, budget reservation and DRR dequeue
+# included, so the number prices the scheduler itself, not just the
+# stacked program it dispatches.
+def _bench_serve_queue():
+    import numpy as np
+    import jax.numpy as jnp
+
+    from slate_tpu.serve.cache import ExecutableCache
+    from slate_tpu.serve.queue import BatchQueue, ManualClock
+    from slate_tpu.serve.router import Router
+
+    n, reqs, batch = 256, 32, 8
+    rng = np.random.default_rng(7)
+    g = rng.standard_normal((n, n))
+    a = jnp.asarray(g @ g.T / n + 2 * np.eye(n))
+    b = jnp.asarray(rng.standard_normal(n))
+    router = Router(bins=(n,), cache=ExecutableCache())
+    q = BatchQueue(router, max_batch=batch, window_s=0.001,
+                   clock=ManualClock(), name="bench")
+    try:
+        for tenant in ("warm",):  # compile outside the timed stream
+            q.submit("posv", a, b, tenant=tenant)
+            q.drain()
+        t0 = time.perf_counter()
+        for i in range(reqs):
+            q.submit("posv", a, b, tenant=("acme", "zeta")[i % 2])
+        q.drain()
+        dt = time.perf_counter() - t0
+    finally:
+        q.close()
+    return reqs / dt
+
+
 def _timeit_perturbed(fn, a, *rest, reps=2):
     """Best wall time with a PERTURBED first input per rep (identical
     dispatches are cached by the tunnel) and a queue drain per timing."""
@@ -550,6 +584,8 @@ def main():
         # (cheap), the f64 baselines just before the n=8192 heavyweights
         # serving runtime (ISSUE 11): batched small-problem throughput
         ("serve_batched_solves_per_s", _bench_serve_batched),
+        # service layer (ISSUE 19): queue-scheduled end-to-end requests/s
+        ("serve_queue_reqs_per_s", _bench_serve_queue),
         ("gesv_mixed_gflops", lambda: _bench_mesh_solve("gesv", "auto")),
         ("posv_mixed_gflops", lambda: _bench_mesh_solve("posv", "auto")),
         ("gesv_f64_direct_gflops", lambda: _bench_mesh_solve("gesv", "off")),

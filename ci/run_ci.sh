@@ -186,6 +186,31 @@ python -m slate_tpu.obs.report --check \
     --ignore '*latency*_s'
 python -m slate_tpu.serve.stats artifacts/serve_ci/serve_sla.report.json \
     > /dev/null
+
+# service-layer queue smoke (ISSUE 19): the async batch-window queue —
+# a deterministic 64-request two-tenant ManualClock stream must coalesce
+# into <= ceil(N/B) dispatched programs with ZERO steady-state retraces
+# and bitwise parity to one-at-a-time Router dispatch, the weighted-DRR
+# dequeue must keep every tenant within one max-weight round (no
+# starvation, FIFO within tenant), per-tenant budget overruns must
+# terminate as counted reject_budget outcomes with headroom restored on
+# drain, the admission memo must evaluate each MemoryModel key exactly
+# once over 100 admissions, the SLA controller must trip EXACTLY once on
+# a seeded p95 spike (hysteresis — no flapping), and a ragged packed
+# window must dispatch as one block-diagonal program.  The stream is
+# meshless, so the ring re-run must reproduce every gated count exactly;
+# only the wall-clock latency quantiles are --ignore'd.
+python -m slate_tpu.serve.queue_smoke --out artifacts/serve_queue_ci
+SLATE_TPU_BCAST_IMPL=ring python -m slate_tpu.serve.queue_smoke \
+    --out artifacts/serve_queue_ci_ring
+python -m slate_tpu.obs.report --check \
+    artifacts/serve_queue_ci/serve_queue.report.json \
+    artifacts/obs/serve_queue.report.json \
+    --ignore '*latency*_s'
+python -m slate_tpu.obs.report --check \
+    artifacts/serve_queue_ci_ring/serve_queue.report.json \
+    artifacts/obs/serve_queue.report.json \
+    --ignore '*latency*_s'
 # the export surface's new families (ISSUE 15): one scrape carries the
 # num.* accuracy gauges and the sched.* schedule keys next to serve.* —
 # format the fresh numwatch + flight artifacts and assert both appear

@@ -26,10 +26,10 @@ fails the run on any cell that does not hold:
 Two registry-completeness checks run first, so a new driver cannot ship
 with an undeclared contract: every contract-bearing ``Option``
 (Checkpoint / NumMonitor / FaultTolerance / Lookahead / PanelImpl /
-BcastImpl) must be consumed by at least one declaration, and every
-naming-convention variant (``*_num`` / ``*_ckpt*`` / ``*_abft*`` /
-``*_flight``) must declare (or belong to a family that declares) the
-matching contract.
+BcastImpl / serve_queue) must be consumed by at least one declaration,
+and every naming-convention variant (``*_num`` / ``*_ckpt*`` /
+``*_abft*`` / ``*_flight`` / ``*_queue``) must declare (or belong to a
+family that declares) the matching contract.
 
 Exit codes mirror lint: 0 proven (or waived), 1 failed cells, 2
 internal error.
@@ -61,10 +61,13 @@ from ..types import Option  # noqa: E402  (no jax dependency)
 from .findings import Finding  # noqa: E402
 
 # Options the contract matrix covers; "obs" is the ambient observability
-# layer (forced on rather than off — recording must be trace-neutral).
+# layer (forced on rather than off — recording must be trace-neutral),
+# "serve_queue" the service layer (ISSUE 19: window dispatch must route
+# the Router's own programs — service-off is byte-identical dispatch).
 CONTRACT_OPTIONS = (
     Option.Checkpoint, Option.NumMonitor, Option.FaultTolerance,
     Option.Lookahead, Option.PanelImpl, Option.BcastImpl, "obs",
+    "serve_queue",
 )
 
 # naming-convention rules: (predicate kind, token, option, scope).
@@ -81,6 +84,10 @@ NAMING_RULES: Tuple[Tuple[str, str, object, str], ...] = (
     # *_traced entries run under an ARMED TraceContext (ISSUE 17): the
     # request-attribution spine must prove it is host-side only
     ("suffix", "_traced", "obs", "entry"),
+    # *_queue entries are the BatchQueue's window-dispatch bodies (ISSUE
+    # 19): the queue is host-side scheduling, so each must prove its
+    # program equals the direct Router/packed driver's
+    ("suffix", "_queue", "serve_queue", "entry"),
 )
 
 

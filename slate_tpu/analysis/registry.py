@@ -18,8 +18,8 @@ re-trace under the option's off-forcing context), ``zero_extra_collectives``
 (audited comm-record multiset equal to the base's), ``bytes_invariant``
 (audited comm volume equal to the base's).  ``python -m
 slate_tpu.analysis.contracts`` proves every declared cell and fails any
-``*_num`` / ``*_ckpt*`` / ``*_abft*`` / ``*_flight`` naming-convention
-variant whose contract is undeclared — a new driver cannot ship with a
+``*_num`` / ``*_ckpt*`` / ``*_abft*`` / ``*_flight`` / ``*_queue``
+naming-convention variant whose contract is undeclared — a new driver cannot ship with a
 claimed-but-unproven contract.
 """
 
@@ -1239,6 +1239,55 @@ def _posv_batched_traced(ctx):
         return out
 
     return fn, (a, b)
+
+
+@register("posv_batched_queue", tags=("serve",), contracts=(
+    Contract("serve_queue", "off_jaxpr_identical", "posv_batched"),
+    Contract("serve_queue", "zero_extra_collectives", "posv_batched"),
+))
+def _posv_batched_queue(ctx):
+    """The BatchQueue's stacked window dispatch (ISSUE 19): a closed
+    window's program is ``queue.stacked_body`` — by construction the
+    Router's own ``_build_batched`` body — so with the service layer off
+    the dispatch is byte-identical to the direct batched driver.  The
+    queue itself (windows, DRR, budgets) is host-side scheduling and
+    must never reach the jaxpr."""
+    from ..serve.queue import stacked_body
+
+    return stacked_body("posv", "friendly"), (_serve_stack(ctx, "spd"),
+                                              _serve_rhs(ctx))
+
+
+@register("posv_packed_queue", tags=("serve",), contracts=(
+    Contract("serve_queue", "off_jaxpr_identical", "posv_packed_mesh"),
+    Contract("serve_queue", "zero_extra_collectives", "posv_packed_mesh"),
+))
+def _posv_packed_queue(ctx):
+    """The BatchQueue's packed window dispatch: ``queue.packed_mesh_body``
+    over the same two-problem block-diagonal operand as the
+    ``posv_packed_mesh`` base.  AutoTune is pinned off and BlockSize
+    pinned to the base's nb: the tuned table's nearest-n lookup WOULD
+    resolve the n=96 winners for the 2N=192 packed operand (a different
+    schedule, legitimately), and this cell isolates the queue plumbing —
+    same options in, same program out."""
+    import jax.numpy as jnp
+    from ..serve.batch import pack_block_diag
+    from ..serve.queue import packed_mesh_body
+    from ..types import Option
+
+    a1 = ctx.dense(kind="spd")
+    a2 = jnp.eye(N, dtype="float64") * 2.0
+    body, _merged = packed_mesh_body(
+        ctx.mesh, 2 * N, "float64",
+        {Option.MixedPrecision: "off", Option.BlockSize: NB,
+         Option.AutoTune: "off"})
+
+    def fn(x1, x2):
+        a, _ = pack_block_diag([x1, x2], N)
+        b = jnp.ones((2 * N, 2), x1.dtype)
+        return body(a, b)
+
+    return fn, (a1, a2)
 
 
 @register("potrf_dist_traced", tags=("serve", "obs"), contracts=(

@@ -15,9 +15,11 @@ telemetry spine:
   LIVE registry over stdlib http: ``/metrics`` (Prometheus exposition
   text), ``/snapshot.json`` (the machine-readable snapshot),
   ``/events.json`` (the bus ring, ``?since=SEQ`` for incremental
-  tailing) and ``/healthz``.  The Prometheus formatter here is THE
-  formatter — ``serve.stats`` delegates to it, so family naming has one
-  source.
+  tailing), ``/queue.json`` (the batch-window queues' live stats —
+  open windows, per-tenant deficits and budget ledgers, ISSUE 19) and
+  ``/healthz`` (which carries queue liveness when the service layer is
+  imported).  The Prometheus formatter here is THE formatter —
+  ``serve.stats`` delegates to it, so family naming has one source.
 - **RunReport ledger** — ``ledger_append`` writes reports into a
   rotating on-disk ledger (``artifacts/obs/ledger/``, oldest entries
   pruned past the cap), each stamped with the emitting trace_id so
@@ -381,6 +383,16 @@ def ledger_load(ledger_dir: str, last: Optional[int] = None) -> List[dict]:
 # ---------------------------------------------------------------------------
 
 
+def queue_snapshot() -> dict:
+    """Live batch-window-queue stats (the ``/queue.json`` body) via the
+    producers' ``sys.modules`` probe: a process that never imports the
+    service layer pays nothing and scrapes an empty surface."""
+    q = sys.modules.get(__package__.rsplit(".", 1)[0] + ".serve.queue")
+    if q is None:
+        return {"queues": {}}
+    return q.queue_stats()
+
+
 def _make_handler():
     from http.server import BaseHTTPRequestHandler
 
@@ -415,8 +427,20 @@ def _make_handler():
                         "dropped": BUS.dropped,
                     }, default=str).encode()
                     self._send(200, "application/json", body)
+                elif url.path == "/queue.json":
+                    self._send(200, "application/json",
+                               json.dumps(queue_snapshot(),
+                                          default=str).encode())
                 elif url.path == "/healthz":
-                    self._send(200, "text/plain", b"ok\n")
+                    # queue liveness rides the health line (ISSUE 19):
+                    # an operator's first question about a wedged
+                    # service is "is anything stuck in a window"
+                    qs = queue_snapshot()["queues"]
+                    body = "ok\nqueues {} depth {} open_windows {}\n".format(
+                        len(qs),
+                        sum(s.get("depth", 0) for s in qs.values()),
+                        sum(s.get("open_windows", 0) for s in qs.values()))
+                    self._send(200, "text/plain", body.encode())
                 else:
                     self._send(404, "text/plain", b"not found\n")
             except Exception as e:  # a broken scrape must not kill the server
@@ -634,7 +658,7 @@ def main(argv=None) -> int:
         _run_workload(mesh_round=not args.no_mesh)
     srv, th, port = start_server(args.port)
     print(f"slate_tpu.obs.live: serving /metrics /snapshot.json "
-          f"/events.json /healthz on http://127.0.0.1:{port}",
+          f"/events.json /queue.json /healthz on http://127.0.0.1:{port}",
           file=sys.stderr)
     try:
         th.join()

@@ -40,6 +40,16 @@ requests.  This package is the throughput layer over ``api.py`` /
 - ``python -m slate_tpu.serve.smoke`` is the CI acceptance run; the
   ``serve.*`` counters land in every RunReport and gate via
   ``obs.report --check`` like the ft/ir/mem/num sections.
+- ``queue`` / ``budget`` / ``controller`` / ``service``: the async
+  service layer (ISSUE 19).  ``BatchQueue`` coalesces a concurrent
+  request stream into batch windows (B requests or T seconds, binned
+  on the cache-key identity) over per-tenant HBM budget accounts
+  (``BudgetLedger``, ``reject_budget``) with weighted deficit-round-
+  robin dequeue; ``ServiceController`` closes the SLA loop (hysteresis
+  latches moving (B, T) and the precision-tier entry point off the
+  PR 14 p95/outcome-rate surface); ``python -m slate_tpu.serve.service``
+  is the stdlib-http front door and ``python -m
+  slate_tpu.serve.queue_smoke`` the CI acceptance run.
 """
 
 from .batch import (  # noqa: F401
@@ -51,8 +61,11 @@ from .batch import (  # noqa: F401
     potrf_batched,
     unpack_block_diag,
 )
+from .budget import BudgetLedger, request_cost  # noqa: F401
 from .cache import CacheKey, ExecutableCache, executable_cache  # noqa: F401
+from .controller import Hysteresis, ServiceController  # noqa: F401
 from .metrics import serve_counter_values  # noqa: F401
+from .queue import BatchQueue, ManualClock, queue_stats  # noqa: F401
 from .router import Router  # noqa: F401
 from .trace import RequestTrace, finished_traces  # noqa: F401
 from .table import (  # noqa: F401
@@ -62,10 +75,17 @@ from .table import (  # noqa: F401
 )
 
 __all__ = [
+    "BatchQueue",
+    "BudgetLedger",
     "CacheKey",
     "ExecutableCache",
     "executable_cache",
+    "Hysteresis",
+    "ManualClock",
     "Router",
+    "ServiceController",
+    "queue_stats",
+    "request_cost",
     "gemm_batched",
     "gesv_batched",
     "posv_batched",

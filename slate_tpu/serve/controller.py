@@ -1,0 +1,213 @@
+"""ServiceController: the SLA control loop over the batch-window queue
+(ISSUE 19 tentpole, part c).
+
+PR 14 built the measurement half — per-(op, class) latency quantiles and
+outcome rates reduced from live RequestTraces (``trace.sla_values``).
+This module closes the loop: a periodic ``step()`` reads that SLA
+surface plus the queue's own depth, and actuates the three serving
+knobs the measurements are ABOUT:
+
+- **(B, T) window shaping.**  Sustained queue depth over ``depth_hi``
+  widens the window (bigger B, longer T — amortize the backlog into
+  fewer, fuller programs); drained depth under ``depth_lo`` restores
+  the baseline (stop taxing latency for throughput nobody needs).
+- **Latency guard.**  p95 over the SLO shrinks T toward its floor —
+  the window wait is the one latency term the service layer itself
+  adds, so it is the first thing to give back.
+- **Precision-tier entry point.**  A sustained failure-outcome tail
+  (failed_info / reject_residual rates) escalates ``Router.tier_map``
+  so friendly-classified operators ENTER at the robust pp+GMRES-IR
+  tier (the Carson–Higham regime boundary is evidently misjudging this
+  traffic); a clean tail releases back to the condest-keyed ladder.
+
+Every latch is a **hysteresis** pair (trip threshold > release
+threshold, arm streaks, cooldown ticks) so one noisy scrape cannot
+flap a knob — an actuation requires ``arm`` consecutive out-of-band
+observations and a quiet cooldown.  Every actuation counts
+``serve.controller_actuations``, updates the ``serve.queue_window_*``
+gauges, and publishes a ``controller`` event on the telemetry bus, so
+a dashboard (or the queue smoke) can replay exactly when and why each
+knob moved.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .. import obs
+from ..obs import REGISTRY
+from . import trace as rtrace
+from .metrics import serve_count
+
+
+class Hysteresis:
+    """Two-threshold latch with arming streaks and a post-actuation
+    cooldown.  ``observe(value)`` returns "trip" the tick the value has
+    been >= ``hi`` for ``arm`` consecutive ticks (latch closed),
+    "release" symmetrically at <= ``lo``, else None.  While the latch
+    is closed further highs return None (no repeated actuation), and
+    ``cooldown`` ticks must pass after any actuation before the next —
+    the control loop cannot flap even on a square-wave input."""
+
+    def __init__(self, hi: float, lo: float, arm: int = 2,
+                 cooldown: int = 3) -> None:
+        if lo > hi:
+            raise ValueError(f"hysteresis lo {lo} > hi {hi}")
+        self.hi = float(hi)
+        self.lo = float(lo)
+        self.arm = int(arm)
+        self.cooldown = int(cooldown)
+        self.tripped = False
+        self._hi_streak = 0
+        self._lo_streak = 0
+        self._cool = 0
+
+    def observe(self, value: float) -> Optional[str]:
+        if value >= self.hi:
+            self._hi_streak += 1
+            self._lo_streak = 0
+        elif value <= self.lo:
+            self._lo_streak += 1
+            self._hi_streak = 0
+        else:
+            self._hi_streak = 0
+            self._lo_streak = 0
+        if self._cool > 0:
+            self._cool -= 1
+            return None
+        if not self.tripped and self._hi_streak >= self.arm:
+            self.tripped = True
+            self._cool = self.cooldown
+            self._hi_streak = 0
+            return "trip"
+        if self.tripped and self._lo_streak >= self.arm:
+            self.tripped = False
+            self._cool = self.cooldown
+            self._lo_streak = 0
+            return "release"
+        return None
+
+
+class ServiceController:
+    """The control loop.  Call ``step()`` periodically (the service
+    worker thread does; tests and the smoke drive it directly)."""
+
+    def __init__(self, queue, *,
+                 slo_p95_s: float = 0.25,
+                 depth_hi: Optional[float] = None,
+                 depth_lo: Optional[float] = None,
+                 failure_rate_hi: float = 0.05,
+                 failure_rate_lo: float = 0.005,
+                 arm: int = 2, cooldown: int = 3,
+                 widen_factor: float = 2.0,
+                 min_window_s: float = 0.001) -> None:
+        self.queue = queue
+        self.router = queue.router
+        self.slo_p95_s = float(slo_p95_s)
+        # baseline (B, T): what release restores
+        self._base_batch = int(queue.max_batch)
+        self._base_window_s = float(queue.window_s)
+        self.widen_factor = float(widen_factor)
+        self.min_window_s = float(min_window_s)
+        dhi = depth_hi if depth_hi is not None else 2.0 * queue.max_batch
+        dlo = depth_lo if depth_lo is not None else 0.5 * queue.max_batch
+        self.depth_latch = Hysteresis(dhi, dlo, arm=arm, cooldown=cooldown)
+        # latency is binary vs the SLO: hi = breach, lo = within 80%
+        self.latency_latch = Hysteresis(
+            self.slo_p95_s, 0.8 * self.slo_p95_s, arm=arm,
+            cooldown=cooldown)
+        self.failure_latch = Hysteresis(
+            failure_rate_hi, failure_rate_lo, arm=arm, cooldown=cooldown)
+        self.ticks = 0
+        self.actuations: List[dict] = []
+
+    # -- signal extraction -------------------------------------------------
+
+    def signals(self) -> Dict[str, float]:
+        """The three scalars the latches consume, reduced from the live
+        SLA surface (worst-case across (op, class) cells — the SLO is a
+        service promise, not a per-cell one) plus the queue's depth."""
+        sla = rtrace.sla_values()
+        p95 = max((v for k, v in sla.items()
+                   if k.startswith("latency_p95_")), default=0.0)
+        # every non-served outcome rate is failure tail (rejects and
+        # failures alike — all of them are broken promises to a caller)
+        fail = sum(v for k, v in sla.items()
+                   if k.startswith("outcome_rate_")
+                   and not k.startswith("outcome_rate_served"))
+        return {"depth": float(self.queue.depth()), "p95_s": p95,
+                "failure_rate": fail}
+
+    # -- the loop ----------------------------------------------------------
+
+    def step(self) -> List[dict]:
+        """One control tick: observe, latch, actuate.  Returns the list
+        of actuations this tick (usually empty)."""
+        self.ticks += 1
+        sig = self.signals()
+        acted: List[dict] = []
+
+        edge = self.depth_latch.observe(sig["depth"])
+        if edge == "trip":
+            acted.append(self._actuate(
+                "widen_window", sig,
+                batch=int(self._base_batch * self.widen_factor),
+                window_s=self._base_window_s * self.widen_factor))
+        elif edge == "release":
+            acted.append(self._actuate(
+                "restore_window", sig, batch=self._base_batch,
+                window_s=self._base_window_s))
+
+        edge = self.latency_latch.observe(sig["p95_s"])
+        if edge == "trip":
+            acted.append(self._actuate(
+                "shrink_window", sig, batch=self.queue.max_batch,
+                window_s=max(self.min_window_s,
+                             self._base_window_s / self.widen_factor)))
+        elif edge == "release":
+            acted.append(self._actuate(
+                "restore_window", sig, batch=self.queue.max_batch,
+                window_s=self._base_window_s))
+
+        edge = self.failure_latch.observe(sig["failure_rate"])
+        if edge == "trip":
+            acted.append(self._actuate("escalate_tier", sig,
+                                       tier={"friendly": "hostile"}))
+        elif edge == "release":
+            acted.append(self._actuate("release_tier", sig, tier={}))
+        return acted
+
+    def _actuate(self, action: str, sig: Dict[str, float], *,
+                 batch: Optional[int] = None,
+                 window_s: Optional[float] = None,
+                 tier: Optional[Dict[str, str]] = None) -> dict:
+        if batch is not None:
+            self.queue.max_batch = int(batch)
+        if window_s is not None:
+            self.queue.window_s = float(window_s)
+        if tier is not None:
+            self.router.tier_map = dict(tier)
+        serve_count("controller_actuations")
+        rec = {"tick": self.ticks, "action": action,
+               "batch": self.queue.max_batch,
+               "window_s": self.queue.window_s,
+               "tier_map": dict(self.router.tier_map),
+               "signals": dict(sig)}
+        self.actuations.append(rec)
+        if obs.enabled():
+            REGISTRY.gauge_set("serve.queue_window_batch",
+                               float(self.queue.max_batch),
+                               queue=self.queue.name)
+            REGISTRY.gauge_set("serve.queue_window_s",
+                               float(self.queue.window_s),
+                               queue=self.queue.name)
+        self._publish(rec)
+        return rec
+
+    def _publish(self, rec: dict) -> None:
+        import sys as _sys
+
+        _live = _sys.modules.get(
+            __package__.rsplit(".", 1)[0] + ".obs.live")
+        if _live is not None:
+            _live.publish("controller", rec)

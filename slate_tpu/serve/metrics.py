@@ -45,6 +45,18 @@ _ZEROS: Dict[str, float] = {
     "condest_cache_hits": 0.0,   # condest served from a factor's memo
     "ozaki_presplits": 0.0,      # digit-plane splits computed
     "ozaki_presplit_hits": 0.0,  # splits served from the operand cache
+    # batch-window queue + budgets + control loop (ISSUE 19)
+    "queue_submitted": 0.0,      # requests admitted into the batch queue
+    "queue_windows": 0.0,        # batch windows closed (dispatched)
+    "queue_window_full": 0.0,    # windows closed by B-fill
+    "queue_window_expired": 0.0, # windows closed by T-expiry (or drain)
+    "queue_dispatched": 0.0,     # requests dispatched out of closed windows
+    "queue_packed_dispatches": 0.0,  # windows dispatched block-diagonally
+    "queue_budget_rejects": 0.0, # submits refused by a tenant's HBM budget
+    "controller_actuations": 0.0,  # SLA control-loop knob movements
+    "max_n_computes": 0.0,       # MemoryModel closed-form evaluations
+    #   (admission memo misses — a steady-state request stream must
+    #   compute each (op, nb, grid, dtype, budget) key exactly once)
 }
 
 _COUNTS: Dict[str, float] = dict(_ZEROS)
@@ -60,6 +72,12 @@ def serve_count(name: str, n: float = 1.0) -> None:
 
     if enabled():
         REGISTRY.counter_add(f"serve.{name}", n)
+
+
+def serve_counts() -> Dict[str, float]:
+    """Plain snapshot of the flat counters (no SLA merge) — what the
+    scheduler tests and the queue smoke diff across phases."""
+    return dict(_COUNTS)
 
 
 def serve_counter_values() -> Dict[str, float]:

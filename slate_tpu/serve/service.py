@@ -1,0 +1,252 @@
+"""The serving front door: ``python -m slate_tpu.serve.service``.
+
+Wires the ISSUE 19 pieces into one long-running process:
+
+- a **Router** (the PR 11 admission → class → cached-dispatch policy),
+- a **BatchQueue** in front of it (batch windows, per-tenant HBM
+  budgets, weighted-DRR dequeue — serve/queue.py), pumped by a worker
+  thread on the wall clock,
+- a **ServiceController** stepping the SLA control loop
+  (serve/controller.py) between pumps,
+- a stdlib-http front end: ``POST /solve`` submits one request (JSON
+  ``{"op", "a", "b", "tenant"}``) and blocks its connection thread on
+  the ticket — concurrent callers' requests coalesce into shared batch
+  windows, which is the entire point — plus ``GET /queue.json`` /
+  ``/healthz`` / ``/metrics`` delegating to the obs.live surface.
+
+Deliberately stdlib-only (``http.server``, like obs/live.py): the
+repo's serving story must not grow a web-framework dependency to be
+demonstrable.  A real deployment would put this behind a proper ASGI
+gateway; every piece below the HTTP skin (queue, ledger, controller)
+is transport-agnostic and is what such a gateway would drive.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+from typing import Dict, Optional
+
+from ..types import SlateError
+from .budget import BudgetLedger
+from .controller import ServiceController
+from .queue import BatchQueue
+from .router import Router
+
+
+class Service:
+    """Queue + worker + controller around one Router."""
+
+    def __init__(self, router: Optional[Router] = None, *,
+                 max_batch: int = 8, window_s: float = 0.005,
+                 budgets: Optional[Dict[str, int]] = None,
+                 weights: Optional[Dict[str, float]] = None,
+                 dispatch: str = "stacked",
+                 controller_every: int = 8,
+                 request_timeout_s: float = 60.0,
+                 name: str = "service", **controller_kw) -> None:
+        self.router = router if router is not None else Router()
+        self.queue = BatchQueue(
+            self.router, max_batch=max_batch, window_s=window_s,
+            ledger=BudgetLedger(budgets, weights), dispatch=dispatch,
+            name=name)
+        self.controller = ServiceController(self.queue, **controller_kw)
+        self.request_timeout_s = float(request_timeout_s)
+        self._controller_every = int(controller_every)
+        self._stop = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._worker is not None:
+            return
+        self._stop.clear()
+        self._worker = threading.Thread(
+            target=self._run, name="slate-serve-worker", daemon=True)
+        self._worker.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._worker is not None:
+            self._worker.join(timeout=5.0)
+            self._worker = None
+        self.queue.drain()
+        self.queue.close()
+
+    def _run(self) -> None:
+        ticks = 0
+        while not self._stop.is_set():
+            try:
+                self.queue.pump()
+            except SlateError:
+                # a failed window already settled its tickets/traces —
+                # the worker must outlive any one bad operand
+                pass
+            ticks += 1
+            if ticks % self._controller_every == 0:
+                self.controller.step()
+            # park for a fraction of the window so T-expiry is observed
+            # promptly without spinning
+            self._stop.wait(min(self.queue.window_s / 4.0, 0.002))
+
+    # -- request entry -----------------------------------------------------
+
+    def solve(self, op: str, a, b, tenant: Optional[str] = None):
+        """Submit one request and block until its window dispatched (the
+        per-connection path; concurrent callers share windows)."""
+        ticket = self.queue.submit(op, a, b, tenant=tenant)
+        return ticket.wait(timeout=self.request_timeout_s)
+
+
+# ---------------------------------------------------------------------------
+# the HTTP skin
+# ---------------------------------------------------------------------------
+
+
+def _make_handler(service: Service):
+    from http.server import BaseHTTPRequestHandler
+
+    import jax.numpy as jnp
+
+    from ..obs import live as _live
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def _send(self, code: int, ctype: str, body: bytes) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_json(self, code: int, doc: dict) -> None:
+            self._send(code, "application/json",
+                       json.dumps(doc, default=str).encode())
+
+        def do_GET(self):  # noqa: N802 (http.server API)
+            if self.path == "/queue.json":
+                self._send_json(200, _live.queue_snapshot())
+            elif self.path == "/healthz":
+                qs = _live.queue_snapshot()["queues"]
+                body = "ok\nqueues {} depth {} open_windows {}\n".format(
+                    len(qs),
+                    sum(s.get("depth", 0) for s in qs.values()),
+                    sum(s.get("open_windows", 0) for s in qs.values()))
+                self._send(200, "text/plain", body.encode())
+            elif self.path in ("/metrics", "/"):
+                self._send(200, "text/plain; version=0.0.4",
+                           _live.prometheus_text().encode())
+            else:
+                self._send(404, "text/plain", b"not found\n")
+
+        def do_POST(self):  # noqa: N802 (http.server API)
+            if self.path != "/solve":
+                self._send(404, "text/plain", b"not found\n")
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                doc = json.loads(self.rfile.read(length).decode())
+                op = doc["op"]
+                a = jnp.asarray(doc["a"], dtype=jnp.float64)
+                b = jnp.asarray(doc["b"], dtype=jnp.float64)
+                tenant = doc.get("tenant")
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._send_json(400, {"error": f"bad request: {e}"})
+                return
+            try:
+                x = service.solve(op, a, b, tenant=tenant)
+            except SlateError as e:
+                # budget refusals are the retry-later class; everything
+                # else in the SlateError taxonomy is the caller's operand
+                code = 429 if "budget" in str(e) else 422
+                self._send_json(code, {"error": str(e)})
+                return
+            except TimeoutError as e:
+                self._send_json(504, {"error": str(e)})
+                return
+            self._send_json(200, {"x": jnp.asarray(x).tolist(),
+                                  "tenant": tenant})
+
+    return Handler
+
+
+def start_http(service: Service, port: int = 0, host: str = "127.0.0.1"):
+    """Serve the front end on a daemon thread; returns ``(server,
+    thread, port)`` — the obs.live ``start_server`` contract."""
+    from http.server import ThreadingHTTPServer
+
+    srv = ThreadingHTTPServer((host, port), _make_handler(service))
+    srv.daemon_threads = True
+    th = threading.Thread(target=srv.serve_forever,
+                          name="slate-serve-http", daemon=True)
+    th.start()
+    return srv, th, srv.server_address[1]
+
+
+def _parse_kv(pairs, cast):
+    out = {}
+    for item in pairs or ():
+        name, _, val = item.partition("=")
+        if not name or not val:
+            raise SystemExit(f"expected TENANT=VALUE, got {item!r}")
+        out[name] = cast(val)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m slate_tpu.serve.service", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--port", type=int, default=9465,
+                    help="front-end port (default 9465; 0 = ephemeral)")
+    ap.add_argument("--max-batch", type=int, default=8,
+                    help="batch-window fill target B (default 8)")
+    ap.add_argument("--window-ms", type=float, default=5.0,
+                    help="batch-window deadline T in ms (default 5)")
+    ap.add_argument("--budget", action="append", metavar="TENANT=BYTES",
+                    help="per-tenant HBM budget (repeatable)")
+    ap.add_argument("--weight", action="append", metavar="TENANT=W",
+                    help="per-tenant DRR weight (repeatable)")
+    ap.add_argument("--dispatch", choices=("stacked", "packed"),
+                    default="stacked")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)  # f64 serving classes
+    from .. import obs
+    from ..obs import live as _live, span as _span
+
+    obs.enable()
+    _span.enable()
+    service = Service(
+        max_batch=args.max_batch, window_s=args.window_ms / 1000.0,
+        budgets=_parse_kv(args.budget, int),
+        weights=_parse_kv(args.weight, float),
+        dispatch=args.dispatch)
+    service.start()
+    srv, th, port = start_http(service, args.port)
+    print(f"slate_tpu.serve.service: POST /solve, GET /queue.json "
+          f"/healthz /metrics on http://127.0.0.1:{port} "
+          f"(B={args.max_batch}, T={args.window_ms}ms)", file=sys.stderr)
+    try:
+        th.join()
+    except KeyboardInterrupt:
+        srv.shutdown()
+        service.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    # run as ``__main__``, re-enter through the canonical import so the
+    # queue registry / bus keyed on real module names see ONE instance
+    # (the obs.live idiom)
+    from slate_tpu.serve import service as _canonical
+
+    sys.exit(_canonical.main())

@@ -54,6 +54,9 @@ TERMINALS = (
     "served_resume",         # Preempted -> resumed from its checkpoint
     "served_growth_retry",   # GrowthAbort -> one pivoted (pp) retry
     "reject_admission",      # over the HBM/bin admission bound
+    "reject_budget",         # over the submitting TENANT's HBM budget
+    #   (the batch-window queue's fair-share ledger, ISSUE 19 — the
+    #   global admission bound above is the whole-device twin)
     "reject_unresumable",    # preempted with no (or a re-killed) snapshot
     "reject_residual",       # resilient-path residual gate refused it
     "reject_batch_abort",    # a sibling/other-group failure aborted the

@@ -1,0 +1,393 @@
+"""Service-layer tests (ISSUE 19): the batch-window queue under an
+injectable ManualClock — B-fill vs T-expiry, FIFO within a tenant,
+weighted-DRR fairness and starvation freedom, per-tenant budget
+rejections, the exactly-one-terminal contract across mid-batch aborts,
+the Router.max_n memo, controller hysteresis, and the obs.live
+``/queue.json`` + ``/healthz`` scrape surface.
+
+Everything here is meshless (stacked single-chip programs, n = 16 in
+one bin) and clock-driven: no test sleeps on wall time to reach a
+window deadline, every close is a decision about numbers.
+"""
+
+import json
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from slate_tpu import obs
+from slate_tpu.obs import REGISTRY
+from slate_tpu.serve import metrics as serve_metrics
+from slate_tpu.serve import trace as rtrace
+from slate_tpu.serve.cache import ExecutableCache
+from slate_tpu.serve.controller import Hysteresis, ServiceController
+from slate_tpu.serve.queue import BatchQueue, ManualClock
+from slate_tpu.serve.router import Router
+from slate_tpu.types import SlateError
+
+N = 16
+BIN = 16
+
+
+@pytest.fixture
+def live_obs():
+    """Armed tracer + clean finished-trace stream (the queue opens a
+    RequestTrace per submit; these tests assert on terminal outcomes)."""
+    obs.reset()
+    rtrace.reset()
+    with obs.force_enabled():
+        yield
+    rtrace.reset()
+    obs.reset()
+
+
+def _spd(rng, n=N):
+    g = rng.standard_normal((n, n))
+    return jnp.asarray(g @ g.T / n + 2 * np.eye(n))
+
+
+def _counts():
+    return dict(serve_metrics.serve_counter_values())
+
+
+def _make_queue(name, **kw):
+    router = Router(bins=(BIN,), hbm_budget=1 << 30,
+                    cache=ExecutableCache())
+    clk = ManualClock()
+    q = BatchQueue(router, max_batch=kw.pop("max_batch", 4),
+                   window_s=kw.pop("window_s", 0.005), clock=clk,
+                   name=name, **kw)
+    return q, clk
+
+
+# ---------------------------------------------------------------------------
+# window closes: B-fill vs T-expiry
+# ---------------------------------------------------------------------------
+
+
+def test_b_fill_closes_before_deadline(rng, live_obs):
+    """The Bth compatible submit closes the window immediately — the
+    clock never advances, so the deadline CANNOT be the cause."""
+    q, _clk = _make_queue("t_bfill", max_batch=3)
+    try:
+        before = _counts()
+        tks = [q.submit("posv", _spd(rng),
+                        jnp.asarray(rng.standard_normal(N)))
+               for _ in range(3)]
+        assert all(tk.done() for tk in tks)
+        assert q.dispatch_log[-1]["cause"] == "full"
+        assert len(q.dispatch_log[-1]["tickets"]) == 3
+        after = _counts()
+        assert after["queue_window_full"] - before["queue_window_full"] == 1
+        assert after["queue_windows"] - before["queue_windows"] == 1
+        for tk in tks:
+            assert tk.trace.outcome == "served"
+    finally:
+        q.close()
+
+
+def test_t_expiry_closes_underfull_window(rng, live_obs):
+    """Below B, nothing dispatches until the injected clock crosses the
+    deadline; the close is then attributed to expiry, and each solution
+    is bitwise the one-at-a-time Router dispatch."""
+    q, clk = _make_queue("t_texp", max_batch=8, window_s=0.005)
+    try:
+        ops = [_spd(rng) for _ in range(2)]
+        rhs = [jnp.asarray(rng.standard_normal(N)) for _ in range(2)]
+        tks = [q.submit("posv", a, b) for a, b in zip(ops, rhs)]
+        assert q.pump() == 0          # t=0: deadline not reached
+        assert not tks[0].done()
+        with pytest.raises(SlateError):
+            tks[0].result()           # not dispatched yet
+        clk.advance(0.005)
+        assert q.pump() == 2
+        assert q.dispatch_log[-1]["cause"] == "expired"
+        ref = Router(bins=(BIN,), hbm_budget=1 << 30,
+                     cache=ExecutableCache())
+        for tk, a, b in zip(tks, ops, rhs):
+            np.testing.assert_array_equal(np.asarray(tk.result()),
+                                          np.asarray(ref.solve("posv", a, b)))
+    finally:
+        q.close()
+
+
+def test_ticket_wait_times_out(rng, live_obs):
+    q, _clk = _make_queue("t_wait", max_batch=8)
+    try:
+        tk = q.submit("posv", _spd(rng),
+                      jnp.asarray(rng.standard_normal(N)))
+        with pytest.raises(TimeoutError):
+            tk.wait(timeout=0.01)
+    finally:
+        q.close()
+
+
+# ---------------------------------------------------------------------------
+# dequeue order: FIFO within a tenant, weighted DRR across tenants
+# ---------------------------------------------------------------------------
+
+
+def test_fifo_within_tenant(rng, live_obs):
+    q, clk = _make_queue("t_fifo", max_batch=8)
+    try:
+        tks = [q.submit("posv", _spd(rng),
+                        jnp.asarray(rng.standard_normal(N)),
+                        tenant="solo")
+               for _ in range(5)]
+        clk.advance(0.01)
+        q.pump()
+        served = [seq for seq, _t in q.dispatch_log[-1]["tickets"]]
+        assert served == sorted(served)
+        assert served == [tk.seq for tk in tks]
+    finally:
+        q.close()
+
+
+def _drr_contended(rng, q, clk, per_tenant, k):
+    """Submit ``per_tenant`` requests for acme and zeta interleaved into
+    one oversubscribed window, then close it at ``max_batch=k``.
+    Returns the contended close's (seq, tenant) list and the leftover
+    close's."""
+    q.max_batch = 100  # no B-fill while loading the window
+    tks = []
+    for _ in range(per_tenant):
+        for tenant in ("acme", "zeta"):
+            tks.append(q.submit("posv", _spd(rng),
+                                jnp.asarray(rng.standard_normal(N)),
+                                tenant=tenant))
+    q.max_batch = k
+    clk.advance(0.01)
+    q.pump()          # contended close: DRR selects k of 2*per_tenant
+    clk.advance(0.01)
+    q.pump()          # the reopened leftover window expires
+    assert all(tk.done() for tk in tks)
+    return q.dispatch_log[-2]["tickets"], q.dispatch_log[-1]["tickets"]
+
+
+def test_drr_equal_weights_split_evenly(rng, live_obs):
+    q, clk = _make_queue("t_drr1", window_s=0.005)
+    try:
+        first, _rest = _drr_contended(rng, q, clk, per_tenant=4, k=4)
+        by_tenant = {"acme": 0, "zeta": 0}
+        for _seq, tenant in first:
+            by_tenant[tenant] += 1
+        assert by_tenant == {"acme": 2, "zeta": 2}
+    finally:
+        q.close()
+
+
+def test_drr_weighted_fairness_and_starvation_freedom(rng, live_obs):
+    """At weights 2:1 a contended close serves acme:zeta in ratio 2:1
+    (lag bounded by one max-weight round) and BOTH tenants appear — a
+    saturating acme cannot starve zeta.  FIFO holds per tenant across
+    the contended close and the leftover's."""
+    q, clk = _make_queue("t_drr2", window_s=0.005,
+                         weights={"acme": 2.0, "zeta": 1.0})
+    try:
+        first, rest = _drr_contended(rng, q, clk, per_tenant=6, k=8)
+        by_tenant = {"acme": [], "zeta": []}
+        for seq, tenant in first + rest:
+            by_tenant[tenant].append(seq)
+        n_first = {"acme": 0, "zeta": 0}
+        for _seq, tenant in first:
+            n_first[tenant] += 1
+        assert n_first["acme"] == 6 and n_first["zeta"] == 2
+        assert min(n_first.values()) > 0  # starvation freedom
+        for seqs in by_tenant.values():   # FIFO within each tenant
+            assert seqs == sorted(seqs)
+    finally:
+        q.close()
+
+
+# ---------------------------------------------------------------------------
+# per-tenant budgets
+# ---------------------------------------------------------------------------
+
+
+def test_budget_reject_is_terminal_and_isolated(rng, live_obs):
+    """A tenant over its declared budget is refused at SUBMIT with the
+    ``reject_budget`` terminal; an unrelated tenant is untouched, and
+    dispatch releases every reservation (peak never over budget)."""
+    from slate_tpu.serve.budget import request_cost
+
+    cost = request_cost(BIN, 8)
+    budget = int(2.5 * cost)          # room for exactly 2 reservations
+    q, clk = _make_queue("t_budget", max_batch=8,
+                         budgets={"hog": budget})
+    try:
+        before = _counts()
+        for _ in range(2):
+            q.submit("posv", _spd(rng),
+                     jnp.asarray(rng.standard_normal(N)), tenant="hog")
+        with pytest.raises(SlateError, match="budget"):
+            q.submit("posv", _spd(rng),
+                     jnp.asarray(rng.standard_normal(N)), tenant="hog")
+        after = _counts()
+        assert after["queue_budget_rejects"] \
+            - before["queue_budget_rejects"] == 1
+        rejected = [t for t in rtrace.finished_traces()
+                    if t.outcome == "reject_budget"]
+        assert len(rejected) == 1 and rejected[0].tenant == "hog"
+        # the calm tenant's default budget is unaffected by hog's state
+        q.submit("posv", _spd(rng),
+                 jnp.asarray(rng.standard_normal(N)), tenant="calm")
+        clk.advance(0.01)
+        q.pump()
+        snap = q.ledger.snapshot()
+        assert snap["hog"]["reserved_bytes"] == 0
+        assert 0 < snap["hog"]["peak_bytes"] <= budget
+    finally:
+        q.close()
+
+
+# ---------------------------------------------------------------------------
+# exactly one terminal per request, including mid-batch aborts
+# ---------------------------------------------------------------------------
+
+
+def test_mid_batch_abort_exactly_one_terminal(rng, live_obs):
+    """A non-SPD operand inside a posv window aborts the WHOLE dispatch:
+    the offender terminates ``failed_info``, every sibling
+    ``reject_batch_abort``, every ticket fails, and no trace carries a
+    second outcome (finish would raise if one did)."""
+    q, clk = _make_queue("t_abort", max_batch=8)
+    try:
+        good = [q.submit("posv", _spd(rng),
+                         jnp.asarray(rng.standard_normal(N)))
+                for _ in range(2)]
+        bad = q.submit("posv", jnp.asarray(-np.eye(N)),
+                       jnp.asarray(rng.standard_normal(N)))
+        clk.advance(0.01)
+        with pytest.raises(SlateError, match="info"):
+            q.pump()
+        assert bad.trace.outcome == "failed_info"
+        for tk in good:
+            assert tk.trace.outcome == "reject_batch_abort"
+        for tk in good + [bad]:
+            assert tk.state == "failed"
+            with pytest.raises(SlateError):
+                tk.result()
+        # reservations were released on the error path too
+        assert all(t["reserved_bytes"] == 0
+                   for t in q.ledger.snapshot().values())
+    finally:
+        q.close()
+
+
+# ---------------------------------------------------------------------------
+# Router.max_n memo (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def test_max_n_memoized_across_router_instances():
+    """The memory-model closed form evaluates ONCE per (op, nb, grid,
+    dtype, budget) key process-wide: a steady-state stream of admission
+    probes — across Router instances — hits the memo."""
+    budget = 876_543_219  # unique: the memo is process-global
+    before = _counts()["max_n_computes"]
+    for _ in range(2):
+        r = Router(bins=(BIN,), hbm_budget=budget, cache=ExecutableCache())
+        for _ in range(50):
+            r.admit("posv", N)
+    assert _counts()["max_n_computes"] - before == 1
+
+
+# ---------------------------------------------------------------------------
+# the control loop
+# ---------------------------------------------------------------------------
+
+
+def test_hysteresis_trips_once_and_releases():
+    h = Hysteresis(10.0, 2.0, arm=2, cooldown=2)
+    assert h.observe(11) is None      # arming
+    assert h.observe(12) == "trip"
+    assert h.observe(15) is None      # latched: no repeated actuation
+    assert h.observe(1) is None       # cooldown + arming
+    assert h.observe(1) == "release"
+    assert h.observe(1) is None       # already open
+
+
+def test_hysteresis_no_flap_on_square_wave():
+    h = Hysteresis(10.0, 2.0, arm=2, cooldown=1)
+    edges = [h.observe(v) for v in [11, 1, 11, 1, 11, 1, 11, 1]]
+    assert edges == [None] * 8        # streaks never arm
+
+
+def test_controller_shrinks_window_on_latency_breach(rng, live_obs):
+    """A seeded p95 spike on the PR 14 SLA surface trips the latency
+    latch after ``arm`` ticks — one shrink_window actuation, recorded
+    with its signals, and no flapping while the breach persists."""
+    q, _clk = _make_queue("t_ctrl", max_batch=4, window_s=0.004)
+    try:
+        ctrl = ServiceController(q, slo_p95_s=0.25, arm=2, cooldown=2,
+                                 failure_rate_hi=100.0,  # out of reach
+                                 failure_rate_lo=0.0)
+        for _ in range(20):
+            REGISTRY.observe("serve.latency_s", 2.0, op="posv",
+                             klass="friendly", outcome="served")
+        for _ in range(6):
+            ctrl.step()
+        assert [a["action"] for a in ctrl.actuations] == ["shrink_window"]
+        assert q.window_s == pytest.approx(0.002)
+        assert q.max_batch == 4       # latency guard moves T, not B
+        assert ctrl.actuations[0]["signals"]["p95_s"] >= 0.25
+    finally:
+        q.close()
+
+
+def test_tier_map_moves_window_class(rng, live_obs):
+    """The controller's precision-tier override changes the class every
+    subsequent submit windows (and dispatches) under."""
+    q, clk = _make_queue("t_tier", max_batch=8)
+    try:
+        a, b = _spd(rng), jnp.asarray(rng.standard_normal(N))
+        assert q.router.effective_class("posv", a) == "friendly"
+        q.router.tier_map = {"friendly": "hostile"}
+        assert q.router.effective_class("posv", a) == "hostile"
+        tk = q.submit("posv", a, b)
+        with q._lock:
+            (key,) = q._windows.keys()
+        assert key[1] == "hostile"
+        clk.advance(0.01)
+        q.pump()
+        assert tk.trace.outcome == "served"
+        assert tk.trace.klass == "hostile"
+    finally:
+        q.close()
+
+
+# ---------------------------------------------------------------------------
+# the live scrape surface (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+def test_queue_json_and_healthz(rng, live_obs):
+    """obs.live serves ``/queue.json`` (every live queue's stats) and a
+    queue-aware ``/healthz`` liveness line."""
+    from slate_tpu.obs import live
+
+    q, _clk = _make_queue("t_live", max_batch=8)
+    srv = None
+    try:
+        q.submit("posv", _spd(rng), jnp.asarray(rng.standard_normal(N)),
+                 tenant="acme")
+        srv, _th, port = live.start_server(port=0)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/queue.json", timeout=5) as r:
+            doc = json.loads(r.read())
+        stats = doc["queues"]["t_live"]
+        assert stats["depth"] == 1
+        assert stats["open_windows"] == 1
+        assert stats["max_batch"] == 8
+        assert stats["tenants"]["acme"]["reserved_bytes"] > 0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+            body = r.read().decode()
+        assert body.startswith("ok")
+        assert "queues" in body and "depth" in body
+    finally:
+        if srv is not None:
+            srv.shutdown()
+        q.close()

@@ -206,7 +206,11 @@ def test_scrape_endpoint_serves_validated_text(rng):
         assert page["events"] and page["last_seq"] > since
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
-            assert r.read().decode().strip() == "ok"
+            body = r.read().decode()
+        # queue-aware liveness since ISSUE 19: first line stays "ok",
+        # the second reports the service layer's live queues
+        assert body.splitlines()[0] == "ok"
+        assert "queues" in body and "open_windows" in body
     finally:
         srv.shutdown()
 
