@@ -79,7 +79,15 @@ class BudgetLedger:
             else memmodel.hbm_budget() * memmodel.HBM_SAFETY)
         self._default_weight = float(default_weight)
         self._declared_budgets = dict(budgets or {})
-        self._declared_weights = dict(weights or {})
+        self._declared_weights = {t: float(v)
+                                  for t, v in (weights or {}).items()}
+        for t, v in [("<default>", self._default_weight),
+                     *self._declared_weights.items()]:
+            if not v > 0.0:   # also catches NaN
+                raise ValueError(
+                    f"budget: DRR weight for tenant {t!r} must be > 0, "
+                    f"got {v!r} — a non-positive weight never accrues "
+                    "deficit and would stall the dequeue rotation")
         self._accounts: Dict[str, TenantAccount] = {}
         self._lock = threading.Lock()
 

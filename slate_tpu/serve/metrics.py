@@ -53,6 +53,8 @@ _ZEROS: Dict[str, float] = {
     "queue_dispatched": 0.0,     # requests dispatched out of closed windows
     "queue_packed_dispatches": 0.0,  # windows dispatched block-diagonally
     "queue_budget_rejects": 0.0, # submits refused by a tenant's HBM budget
+    "queue_pump_errors": 0.0,    # non-settling exceptions the service
+    #   worker survived (anything past the SlateError batch-abort path)
     "controller_actuations": 0.0,  # SLA control-loop knob movements
     "max_n_computes": 0.0,       # MemoryModel closed-form evaluations
     #   (admission memo misses — a steady-state request stream must

@@ -42,6 +42,7 @@ prove they are byte-identical to the service-off Router dispatch.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import OrderedDict, deque
@@ -88,7 +89,10 @@ class MonotonicClock:
 # tickets and windows
 # ---------------------------------------------------------------------------
 
-_TICKET_SEQ = 0
+# process-wide ticket numbering: next() on a count() is atomic under
+# the GIL, so concurrent submits on DIFFERENT queues (each under its
+# own instance lock) still get unique seqs
+_TICKET_SEQ = itertools.count(1)
 
 
 class Ticket:
@@ -212,23 +216,42 @@ class BatchQueue:
 
     # -- admission ---------------------------------------------------------
 
+    def _reject_admission(self, op, n, dtype, tenant, msg):
+        """Terminal ``reject_admission`` at submit: count, open-and-
+        finish the request's trace, raise."""
+        serve_count("admission_rejects")
+        tr = rtrace.new_trace(op, n, self.router.nb, dtype, tenant=tenant)
+        rtrace.finish(tr, "reject_admission")
+        raise SlateError(msg)
+
     def submit(self, op: str, a, b, tenant: Optional[str] = None) -> Ticket:
         """Admit one request into its batch window.  Raises SlateError
         (terminal ``reject_admission`` / ``reject_budget`` on the
-        request's trace) when the request exceeds the bin vocabulary or
-        its tenant's HBM budget; otherwise returns a Ticket that
-        resolves when the window dispatches."""
-        global _TICKET_SEQ
-        n = a.shape[0]
+        request's trace) when the request is malformed (non-square
+        operand, rhs row count mismatch), exceeds the bin vocabulary,
+        or is over its tenant's HBM budget; otherwise returns a Ticket
+        that resolves when the window dispatches.  Shape validation
+        lives HERE, at admission, because a malformed request that
+        entered a shared window would abort every co-batched sibling
+        (and, unguarded, the pump worker) at stack/pad time."""
         dtype = str(a.dtype)
         tenant_key = tenant if tenant is not None else _DEFAULT_TENANT
+        shape_a = tuple(getattr(a, "shape", ()))
+        if a.ndim != 2 or shape_a[0] != shape_a[1]:
+            self._reject_admission(
+                op, int(shape_a[0]) if shape_a else 0, dtype, tenant,
+                f"queue: operand must be a square matrix, got shape "
+                f"{shape_a}")
+        n = a.shape[0]
+        if b.ndim not in (1, 2) or b.shape[0] != n:
+            self._reject_admission(
+                op, n, dtype, tenant,
+                f"queue: rhs shape {tuple(b.shape)} incompatible with "
+                f"operand n={n} (want ({n},) or ({n}, nrhs))")
         m = bin_for(n, self.router.bins)
         if m is None:
-            serve_count("admission_rejects")
-            tr = rtrace.new_trace(op, n, self.router.nb, dtype,
-                                  tenant=tenant)
-            rtrace.finish(tr, "reject_admission")
-            raise SlateError(
+            self._reject_admission(
+                op, n, dtype, tenant,
                 f"queue: n={n} exceeds the largest serving bin "
                 f"{self.router.bins[-1]}")
         cost = request_cost(m, a.dtype.itemsize)
@@ -253,9 +276,8 @@ class BatchQueue:
         key = (op, klass, m, nrhs, dtype)
         now = self.clock.now()
         with self._lock:
-            _TICKET_SEQ += 1
-            tk = Ticket(_TICKET_SEQ, op, n, m, nrhs, tenant, tenant_key,
-                        cost, tr, now)
+            tk = Ticket(next(_TICKET_SEQ), op, n, m, nrhs, tenant,
+                        tenant_key, cost, tr, now)
             w = self._windows.get(key)
             if w is None:
                 w = self._windows[key] = _Window(
@@ -343,10 +365,17 @@ class BatchQueue:
         whole requests (cost 1) while deficit lasts — so within one
         round every tenant with weight >= 1 is served, and a tenant's
         service lag is bounded by one max-weight round.  Deficit resets
-        when a tenant's sub-queue empties (no banking across idle
-        periods); FIFO holds within a tenant by construction."""
+        only once a tenant has drained from EVERY open window (credit
+        accrued here is not forfeited by a sibling window's close) and
+        never banks across idle periods; FIFO holds within a tenant by
+        construction.  A full rotation that serves nothing (possible
+        only if the ledger yields degenerate weights at runtime —
+        construction validates > 0) force-serves the head-of-line
+        tenant into deficit debt, so selection always terminates
+        instead of spinning the dispatching thread."""
         selected: List[tuple] = []
         while len(selected) < k and w.entries:
+            progressed = False
             for tenant_key in list(w.entries.keys()):
                 if len(selected) >= k:
                     break
@@ -358,14 +387,33 @@ class BatchQueue:
                     + self.ledger.weight(tenant_key))
                 while (self._deficit[tenant_key] >= 1.0 and q
                        and len(selected) < k):
-                    entry = q.popleft()
-                    w.count -= 1
-                    selected.append(entry)
-                    self._deficit[tenant_key] -= 1.0
+                    self._take(w, tenant_key, q, selected)
+                    progressed = True
                 if not q:
-                    del w.entries[tenant_key]
-                    self._deficit[tenant_key] = 0.0
+                    self._drop_subqueue(w, tenant_key)
+            if not progressed and w.entries and len(selected) < k:
+                tenant_key = next(iter(w.entries))
+                q = w.entries[tenant_key]
+                self._take(w, tenant_key, q, selected)
+                if not q:
+                    self._drop_subqueue(w, tenant_key)
         return selected
+
+    def _take(self, w: _Window, tenant_key: str, q, selected) -> None:
+        selected.append(q.popleft())
+        w.count -= 1
+        self._deficit[tenant_key] = (
+            self._deficit.get(tenant_key, 0.0) - 1.0)
+
+    def _drop_subqueue(self, w: _Window, tenant_key: str) -> None:
+        """The tenant's sub-queue in this window drained; forget its
+        deficit only if no OTHER open window still holds its entries
+        (``_drr_select`` runs under the lock with the closing window
+        already popped from ``_windows``)."""
+        del w.entries[tenant_key]
+        if not any(w2.entries.get(tenant_key)
+                   for w2 in self._windows.values()):
+            self._deficit[tenant_key] = 0.0
 
     # -- dispatch ----------------------------------------------------------
 
@@ -523,8 +571,12 @@ def _key_str(key) -> str:
 
 def queue_stats() -> dict:
     """Every live queue's stats, keyed by queue name — the obs.live
-    ``/queue.json`` body (and the ``/healthz`` liveness line)."""
-    return {"queues": {name: q.stats() for name, q in _ACTIVE.items()}}
+    ``/queue.json`` body (and the ``/healthz`` liveness line).  The
+    scrape runs on its own thread while queues open/close; snapshot the
+    registry so a concurrent ``BatchQueue.__init__``/``close`` cannot
+    resize the dict mid-iteration."""
+    return {"queues": {name: q.stats()
+                       for name, q in list(_ACTIVE.items())}}
 
 
 # ---------------------------------------------------------------------------

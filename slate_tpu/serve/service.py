@@ -27,11 +27,13 @@ import argparse
 import json
 import sys
 import threading
+import traceback
 from typing import Dict, Optional
 
 from ..types import SlateError
 from .budget import BudgetLedger
 from .controller import ServiceController
+from .metrics import serve_count
 from .queue import BatchQueue
 from .router import Router
 
@@ -85,9 +87,20 @@ class Service:
                 # a failed window already settled its tickets/traces —
                 # the worker must outlive any one bad operand
                 pass
+            except Exception:
+                # ANY other escape (a malformed operand that slipped
+                # admission, a backend error) must not kill the worker:
+                # a dead pump hangs every queued and future request
+                # until its ticket timeout — a one-request DoS
+                serve_count("queue_pump_errors")
+                traceback.print_exc(file=sys.stderr)
             ticks += 1
             if ticks % self._controller_every == 0:
-                self.controller.step()
+                try:
+                    self.controller.step()
+                except Exception:
+                    serve_count("queue_pump_errors")
+                    traceback.print_exc(file=sys.stderr)
             # park for a fraction of the window so T-expiry is observed
             # promptly without spinning
             self._stop.wait(min(self.queue.window_s / 4.0, 0.002))
@@ -225,11 +238,14 @@ def main(argv=None) -> int:
 
     obs.enable()
     _span.enable()
-    service = Service(
-        max_batch=args.max_batch, window_s=args.window_ms / 1000.0,
-        budgets=_parse_kv(args.budget, int),
-        weights=_parse_kv(args.weight, float),
-        dispatch=args.dispatch)
+    try:
+        service = Service(
+            max_batch=args.max_batch, window_s=args.window_ms / 1000.0,
+            budgets=_parse_kv(args.budget, int),
+            weights=_parse_kv(args.weight, float),
+            dispatch=args.dispatch)
+    except ValueError as e:   # e.g. --weight t=0
+        raise SystemExit(str(e))
     service.start()
     srv, th, port = start_http(service, args.port)
     print(f"slate_tpu.serve.service: POST /solve, GET /queue.json "

@@ -243,6 +243,139 @@ def test_budget_reject_is_terminal_and_isolated(rng, live_obs):
 
 
 # ---------------------------------------------------------------------------
+# admission hardening (REVIEW 19): malformed shapes, degenerate weights
+# ---------------------------------------------------------------------------
+
+
+def test_malformed_shapes_rejected_at_submit(rng, live_obs):
+    """A non-square operand or a mismatched rhs is refused at SUBMIT as
+    ``reject_admission`` — it never enters a window, so it cannot abort
+    a shared batch (or, unguarded, kill the pump worker) at stack/pad
+    time, and a well-formed request sharing the queue still serves."""
+    q, clk = _make_queue("t_malformed", max_batch=4)
+    try:
+        with pytest.raises(SlateError, match="square"):
+            q.submit("posv", jnp.zeros((N, N - 2)), jnp.zeros((N,)))
+        with pytest.raises(SlateError, match="rhs"):
+            q.submit("posv", _spd(rng), jnp.zeros((N + 4,)))
+        with pytest.raises(SlateError, match="rhs"):
+            q.submit("posv", _spd(rng), jnp.zeros((N, 2, 2)))
+        assert q.depth() == 0
+        outcomes = [t.outcome for t in rtrace.finished_traces()]
+        assert outcomes.count("reject_admission") == 3
+        assert all(t["reserved_bytes"] == 0
+                   for t in q.ledger.snapshot().values())
+        tk = q.submit("posv", _spd(rng),
+                      jnp.asarray(rng.standard_normal(N)))
+        clk.advance(0.01)
+        q.pump()
+        assert tk.trace.outcome == "served"
+    finally:
+        q.close()
+
+
+def test_nonpositive_weight_rejected_at_construction():
+    """``--weight t=0`` (or negative/NaN) must fail fast: a tenant whose
+    deficit can never reach 1.0 would hard-hang the DRR rotation."""
+    from slate_tpu.serve.budget import BudgetLedger
+
+    for bad in (0.0, -1.0, float("nan")):
+        with pytest.raises(ValueError, match="weight"):
+            BudgetLedger(weights={"t": bad})
+    with pytest.raises(ValueError, match="weight"):
+        BudgetLedger(default_weight=0.0)
+
+
+def test_drr_progresses_under_degenerate_runtime_weight(rng, live_obs):
+    """Construction validates weights > 0, but a ledger subclass could
+    still hand back 0 at dequeue time — selection must force-serve the
+    head-of-line tenant instead of spinning the dispatching thread."""
+    q, clk = _make_queue("t_degen", max_batch=8)
+    try:
+        tks = [q.submit("posv", _spd(rng),
+                        jnp.asarray(rng.standard_normal(N)),
+                        tenant="stuck")
+               for _ in range(3)]
+        q.ledger.account("stuck").weight = 0.0   # simulate a bad ledger
+        clk.advance(0.01)
+        assert q.pump() == 3
+        assert all(tk.trace.outcome == "served" for tk in tks)
+    finally:
+        q.close()
+
+
+def test_deficit_preserved_across_windows(rng, live_obs):
+    """Accrued DRR credit survives one window's close while the tenant
+    still has entries pending in ANOTHER open window — deficit resets
+    only on a full drain, so the one-round service-lag bound holds
+    queue-wide, not per window."""
+    q, _clk = _make_queue("t_deficit", max_batch=8,
+                          weights={"acme": 1.7})
+    try:
+        b1 = jnp.asarray(rng.standard_normal(N))       # nrhs=1 window
+        b2 = jnp.asarray(rng.standard_normal((N, 2)))  # nrhs=2 window
+        q.submit("posv", _spd(rng), b1, tenant="acme")
+        tk2 = q.submit("posv", _spd(rng), b2, tenant="acme")
+        with q._lock:
+            k1, k2 = list(q._windows)
+        q._close_key(k1, "expired")
+        # +1.7 granted, 1 served: the 0.7 credit is KEPT (k2 pending)
+        assert q._deficit["acme"] == pytest.approx(0.7)
+        q._close_key(k2, "expired")
+        assert q._deficit["acme"] == 0.0   # fully drained: reset
+        assert tk2.trace.outcome == "served"
+    finally:
+        q.close()
+
+
+def test_ticket_seqs_unique_across_queues(rng, live_obs):
+    """Ticket numbering is process-wide and atomic: two queues never
+    issue the same seq (dispatch logs / FIFO assertions key on it)."""
+    qa, _ca = _make_queue("t_seq_a", max_batch=8)
+    qb, _cb = _make_queue("t_seq_b", max_batch=8)
+    try:
+        seqs = [q.submit("posv", _spd(rng),
+                         jnp.asarray(rng.standard_normal(N))).seq
+                for q in (qa, qb, qa, qb)]
+        assert len(set(seqs)) == 4
+        qa.drain()
+        qb.drain()
+    finally:
+        qa.close()
+        qb.close()
+
+
+def test_worker_survives_pump_exception(rng, live_obs):
+    """A non-SlateError escaping pump() (the REVIEW 19 DoS: one bad
+    dispatch) must not kill the service worker — the next pump still
+    runs and subsequent requests still serve."""
+    from slate_tpu.serve.service import Service
+
+    router = Router(bins=(BIN,), hbm_budget=1 << 30,
+                    cache=ExecutableCache())
+    svc = Service(router=router, max_batch=2, window_s=0.001,
+                  name="t_svc_survive")
+    orig_pump = svc.queue.pump
+    state = {"boomed": False}
+
+    def flaky_pump():
+        if not state["boomed"]:
+            state["boomed"] = True
+            raise ValueError("boom")
+        return orig_pump()
+
+    svc.queue.pump = flaky_pump
+    svc.start()
+    try:
+        x = svc.solve("posv", _spd(rng),
+                      jnp.asarray(rng.standard_normal(N)))
+        assert np.asarray(x).shape == (N,)
+        assert state["boomed"]
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
 # exactly one terminal per request, including mid-batch aborts
 # ---------------------------------------------------------------------------
 
