@@ -238,6 +238,113 @@ def bench_panel_getrf(impl):
     return flops / t / 1e9
 
 
+# ---------------------------------------------------------------------------
+# trailing-update microbenches (PR 20): the fused one-dispatch Pallas
+# trailing-update kernels vs the XLA einsum bulk forms, at a mesh
+# kernel's local trailing shape (an 8 x 8 local tile grid of nb = 256
+# tiles — one device's share of a step's trailing update).  The panel
+# benches above isolate the panel phase's dispatch latency; these
+# isolate the OTHER side of every k-step — the grid-wide consume — where
+# the fused kernel keeps the broadcast panels VMEM-resident across the
+# whole tile stack instead of re-streaming them per XLA fusion.
+# ---------------------------------------------------------------------------
+
+MTL_UPD = NTL_UPD = 8
+NB_UPD = 256
+
+
+def _update_operands(masked):
+    rng = np.random.default_rng(9)
+    acc = rng.standard_normal(
+        (MTL_UPD, NTL_UPD, NB_UPD, NB_UPD)).astype(np.float32)
+    pan = rng.standard_normal((MTL_UPD, NB_UPD, NB_UPD)).astype(np.float32)
+    urow = rng.standard_normal((NTL_UPD, NB_UPD, NB_UPD)).astype(np.float32)
+    mask = (np.arange(MTL_UPD)[:, None] >= np.arange(NTL_UPD)[None, :]
+            if masked else np.ones((MTL_UPD, NTL_UPD), bool))
+    return (jnp.asarray(acc), jnp.asarray(pan), jnp.asarray(urow),
+            jnp.asarray(mask))
+
+
+def bench_update_summa(impl):
+    """One SUMMA stationary-C consume over the local tile grid: xla =
+    today's einsum + add; pallas = the fused one-dispatch grid kernel
+    (summa_update_pallas, panels broadcast in VMEM)."""
+    from slate_tpu.ops.pallas_ops import summa_update_pallas
+
+    acc, pan, urow, _ = _update_operands(masked=False)
+    if impl == "pallas":
+
+        @jax.jit
+        def run(acc, p, u):
+            out = summa_update_pallas(acc, p, u)
+            return jnp.sum(out[:, :, :1, :1])
+
+    else:
+
+        @jax.jit
+        def run(acc, p, u):
+            upd = jnp.einsum("iab,jbc->ijac", p, u,
+                             precision=jax.lax.Precision.HIGHEST)
+            return jnp.sum((acc + upd.astype(acc.dtype))[:, :, :1, :1])
+
+    t = _timeit(run, acc, pan, urow)
+    return 2.0 * MTL_UPD * NTL_UPD * NB_UPD**3 / t / 1e9
+
+
+def bench_update_potrf(impl):
+    """One potrf trailing herk (lower-masked rank-nb update of the local
+    trailing stack) — dist_chol._chol_bulk's two lowerings."""
+    from slate_tpu.ops.pallas_ops import chol_trailing_update_pallas
+
+    view, pan, _, mask = _update_operands(masked=True)
+    pan_t = pan  # the mesh kernel broadcasts the panel twice (row + col)
+    if impl == "pallas":
+
+        @jax.jit
+        def run(v, p, pt, m):
+            out = chol_trailing_update_pallas(v, p, pt, m)
+            return jnp.sum(out[:, :, :1, :1])
+
+    else:
+
+        @jax.jit
+        def run(v, p, pt, m):
+            upd = jnp.einsum("iab,jcb->ijac", p, pt,
+                             precision=jax.lax.Precision.HIGHEST
+                             ).astype(v.dtype)
+            out = v - jnp.where(m[:, :, None, None], upd, 0)
+            return jnp.sum(out[:, :, :1, :1])
+
+    t = _timeit(run, view, pan, pan_t, mask)
+    flops = 2.0 * int(mask.sum()) * NB_UPD**3
+    return flops / t / 1e9
+
+
+def bench_update_getrf(impl):
+    """One LU trailing gemm (full local stack, the strict-schedule
+    _nopiv_bulk) — einsum + subtract vs the fused kernel."""
+    from slate_tpu.ops.pallas_ops import lu_trailing_update_pallas
+
+    t_loc, pan, urow, mask = _update_operands(masked=False)
+    if impl == "pallas":
+
+        @jax.jit
+        def run(t, p, u, m):
+            out = lu_trailing_update_pallas(t, p, u, m)
+            return jnp.sum(out[:, :, :1, :1])
+
+    else:
+
+        @jax.jit
+        def run(t, p, u, m):
+            upd = jnp.einsum("iab,jbc->ijac", p, u,
+                             precision=jax.lax.Precision.HIGHEST)
+            return jnp.sum((t - upd.astype(t.dtype))[:, :, :1, :1])
+
+    t = _timeit(run, t_loc, pan, urow, mask)
+    return 2.0 * MTL_UPD * NTL_UPD * NB_UPD**3 / t / 1e9
+
+
 def bench_panel_qr(impl):
     """One tall-skinny Householder panel (m = 16384, w = 64) WITH the
     compact-WY T accumulation — the CAQR / two-stage building block."""
@@ -576,6 +683,14 @@ def main():
         ("panel_getrf_pallas_gflops", lambda: bench_panel_getrf("pallas")),
         ("panel_qr_xla_gflops", lambda: bench_panel_qr("xla")),
         ("panel_qr_pallas_gflops", lambda: bench_panel_qr("pallas")),
+        # fused trailing-update story (PR 20): the k-step's OTHER side —
+        # the grid-wide consume — under both Option.UpdateImpl lowerings
+        ("update_summa_xla_gflops", lambda: bench_update_summa("xla")),
+        ("update_summa_pallas_gflops", lambda: bench_update_summa("pallas")),
+        ("update_potrf_xla_gflops", lambda: bench_update_potrf("xla")),
+        ("update_potrf_pallas_gflops", lambda: bench_update_potrf("pallas")),
+        ("update_getrf_xla_gflops", lambda: bench_update_getrf("xla")),
+        ("update_getrf_pallas_gflops", lambda: bench_update_getrf("pallas")),
         ("potrf_f32_gflops", bench_potrf),
         ("getrf_f32_gflops", bench_getrf),
         ("gemm_f64_emulated_gflops", bench_gemm_f64_emulated),
@@ -612,6 +727,11 @@ def main():
         pp = extras.get(f"panel_{kind}_pallas_gflops")
         if isinstance(px, float) and isinstance(pp, float) and px > 0:
             extras[f"panel_{kind}_pallas_speedup"] = round(pp / px, 2)
+    for kind in ("summa", "potrf", "getrf"):
+        ux = extras.get(f"update_{kind}_xla_gflops")
+        up = extras.get(f"update_{kind}_pallas_gflops")
+        if isinstance(ux, float) and isinstance(up, float) and ux > 0:
+            extras[f"update_{kind}_pallas_speedup"] = round(up / ux, 2)
     for kind in ("gesv", "posv"):
         mx = extras.get(f"{kind}_mixed_gflops")
         fx = extras.get(f"{kind}_f64_direct_gflops")

@@ -332,6 +332,46 @@ python -m slate_tpu.obs.report --check \
     artifacts/obs/panel_pallas.report.json artifacts/obs/panel_xla.report.json \
     --threshold "$PANEL_PARITY_THRESHOLD"
 
+# fused trailing-update cross-impl pass (PR 20): re-run the smokes under
+# the explicit Pallas trailing-update lowering — on this CPU harness the
+# one-kernel fused updates (SUMMA stationary-C consume, potrf trailing
+# herk, LU-nopiv trailing gemm) run under the Pallas interpreter, so
+# Option.UpdateImpl=pallas is exercised end-to-end on every commit.  The
+# default runs above cover auto -> xla (bitwise today's update loops),
+# and the contracts runs at the top already prove BOTH lowerings of
+# every *_upd_* matrix cell — the xla-side cells are jaxpr-identity
+# proofs against the default trace, the pallas-side cells are
+# bytes_invariant proofs against their xla twins, each under psum AND
+# ring.  (No contracts re-run under this env: the off-pole cells
+# compare pinned-xla against the ambient default, which the env itself
+# would move.)  The flight re-run gates the byte surface: the fused
+# update sits strictly inside the compute half of each k-step, so the
+# modeled/measured bytes must equal the committed default-lowering
+# references exactly (wall-clock keys and overlap_eff stay
+# machine-dependent and --ignore'd, as above).
+SLATE_TPU_UPDATE_IMPL=pallas python -m slate_tpu.obs.smoke --out artifacts/obs_upd
+SLATE_TPU_UPDATE_IMPL=pallas python -m slate_tpu.ft.smoke --out artifacts/ft_upd
+SLATE_TPU_UPDATE_IMPL=pallas python -m slate_tpu.obs.flight --smoke \
+    --out artifacts/obs_flight_upd
+for op in summa potrf; do
+  python -m slate_tpu.obs.report --check \
+      "artifacts/obs_flight_upd/flight_${op}.flight.json" \
+      "artifacts/obs/flight_${op}.flight.json" --threshold 4 \
+      --ignore 'sched.*_s' --ignore 'sched.overlap_eff'
+done
+
+# fused-update parity artifact: regenerate the fused trailing-update vs
+# XLA-reference RunReports and gate the parity — the update kernels
+# replicate the XLA op sequence exactly (contraction at HIGHEST →
+# astype → select → add), so the tool requires BITWISE equality under
+# the interpreter, a stronger contract than the panel threshold class.
+# The obs.report --check pass re-validates the committed artifact pair
+# through the standard CLI.
+python tools/update_report.py --out artifacts/obs
+python -m slate_tpu.obs.report --check \
+    artifacts/obs/update_pallas.report.json artifacts/obs/update_xla.report.json \
+    --threshold 3
+
 # mixed-precision solve smoke (ISSUE 8): the default f64 gesv/posv route
 # through the Option.MixedPrecision=auto ladder (f32 mesh factor + fused
 # on-device refinement, GMRES-IR escalation, full-f64 fallback).  The

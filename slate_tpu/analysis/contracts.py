@@ -66,8 +66,8 @@ from .findings import Finding  # noqa: E402
 # the Router's own programs — service-off is byte-identical dispatch).
 CONTRACT_OPTIONS = (
     Option.Checkpoint, Option.NumMonitor, Option.FaultTolerance,
-    Option.Lookahead, Option.PanelImpl, Option.BcastImpl, "obs",
-    "serve_queue",
+    Option.Lookahead, Option.PanelImpl, Option.BcastImpl,
+    Option.UpdateImpl, "obs", "serve_queue",
 )
 
 # naming-convention rules: (predicate kind, token, option, scope).
@@ -88,6 +88,10 @@ NAMING_RULES: Tuple[Tuple[str, str, object, str], ...] = (
     # 19): the queue is host-side scheduling, so each must prove its
     # program equals the direct Router/packed driver's
     ("suffix", "_queue", "serve_queue", "entry"),
+    # *_upd_* entries pin an Option.UpdateImpl lowering (PR 20): each
+    # must prove its cell — xla trace-identical to the base, pallas
+    # bytes-invariant against its xla twin
+    ("infix", "_upd", Option.UpdateImpl, "entry"),
 )
 
 
@@ -159,6 +163,10 @@ def _off_context(option):
         from ..ops.pallas_ops import use_panel_impl
 
         return use_panel_impl("xla")
+    if option is Option.UpdateImpl:
+        from ..ops.pallas_ops import use_update_impl
+
+        return use_update_impl("xla")
     raise KeyError(
         f"no off-forcing context for {_opt_name(option)}; declare the "
         "contract with an explicit base entry instead"

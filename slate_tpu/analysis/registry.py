@@ -588,6 +588,14 @@ def _potrf_ring(ctx):
     return _with_impl("ring", potrf_dist), (a,)
 
 
+@register("getrf_nopiv_dist_psum", tags=("bcast",))
+def _getrf_nopiv_psum(ctx):
+    from ..parallel.dist_lu import getrf_nopiv_dist
+
+    a = ctx.dist(kind="tril", diag_pad=True)
+    return _with_impl("psum", getrf_nopiv_dist), (a,)
+
+
 @register("getrf_nopiv_dist_ring", tags=("bcast",), contracts=(
     Contract(Option.BcastImpl, "bytes_invariant", "getrf_nopiv_dist"),
 ))
@@ -900,6 +908,125 @@ def _ft_gemm_pallas(ctx):
 ))
 def _ft_potrf_pallas(ctx):
     return _ft_factor_build(ctx, "potrf", armed=False, panel_impl="pallas")
+
+
+@register("getrf_tntpiv_panel_pallas", tags=("panel",), contracts=(
+    Contract(Option.PanelImpl, "bytes_invariant", "getrf_tntpiv_dist"),
+))
+def _getrf_tnt_pallas(ctx):
+    """CALU with the post-pivot panel factor/solve fused (the tournament
+    pivot search itself has no Pallas dispatch site — PR 20)."""
+    from ..parallel.dist_lu import getrf_tntpiv_dist
+
+    a = ctx.dist(diag_pad=True)
+    return (lambda x: getrf_tntpiv_dist(x, panel_impl="pallas")), (a,)
+
+
+@register("getrf_pp_panel_pallas", tags=("panel",), contracts=(
+    Contract(Option.PanelImpl, "bytes_invariant", "getrf_pp_dist"),
+))
+def _getrf_pp_pallas(ctx):
+    """Partial-pivot LU with the panel-row solve fused (the in-loop
+    column factor IS the pivot search, so only the row solve dispatches
+    — PR 20)."""
+    from ..parallel.dist_lu import getrf_pp_dist
+
+    a = ctx.dist(diag_pad=True)
+    return (lambda x: getrf_pp_dist(x, panel_impl="pallas")), (a,)
+
+
+@register("geqrf_dist_panel_pallas", tags=("panel",), contracts=(
+    Contract(Option.PanelImpl, "bytes_invariant", "geqrf_dist"),
+))
+def _geqrf_pallas(ctx):
+    """CAQR with the offset panel factor + larft fused (PR 20: the
+    formerly-pinned dist_qr panels now dispatch by Option.PanelImpl)."""
+    from ..parallel.dist_qr import geqrf_dist
+
+    a = ctx.dist()
+    return (lambda x: geqrf_dist(x, panel_impl="pallas")), (a,)
+
+
+# ---------------------------------------------------------------------------
+# fused trailing-update variants (PR 20): the Option.UpdateImpl lowerings
+# under the gate for the three ops the option scopes (SUMMA consume,
+# potrf trailing herk-gemm, LU-nopiv trailing gemm).  Per op and per
+# broadcast engine (psum AND ring) the ``*_upd_xla`` entry proves the
+# explicit xla pole is trace-IDENTICAL to the base entry's default chain
+# (auto resolves to xla on the CPU trace mesh), and the ``*_upd_pallas``
+# entry proves the fused one-dispatch kernel moves exactly the bytes of
+# its xla twin (the ScheduleModel/comm-audit invariance the option
+# promises by construction).
+# ---------------------------------------------------------------------------
+
+
+def _upd_entry(call, impl, bcast):
+    from ..parallel.comm import use_bcast_impl
+    from ..ops.pallas_ops import use_update_impl
+
+    def fn(*args):
+        with use_bcast_impl(bcast), use_update_impl(impl):
+            return call(*args)
+
+    return fn
+
+
+def _register_upd_cells(stem, base_psum, base_ring, build):
+    """One psum + one ring (xla off-identity, pallas bytes-invariant)
+    quadruple for a driver under Option.UpdateImpl."""
+    for bcast, base in (("psum", base_psum), ("ring", base_ring)):
+        sfx = "" if bcast == "psum" else "_ring"
+        xla_name = f"{stem}_upd_xla{sfx}"
+
+        def _mk(impl, bcast=bcast):
+            def _build(ctx, impl=impl, bcast=bcast):
+                call, args = build(ctx)
+                return _upd_entry(call, impl, bcast), args
+
+            return _build
+
+        register(xla_name, tags=("update",), contracts=(
+            Contract(Option.UpdateImpl, "off_jaxpr_identical", base),
+        ))(_mk("xla"))
+        register(f"{stem}_upd_pallas{sfx}", tags=("update",), contracts=(
+            Contract(Option.UpdateImpl, "bytes_invariant", xla_name),
+        ))(_mk("pallas"))
+
+
+def _upd_gemm_build(ctx):
+    from ..parallel.summa import gemm_summa
+    from ..types import MethodGemm
+
+    a, b = ctx.dist(), ctx.dist()
+    return (
+        lambda x, y: gemm_summa(1.0, x, y, method=MethodGemm.GemmC)
+    ), (a, b)
+
+
+def _upd_potrf_build(ctx):
+    from ..parallel.dist_chol import potrf_dist
+
+    a = ctx.dist(kind="spd", diag_pad=True)
+    return potrf_dist, (a,)
+
+
+def _upd_getrf_build(ctx):
+    from ..parallel.dist_lu import getrf_nopiv_dist
+
+    a = ctx.dist(kind="tril", diag_pad=True)
+    return getrf_nopiv_dist, (a,)
+
+
+_register_upd_cells(
+    "gemm_summa", "gemm_summa_psum", "gemm_summa_ring", _upd_gemm_build
+)
+_register_upd_cells(
+    "potrf_dist", "potrf_dist_psum", "potrf_dist_ring", _upd_potrf_build
+)
+_register_upd_cells(
+    "getrf_nopiv_dist", "getrf_nopiv_dist_psum", "getrf_nopiv_dist_ring",
+    _upd_getrf_build,
+)
 
 
 # ---------------------------------------------------------------------------

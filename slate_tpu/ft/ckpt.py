@@ -603,7 +603,10 @@ def _qr_seg_jit(at, tls, tvs, tts, mesh, p, q, m_true, k0, k1, bi):
         with audit_scope(k1 - k0):
             return lax.fori_loop(k0, k1, step, (t_loc, tl_loc, tv, tt))
 
-    with bcast_impl_scope(bi):
+    # pinned xla (see _pp_jit): the committed segment artifacts record
+    # the XLA panel traces, and in interpret mode pallas is bitwise-
+    # equal anyway, so chained-vs-fused comparisons stay exact
+    with bcast_impl_scope(bi), panel_impl_scope("xla"):
         return shard_map_compat(
             kernel, mesh=mesh,
             in_specs=(spec, P(ROW_AXIS), P(), P()),
@@ -642,7 +645,7 @@ def _qr_seg_nm_jit(at, tls, tvs, tts, g, mesh, p, q, m_true, k0, k1, bi):
         gg = lax.pmax(lax.pmax(gg, ROW_AXIS), COL_AXIS)
         return t_loc, tl_loc, tv, tt, gg[None, None]
 
-    with bcast_impl_scope(bi):
+    with bcast_impl_scope(bi), panel_impl_scope("xla"):  # see _qr_seg_jit
         t, tls, tvs, tts, g_out = shard_map_compat(
             kernel, mesh=mesh,
             in_specs=(spec, P(ROW_AXIS), P(), P(), P()),

@@ -336,6 +336,7 @@ def _summa_phase_kernels(p, q):
     import jax.numpy as jnp
     from jax import lax
 
+    from ..ops.pallas_ops import summa_update_pallas, update_engaged
     from ..parallel.comm import PRECISE, bcast_from_col, bcast_from_row
 
     def fetch_k(a_loc, b_loc, k):
@@ -346,20 +347,28 @@ def _summa_phase_kernels(p, q):
         return acol[None, None], brow[None, None]
 
     def bulk_k(acc, acol, brow):
-        upd = jnp.einsum(
-            "iab,jbc->ijac", acol[0, 0], brow[0, 0], precision=PRECISE
-        )
+        # same Option.UpdateImpl dispatch as _summa_jit's consume (the
+        # step-dispatch driver mirrors the fused kernel's math exactly)
+        a0, b0 = acol[0, 0], brow[0, 0]
+        nb_ = a0.shape[-1]
+        if update_engaged(
+            acc.dtype,
+            (a0.shape[0] + b0.shape[0]) * nb_ * nb_ * acc.dtype.itemsize,
+        ):
+            return summa_update_pallas(acc, a0, b0)
+        upd = jnp.einsum("iab,jbc->ijac", a0, b0, precision=PRECISE)
         return acc + upd.astype(acc.dtype)
 
     return {"fetch": fetch_k, "bulk": bulk_k}
 
 
-def summa_steps(at, bt, ct, alpha, beta, mesh, p, q, kt, la, bi):
+def summa_steps(at, bt, ct, alpha, beta, mesh, p, q, kt, la, bi, ui):
     """Per-step stationary-C SUMMA (the _summa_jit schedule, fenced)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding
 
+    from ..ops.pallas_ops import update_impl_scope
     from ..parallel.comm import bcast_impl_scope
 
     rec = active_recorder()
@@ -369,7 +378,8 @@ def summa_steps(at, bt, ct, alpha, beta, mesh, p, q, kt, la, bi):
                    _sm(ks["fetch"], mesh, (spec, spec, rep), (spec, spec)),
                    trace_ctx=lambda: bcast_impl_scope(bi))
     bulk = _Phase("summa", "bulk",
-                  _sm(ks["bulk"], mesh, (spec, spec, spec), spec))
+                  _sm(ks["bulk"], mesh, (spec, spec, spec), spec),
+                  trace_ctx=lambda: update_impl_scope(ui))
 
     nb = at.shape[2]
     acc = jax.device_put(
@@ -379,8 +389,8 @@ def summa_steps(at, bt, ct, alpha, beta, mesh, p, q, kt, la, bi):
     coords = _coords(p, q)
     d = max(0, min(int(la), int(kt)))
     if rec is not None:
-        rec.note_run(op="summa", nt=int(kt), depth=d, impl=bi, grid=(p, q),
-                     phases=("bcast", "bulk"))
+        rec.note_run(op="summa", nt=int(kt), depth=d, impl=bi, update=ui,
+                     grid=(p, q), phases=("bcast", "bulk"))
     fifo: List[Any] = []
     for j in range(d):
         fifo.append(fetch(rec, j, coords, at, bt, _ik(j)))
@@ -441,12 +451,12 @@ def _potrf_phase_kernels(p, q, mtl, ntl, nt, nb, cplx):
             "info": info_k}
 
 
-def potrf_steps(at, mesh, p, q, nt, la, bi, pi):
+def potrf_steps(at, mesh, p, q, nt, la, bi, pi, ui):
     """Per-step mesh Cholesky: the _potrf_jit phases (module-level
     _chol_* helpers), unbucketed, fenced per phase."""
     import jax.numpy as jnp
 
-    from ..ops.pallas_ops import panel_impl_scope
+    from ..ops.pallas_ops import panel_impl_scope, update_impl_scope
     from ..parallel.comm import bcast_impl_scope
 
     rec = active_recorder()
@@ -455,6 +465,7 @@ def potrf_steps(at, mesh, p, q, nt, la, bi, pi):
     nb = at.shape[2]
     cplx = jnp.issubdtype(at.dtype, jnp.complexfloating)
     ctx = lambda: _scopes(bcast_impl_scope(bi), panel_impl_scope(pi))
+    uctx = lambda: update_impl_scope(ui)
     ks = _potrf_phase_kernels(p, q, mtl, ntl, nt, nb, cplx)
 
     panel = _Phase("potrf", "panel",
@@ -469,17 +480,17 @@ def potrf_steps(at, mesh, p, q, nt, la, bi, pi):
     bulk_excl = _Phase("potrf", "bulk",
                        _sm(ks["bulk_excl"], mesh,
                            (spec, spec, spec, rep), spec),
-                       label="bulk_excl")
+                       trace_ctx=uctx, label="bulk_excl")
     bulk_full = _Phase("potrf", "bulk",
                        _sm(ks["bulk_full"], mesh, (spec, spec, spec), spec),
-                       label="bulk_full")
+                       trace_ctx=uctx, label="bulk_full")
     info_p = _Phase("potrf", "info", _sm(ks["info"], mesh, (spec,), spec))
 
     coords = _coords(p, q)
     d = min(max(0, int(la)), 1)  # factor-loop pipelining caps at depth 1
     if rec is not None:
         rec.note_run(op="potrf", nt=int(nt), depth=d, impl=bi, panel=pi,
-                     grid=(p, q), phases=PHASES)
+                     update=ui, grid=(p, q), phases=PHASES)
     t = at
     if d == 0:
         for k in range(nt):
@@ -545,12 +556,12 @@ def _lu_phase_kernels(p, q, mtl, ntl, nt, nb):
             "info": info_k}
 
 
-def lu_steps(at, mesh, p, q, nt, la, bi, pi):
+def lu_steps(at, mesh, p, q, nt, la, bi, pi, ui):
     """Per-step no-pivot mesh LU: the _lu_jit phases (_nopiv_* helpers),
     unbucketed, fenced per phase."""
     import jax.numpy as jnp
 
-    from ..ops.pallas_ops import panel_impl_scope
+    from ..ops.pallas_ops import panel_impl_scope, update_impl_scope
     from ..parallel.comm import bcast_impl_scope
 
     rec = active_recorder()
@@ -558,6 +569,7 @@ def lu_steps(at, mesh, p, q, nt, la, bi, pi):
     mtl, ntl = at.shape[0] // p, at.shape[1] // q
     nb = at.shape[2]
     ctx = lambda: _scopes(bcast_impl_scope(bi), panel_impl_scope(pi))
+    uctx = lambda: update_impl_scope(ui)
     ks = _lu_phase_kernels(p, q, mtl, ntl, nt, nb)
 
     panel = _Phase("getrf_nopiv", "panel",
@@ -572,10 +584,10 @@ def lu_steps(at, mesh, p, q, nt, la, bi, pi):
     bulk_excl = _Phase("getrf_nopiv", "bulk",
                        _sm(ks["bulk_excl"], mesh,
                            (spec, spec, spec, rep), spec),
-                       label="bulk_excl")
+                       trace_ctx=uctx, label="bulk_excl")
     bulk_full = _Phase("getrf_nopiv", "bulk",
                        _sm(ks["bulk_full"], mesh, (spec, spec, spec), spec),
-                       label="bulk_full")
+                       trace_ctx=uctx, label="bulk_full")
     info_p = _Phase("getrf_nopiv", "info",
                     _sm(ks["info"], mesh, (spec,), spec))
 
@@ -583,7 +595,7 @@ def lu_steps(at, mesh, p, q, nt, la, bi, pi):
     d = min(max(0, int(la)), 1)
     if rec is not None:
         rec.note_run(op="getrf_nopiv", nt=int(nt), depth=d, impl=bi,
-                     panel=pi, grid=(p, q), phases=PHASES)
+                     panel=pi, update=ui, grid=(p, q), phases=PHASES)
     t = at
     if d == 0:
         for k in range(nt):
@@ -747,7 +759,7 @@ def _qr_phase_kernels(p, q, m_true):
             "fin": fin_k}
 
 
-def geqrf_steps(at, mesh, p, q, nt, m_true, n_true, bi):
+def geqrf_steps(at, mesh, p, q, nt, m_true, n_true, bi, pi):
     """Per-step distributed CAQR (the _geqrf_jit strict schedule over
     dist_qr's module-level phase helpers), fenced per phase: panel = the
     local offset-pivot QR + compact-WY T, bcast = the three rooted
@@ -759,6 +771,7 @@ def geqrf_steps(at, mesh, p, q, nt, m_true, n_true, bi):
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from ..ops.pallas_ops import panel_impl_scope
     from ..parallel.comm import bcast_impl_scope
     from ..parallel.mesh import ROW_AXIS
 
@@ -770,7 +783,8 @@ def geqrf_steps(at, mesh, p, q, nt, m_true, n_true, bi):
     ks = _qr_phase_kernels(p, q, m_true)
 
     panel = _Phase("geqrf", "panel",
-                   _sm(ks["panel"], mesh, (spec, rep), (spec, spec, spec)))
+                   _sm(ks["panel"], mesh, (spec, rep), (spec, spec, spec)),
+                   trace_ctx=lambda: panel_impl_scope(pi))
     bcast = _Phase("geqrf", "bcast",
                    _sm(ks["bcast"], mesh, (spec, spec, spec, rep),
                        (spec, spec, spec)),
@@ -786,8 +800,8 @@ def geqrf_steps(at, mesh, p, q, nt, m_true, n_true, bi):
 
     coords = _coords(p, q)
     if rec is not None:
-        rec.note_run(op="geqrf", nt=int(nt), depth=0, impl=bi, grid=(p, q),
-                     phases=PHASES)
+        rec.note_run(op="geqrf", nt=int(nt), depth=0, impl=bi, panel=pi,
+                     grid=(p, q), phases=PHASES)
     dtype = at.dtype
     t = at
     tls = jax.device_put(jnp.zeros((p * nt, nb, nb), dtype),
@@ -887,14 +901,14 @@ def he2hb_steps(at, mesh, p, q, n_true, nb, nsteps, bi):
 
 def step_traceable(op: str, mesh, p: int, q: int, nt: int, mtl: int,
                    ntl: int, nb: int, cplx: bool = False,
-                   bi: str = "auto", pi: str = "xla"):
+                   bi: str = "auto", pi: str = "xla", ui: str = "xla"):
     """One full flight k-step as a single traceable function over the
     global tile stacks — the slate_lint registry surface for the
     step-dispatch phase programs.  ``k`` is a runtime argument, so the
     rooted broadcasts trace the engine's lax.switch dispatch exactly as
     the per-step jits do.  Returns the composed fn (summa: (at, bt, k);
     potrf/getrf_nopiv: (at, k))."""
-    from ..ops.pallas_ops import panel_impl_scope
+    from ..ops.pallas_ops import panel_impl_scope, update_impl_scope
     from ..parallel.comm import bcast_impl_scope
 
     spec, rep = _specs()
@@ -907,7 +921,7 @@ def step_traceable(op: str, mesh, p: int, q: int, nt: int, mtl: int,
         def fn(at, bt, k):
             import jax.numpy as jnp
 
-            with bcast_impl_scope(bi):
+            with _scopes(bcast_impl_scope(bi), update_impl_scope(ui)):
                 acol, brow = fetch(at, bt, k)
                 acc = jnp.zeros((at.shape[0], bt.shape[1], nb, nb), at.dtype)
                 return bulk(acc, acol, brow)
@@ -929,7 +943,7 @@ def step_traceable(op: str, mesh, p: int, q: int, nt: int, mtl: int,
                      (spec, prow, rep, rep))
 
         def fn(at, tls, tvs, tts, k):
-            with bcast_impl_scope(bi):
+            with _scopes(bcast_impl_scope(bi), panel_impl_scope(pi)):
                 po = panel(at, k)
                 pl = bcast(po[0], po[1], po[2], k)
                 return update(at, tls, tvs, tts, pl[0], pl[1], pl[2], k)
@@ -975,7 +989,8 @@ def step_traceable(op: str, mesh, p: int, q: int, nt: int, mtl: int,
     info = _sm(ks["info"], mesh, (spec,), spec)
 
     def fn(at, k):
-        with _scopes(bcast_impl_scope(bi), panel_impl_scope(pi)):
+        with _scopes(bcast_impl_scope(bi), panel_impl_scope(pi),
+                     update_impl_scope(ui)):
             if op == "potrf":
                 t, po = panel(at, k)
                 pl = bcast(po, k)

@@ -60,6 +60,13 @@ _NUM_DEFAULT: List[Optional[str]] = [None]
 # factor stalls but GMRES-IR still converges (Carson & Higham 2018).
 GROWTH_THRESHOLD = float(os.environ.get("SLATE_TPU_NUM_GROWTH_MAX", 2.0**20))
 CONDEST_THRESHOLD = float(os.environ.get("SLATE_TPU_NUM_COND_MAX", 1e7))
+# ORTH: the reflector/τ consistency loss of a monitored QR chain
+# (num.qr_orth_margin / num.he2hb_orth_margin) is ~eps of the working
+# dtype for healthy panels; past ~sqrt(eps64) half the digits of Q's
+# orthogonality are gone — the classical one-reorthogonalization trigger
+# (Giraud & Langou's "twice is enough" bound).  serve.Router's QR tier
+# acts on it: one re-orthogonalization retry (``serve.retries``).
+ORTH_THRESHOLD = float(os.environ.get("SLATE_TPU_NUM_ORTH_MAX", 1e-8))
 
 
 class GrowthAbort(Exception):
@@ -127,6 +134,7 @@ _STATE = {
     "chol_margin_min": 0.0,    # smallest Schur-diagonal margin seen
     "qr_orth_loss_max": 0.0,   # worst QR reflector/τ consistency loss
     "he2hb_orth_loss_max": 0.0,  # worst eig-chain (he2hb) panel loss
+    "orth_alarms": 0.0,        # orth loss above ORTH_THRESHOLD
 }
 
 
@@ -230,6 +238,17 @@ def last_gauges(op: str) -> Dict[str, float]:
         return dict(_LAST.get(op, {}))
 
 
+def orth_exceeded(op: str) -> bool:
+    """Whether ``op``'s most recent monitored run recorded an
+    orthogonality-loss gauge (``qr_orth_loss`` or ``he2hb_orth_loss``)
+    past ORTH_THRESHOLD — serve.Router's re-orthogonalization retry
+    trigger (the read side of ``num.qr_orth_margin`` /
+    ``num.he2hb_orth_margin``)."""
+    g = last_gauges(op)
+    loss = max(g.get("qr_orth_loss", 0.0), g.get("he2hb_orth_loss", 0.0))
+    return loss > ORTH_THRESHOLD
+
+
 def last_history(op: str) -> List:
     """The most recent refinement trajectory for ``op``: a list of
     (rnorm, xnorm) pairs, initial solve first."""
@@ -303,6 +322,10 @@ def record_qr_orth(op: str, loss) -> None:
     _note(op, {"qr_orth_loss": val})
     with _lock:
         _STATE["qr_orth_loss_max"] = max(_STATE["qr_orth_loss_max"], val)
+        if val > ORTH_THRESHOLD:
+            _STATE["orth_alarms"] += 1
+            REGISTRY.counter_add("num.orth_alarms", 1.0, op=op,
+                                 **_tenant_tags())
 
 
 def record_he2hb_orth(op: str, loss) -> None:
@@ -323,6 +346,10 @@ def record_he2hb_orth(op: str, loss) -> None:
     with _lock:
         _STATE["he2hb_orth_loss_max"] = max(_STATE["he2hb_orth_loss_max"],
                                             val)
+        if val > ORTH_THRESHOLD:
+            _STATE["orth_alarms"] += 1
+            REGISTRY.counter_add("num.orth_alarms", 1.0, op=op,
+                                 **_tenant_tags())
 
 
 def record_condest(op: str, rcond) -> None:

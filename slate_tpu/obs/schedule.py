@@ -40,7 +40,12 @@ PHASES = ("panel", "bcast", "bulk")
 # phases whose fenced duration is communication time (the comm lens);
 # everything else is compute.  "panel" carries the diag-tile hop too but
 # is dominated by the factor+solve — the split matches the fused
-# kernels' phase_scope tagging.
+# kernels' phase_scope tagging.  The "bulk" phase is the trailing
+# update: under Option.UpdateImpl=pallas its fenced dispatch lowers to
+# the one-kernel fused trailing update (PR 20) with the SAME phase
+# events and collective records — the model's bytes are invariant
+# across UpdateImpl by construction (the dispatch sits strictly inside
+# the compute half), which the *_upd_* contract cells prove.
 _COMM_PHASES = ("bcast",)
 
 

@@ -255,6 +255,13 @@ def panel_engaged(dtype, nbytes: Optional[int] = None) -> bool:
     impl = panel_active_impl()
     if impl != "pallas":
         return False
+    return _pallas_dtype_ok(dtype, nbytes)
+
+
+def _pallas_dtype_ok(dtype, nbytes: Optional[int] = None) -> bool:
+    """The shared dtype/size gate behind ``panel_engaged`` and
+    ``update_engaged``: real-floating always under the interpreter,
+    MXU dtypes within the VMEM cap on a real TPU, complex never."""
     dt = jnp.dtype(dtype)
     if dt.kind == "c":
         return False
@@ -263,6 +270,96 @@ def panel_engaged(dtype, nbytes: Optional[int] = None) -> bool:
     if dt not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
         return False
     return nbytes is None or nbytes <= _PANEL_VMEM_CAP
+
+
+# ---------------------------------------------------------------------------
+# Option.UpdateImpl gate (ISSUE 20): the Option.PanelImpl pattern applied
+# to the TRAILING UPDATE — the O(n^3) bulk of every k-step.  Same
+# trace-time contract: mesh kernels thread the resolved impl through
+# their jit as a static argument and wrap tracing in
+# ``update_impl_scope``; ``xla`` IS today's einsum bulk (jaxpr-identical
+# by construction), ``pallas`` swaps only the local compute for the
+# fused grid kernels below — the broadcast schedule and comm bytes are
+# untouched.
+# ---------------------------------------------------------------------------
+
+UPDATE_IMPLS = ("xla", "pallas", "auto")
+UPDATE_IMPL_ENV = "SLATE_TPU_UPDATE_IMPL"
+
+_UPDATE_DEFAULT = [None]  # session default (use_update_impl), outside jit
+_UPDATE_ACTIVE = ["__chain__"]  # trace-time impl (update_impl_scope)
+
+
+def _check_update_impl(impl: str) -> str:
+    if impl not in UPDATE_IMPLS:
+        raise ValueError(
+            f"unknown update impl {impl!r}; expected one of {UPDATE_IMPLS}"
+        )
+    return impl
+
+
+def resolve_update_impl(impl: Optional[str] = None) -> str:
+    """Resolve an Option.UpdateImpl value at driver level (OUTSIDE jit):
+    explicit argument > ``use_update_impl`` context default >
+    ``SLATE_TPU_UPDATE_IMPL`` environment > ``auto``.  ``auto`` stays
+    ``auto``: the concrete choice depends on the trailing stack's
+    dtype/size and is made at the dispatch site
+    (:func:`update_engaged`)."""
+    if impl is None:
+        impl = _UPDATE_DEFAULT[-1]
+    if impl is None:
+        impl = os.environ.get(UPDATE_IMPL_ENV) or "auto"
+    return _check_update_impl(impl)
+
+
+@contextlib.contextmanager
+def use_update_impl(impl: str):
+    """Set the session-default trailing-update lowering for drivers
+    called inside (tests / CI sweeps); an explicit ``update_impl=``
+    argument still wins."""
+    _UPDATE_DEFAULT.append(_check_update_impl(impl))
+    try:
+        yield
+    finally:
+        _UPDATE_DEFAULT.pop()
+
+
+@contextlib.contextmanager
+def update_impl_scope(impl: str):
+    """Activate a lowering for the trailing-update dispatch traced
+    inside — used by the mesh kernels around their shard_map call, with
+    ``impl`` a static jit argument of the enclosing kernel."""
+    _UPDATE_ACTIVE.append(_check_update_impl(impl))
+    try:
+        yield
+    finally:
+        _UPDATE_ACTIVE.pop()
+
+
+def update_active_impl() -> str:
+    """Concrete trace-time impl: the innermost ``update_impl_scope``
+    when a kernel pinned one (static jit arg), else the resolve chain;
+    with ``auto`` mapped to ``pallas`` on a real TPU backend and ``xla``
+    elsewhere (CPU tier-1 stays bitwise today's results unless pallas is
+    requested explicitly)."""
+    impl = _UPDATE_ACTIVE[-1]
+    if impl == "__chain__":
+        impl = resolve_update_impl()
+    if impl == "auto":
+        impl = "xla" if _interpret() else "pallas"
+    return impl
+
+
+def update_engaged(dtype, nbytes: Optional[int] = None) -> bool:
+    """Whether the fused Pallas trailing-update kernels take this
+    dispatch — the :func:`panel_engaged` gate read against the
+    ``update_impl_scope`` chain.  ``nbytes`` is the broadcast-panel
+    working set (the VMEM-resident operands; the trailing tiles
+    stream)."""
+    impl = update_active_impl()
+    if impl != "pallas":
+        return False
+    return _pallas_dtype_ok(dtype, nbytes)
 
 
 # ---------------------------------------------------------------------------
@@ -599,6 +696,119 @@ def qr_panel_offset_pallas(a: jax.Array, row0):
         ),
     )(r0, a)
     return r, v, tau[0], t
+
+
+# ---------------------------------------------------------------------------
+# fused trailing-update kernels (ISSUE 20): one grid dispatch over the
+# local trailing tile stack per k-step.  The broadcast panels ride VMEM
+# blocks shared across the grid; the trailing tiles stream through one
+# (nb, nb) block per step.  Each kernel runs the SAME dot_general
+# contraction + select/accumulate op sequence as its XLA einsum bulk —
+# bitwise under interpret mode (asserted in tests/test_pallas_update.py).
+# ---------------------------------------------------------------------------
+
+
+def summa_update_pallas(
+    acc: jax.Array, pan: jax.Array, urow: jax.Array
+) -> jax.Array:
+    """One SUMMA accumulation step over the local (I, J) tile grid:
+    ``acc[i, j] += pan[i] @ urow[j]`` — the non-checksum sibling of
+    :func:`ft_summa_update_pallas`, consumed by ``summa.py``'s
+    stationary-C consume."""
+    I, nb, _ = pan.shape
+    J = urow.shape[0]
+
+    def kern(p_ref, u_ref, a_ref, o_ref):
+        upd = jnp.matmul(p_ref[0], u_ref[0], precision=_HIGHEST)
+        o_ref[:] = a_ref[:] + upd[None, None].astype(a_ref.dtype)
+
+    return _pallas_call(
+        kern,
+        grid=(J, I),
+        in_specs=[
+            pl.BlockSpec((1, nb, nb), lambda j, i: (i, 0, 0)),
+            pl.BlockSpec((1, nb, nb), lambda j, i: (j, 0, 0)),
+            pl.BlockSpec((1, 1, nb, nb), lambda j, i: (i, j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, nb, nb), lambda j, i: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(acc.shape, acc.dtype),
+    )(pan, urow, acc)
+
+
+def chol_trailing_update_pallas(
+    view: jax.Array, pan: jax.Array, pan_t: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """The potrf trailing update (``dist_chol._chol_bulk``'s herk) as one
+    grid dispatch: ``view[i, j] -= mask[i, j] ? pan[i] @ pan_t[j]^T : 0``
+    with the per-tile lower/exclusion ``mask`` (int32, possibly traced —
+    it folds the ``i_log >= j_log`` lower select and the lookahead
+    ``excl_kc`` column) computed in XLA outside and riding SMEM."""
+    I, nb, _ = pan.shape
+    J = pan_t.shape[0]
+    m32 = mask.astype(jnp.int32)
+
+    def kern(m_ref, p_ref, t_ref, a_ref, o_ref):
+        upd = lax.dot_general(
+            p_ref[0], t_ref[0], (((1,), (1,)), ((), ())),
+            precision=_HIGHEST,
+        ).astype(a_ref.dtype)
+        sel = jnp.where(m_ref[0, 0] != 0, upd, jnp.zeros_like(upd))
+        o_ref[:] = a_ref[:] - sel[None, None]
+
+    mask_spec = (
+        pl.BlockSpec(memory_space=pltpu.SMEM)
+        if _HAS_PLTPU and not _interpret()
+        else pl.BlockSpec((1, 1), lambda j, i: (i, j))
+    )
+    return _pallas_call(
+        kern,
+        grid=(J, I),
+        in_specs=[
+            mask_spec,
+            pl.BlockSpec((1, nb, nb), lambda j, i: (i, 0, 0)),
+            pl.BlockSpec((1, nb, nb), lambda j, i: (j, 0, 0)),
+            pl.BlockSpec((1, 1, nb, nb), lambda j, i: (i, j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, nb, nb), lambda j, i: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(view.shape, view.dtype),
+    )(m32, pan, pan_t, view)
+
+
+def lu_trailing_update_pallas(
+    t_loc: jax.Array, pan: jax.Array, urow: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """The LU-nopiv trailing update (``dist_lu._nopiv_bulk``'s gemm) as
+    one grid dispatch: ``t[i, j] -= mask[i, j] ? pan[i] @ urow[j] : 0``
+    with the per-tile keep ``mask`` (the lookahead ``excl_kr``/``excl_kc``
+    exclusions; all-ones on the plain sweep) computed in XLA outside."""
+    I, nb, _ = pan.shape
+    J = urow.shape[0]
+    m32 = mask.astype(jnp.int32)
+
+    def kern(m_ref, p_ref, u_ref, a_ref, o_ref):
+        upd = jnp.matmul(
+            p_ref[0], u_ref[0], precision=_HIGHEST
+        ).astype(a_ref.dtype)
+        sel = jnp.where(m_ref[0, 0] != 0, upd, jnp.zeros_like(upd))
+        o_ref[:] = a_ref[:] - sel[None, None]
+
+    mask_spec = (
+        pl.BlockSpec(memory_space=pltpu.SMEM)
+        if _HAS_PLTPU and not _interpret()
+        else pl.BlockSpec((1, 1), lambda j, i: (i, j))
+    )
+    return _pallas_call(
+        kern,
+        grid=(J, I),
+        in_specs=[
+            mask_spec,
+            pl.BlockSpec((1, nb, nb), lambda j, i: (i, 0, 0)),
+            pl.BlockSpec((1, nb, nb), lambda j, i: (j, 0, 0)),
+            pl.BlockSpec((1, 1, nb, nb), lambda j, i: (i, j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, nb, nb), lambda j, i: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(t_loc.shape, t_loc.dtype),
+    )(m32, pan, urow, t_loc)
 
 
 # ---------------------------------------------------------------------------

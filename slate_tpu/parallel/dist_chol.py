@@ -45,9 +45,13 @@ from ..obs import instrument
 from ..obs.numerics import resolve_num_monitor
 from ..ops.pallas_ops import (
     chol_panel_tiles_pallas,
+    chol_trailing_update_pallas,
     panel_engaged,
     panel_impl_scope,
     resolve_panel_impl,
+    resolve_update_impl,
+    update_engaged,
+    update_impl_scope,
 )
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
@@ -74,7 +78,7 @@ from typing import Optional
 def potrf_dist(
     a: DistMatrix, lookahead: Optional[int] = None,
     bcast_impl: Optional[str] = None, panel_impl: Optional[str] = None,
-    num_monitor: Optional[str] = None,
+    num_monitor: Optional[str] = None, update_impl: Optional[str] = None,
 ) -> Tuple[DistMatrix, jax.Array]:
     """Factor A = L L^H (lower). ``a`` holds the lower triangle (upper tile
     content ignored). Returns (L as DistMatrix, info).
@@ -95,7 +99,11 @@ def potrf_dist(
     factorization — a strict-schedule intermediate at ANY lookahead
     depth, so the gauge is depth-invariant) plus the final factor's diag
     min/max, reduced once at loop exit; ``off`` (and the flight
-    step-dispatch path) is jaxpr-identical and records nothing."""
+    step-dispatch path) is jaxpr-identical and records nothing.
+    ``update_impl`` (Option.UpdateImpl) picks the trailing-herk lowering:
+    ``xla`` (today's masked einsum bulk, jaxpr-identical) or ``pallas``
+    (one fused grid dispatch per k-step, bitwise vs xla under interpret
+    mode; comm bytes invariant by construction)."""
     p, q = mesh_shape(a.mesh)
     if a.mt != a.nt:
         raise ValueError("potrf_dist needs a square tile grid")
@@ -111,19 +119,20 @@ def potrf_dist(
         lt, info = _flight.potrf_steps(
             a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
             resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
+            resolve_update_impl(update_impl),
         )
     elif nm:
         lt, info, gz = _potrf_jit(
             a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
             resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
-            True, a.n,
+            resolve_update_impl(update_impl), True, a.n,
         )
         _num.record_chol_gauges("potrf", gz[0], gz[1], gz[2])
     else:
         lt, info = _potrf_jit(
             a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
             resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
-            False, 0,
+            resolve_update_impl(update_impl), False, 0,
         )
     return DistMatrix(
         tiles=lt, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True
@@ -230,10 +239,22 @@ def _chol_info_dist(t_loc, i_log, j_log, nt, nb):
 
 
 def _chol_bulk(view, payload, lower, cplx, excl_kc=None):
-    """The trailing herk.  ``excl_kc`` None: the strict/drain full
-    update; otherwise exclude the column slot ``_chol_narrow`` already
-    refreshed."""
+    """The trailing herk, dispatched by the active Option.UpdateImpl
+    scope.  ``excl_kc`` None: the strict/drain full update; otherwise
+    exclude the column slot ``_chol_narrow`` already refreshed.  The
+    pallas branch folds the lower/exclusion select into a per-tile mask
+    and runs one fused grid dispatch (bitwise vs the einsum form under
+    interpret mode); complex stays on the xla form."""
     pan_p, panT_p = payload
+    nb = view.shape[-1]
+    if not cplx and update_engaged(
+        view.dtype,
+        (pan_p.shape[0] + panT_p.shape[0]) * nb * nb * view.dtype.itemsize,
+    ):
+        mask = lower[:, :, 0, 0]
+        if excl_kc is not None:
+            mask = mask & (jnp.arange(lower.shape[1]) != excl_kc)[None, :]
+        return chol_trailing_update_pallas(view, pan_p, panT_p, mask)
     upd = jnp.einsum(
         "iab,jcb->ijac", pan_p, jnp.conj(panT_p) if cplx else panT_p,
         precision=PRECISE,
@@ -245,8 +266,8 @@ def _chol_bulk(view, payload, lower, cplx, excl_kc=None):
     return view - jnp.where(mask, upd, 0)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9))
-def _potrf_jit(at, mesh, p, q, nt, la, bi, pi, nm=False, n_true=0):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10))
+def _potrf_jit(at, mesh, p, q, nt, la, bi, pi, ui, nm=False, n_true=0):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
@@ -371,7 +392,7 @@ def _potrf_jit(at, mesh, p, q, nt, la, bi, pi, nm=False, n_true=0):
     out_specs = (spec, P(ROW_AXIS, COL_AXIS))
     if nm:
         out_specs = out_specs + (P(ROW_AXIS, COL_AXIS),)
-    with bcast_impl_scope(bi), panel_impl_scope(pi):
+    with bcast_impl_scope(bi), panel_impl_scope(pi), update_impl_scope(ui):
         out = shard_map_compat(
             kernel,
             mesh=mesh,

@@ -46,9 +46,13 @@ from ..obs.numerics import resolve_num_monitor
 from ..ops.pallas_ops import (
     lu_panel_tiles_pallas,
     lu_rowsolve_tiles_pallas,
+    lu_trailing_update_pallas,
     panel_engaged,
     panel_impl_scope,
     resolve_panel_impl,
+    resolve_update_impl,
+    update_engaged,
+    update_impl_scope,
 )
 from .dist import DistMatrix
 from .mesh import COL_AXIS, ROW_AXIS, mesh_shape
@@ -77,7 +81,7 @@ from typing import Optional
 def getrf_nopiv_dist(
     a: DistMatrix, lookahead: Optional[int] = None,
     bcast_impl: Optional[str] = None, panel_impl: Optional[str] = None,
-    num_monitor: Optional[str] = None,
+    update_impl: Optional[str] = None, num_monitor: Optional[str] = None,
 ) -> Tuple[DistMatrix, jax.Array]:
     """Factor A = L U in place (packed LU tiles). Returns (LU, info).
 
@@ -89,7 +93,11 @@ def getrf_nopiv_dist(
     bitwise-identical.  ``panel_impl`` (Option.PanelImpl) picks the
     panel-phase lowering: ``xla`` (today's recursive diag factor +
     batched trsm pair, bitwise) or ``pallas`` (fused on-chip panel
-    kernels; documented-tolerance parity).  ``num_monitor``
+    kernels; documented-tolerance parity).  ``update_impl``
+    (Option.UpdateImpl) picks the trailing-gemm lowering the same way:
+    ``xla`` (today's bulk einsum, jaxpr-identical) or ``pallas``
+    (:func:`~..ops.pallas_ops.lu_trailing_update_pallas`, one fused grid
+    dispatch per k-step, bitwise in interpret mode).  ``num_monitor``
     (Option.NumMonitor) threads the in-carry element-growth gauge —
     running max|working array|/max|A|, THE no-pivot breakdown monitor —
     sampled at panel entry of every step (strict-schedule intermediates
@@ -109,19 +117,20 @@ def getrf_nopiv_dist(
         lut, info = _flight.lu_steps(
             a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
             resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
+            resolve_update_impl(update_impl),
         )
     elif nm:
         lut, info, gz = _lu_jit(
             a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
             resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
-            True, a.m,
+            resolve_update_impl(update_impl), True, a.m,
         )
         _num.record_lu_growth("getrf_nopiv", gz[0], gz[1])
     else:
         lut, info = _lu_jit(
             a.tiles, a.mesh, p, q, a.nt, la_depth(lookahead, a.nt),
             resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
-            False, 0,
+            resolve_update_impl(update_impl), False, 0,
         )
     return DistMatrix(
         tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True
@@ -272,10 +281,25 @@ def _nopiv_narrow(t_loc, payload, k, p, q, roff=0, coff=0, with_row=True):
 
 def _nopiv_bulk(t_loc, payload, excl_kr=None, excl_kc=None):
     """Apply a deferred trailing update everywhere ``_nopiv_narrow`` did
-    not (both exclusions None = the full strict-schedule update)."""
+    not (both exclusions None = the full strict-schedule update),
+    dispatched by the active Option.UpdateImpl scope.  XLA branch:
+    today's bulk einsum, jaxpr-identical.  Pallas branch: one fused grid
+    dispatch (``lu_trailing_update_pallas``) running the same contraction
+    + select + subtract op sequence per tile — bitwise in interpret
+    mode; the exclusions fold into a per-tile keep mask."""
     dtype = t_loc.dtype
     mtl, ntl = t_loc.shape[0], t_loc.shape[1]
     pan_p, urow_p = payload
+    nb = t_loc.shape[-1]
+    if update_engaged(
+        dtype, (pan_p.shape[0] + urow_p.shape[0]) * nb * nb * dtype.itemsize
+    ):
+        keep = jnp.ones((mtl, ntl), bool)
+        if excl_kc is not None:
+            keep = keep & (jnp.arange(ntl) != excl_kc)[None, :]
+        if excl_kr is not None:
+            keep = keep & (jnp.arange(mtl) != excl_kr)[:, None]
+        return lu_trailing_update_pallas(t_loc, pan_p, urow_p, keep)
     upd = jnp.einsum("iab,jbc->ijac", pan_p, urow_p, precision=PRECISE)
     if excl_kr is None and excl_kc is None:
         return t_loc - upd.astype(dtype)
@@ -332,8 +356,8 @@ def _lu_growth_out(amax0, g, gfinal):
     return jnp.stack([allr(amax0), allr(g)])[None, None]
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9))
-def _lu_jit(at, mesh, p, q, nt, la, bi, pi, nm=False, m_true=0):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10))
+def _lu_jit(at, mesh, p, q, nt, la, bi, pi, ui, nm=False, m_true=0):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
@@ -407,7 +431,7 @@ def _lu_jit(at, mesh, p, q, nt, la, bi, pi, nm=False, m_true=0):
     out_specs = (spec, P(ROW_AXIS, COL_AXIS))
     if nm:
         out_specs = out_specs + (P(ROW_AXIS, COL_AXIS),)
-    with bcast_impl_scope(bi), panel_impl_scope(pi):
+    with bcast_impl_scope(bi), panel_impl_scope(pi), update_impl_scope(ui):
         out = shard_map_compat(
             kernel,
             mesh=mesh,
@@ -430,7 +454,8 @@ def _lu_jit(at, mesh, p, q, nt, la, bi, pi, nm=False, m_true=0):
 @instrument("getrf_tntpiv_dist")
 def getrf_tntpiv_dist(
     a: DistMatrix, lookahead: Optional[int] = None,
-    bcast_impl: Optional[str] = None, num_monitor: Optional[str] = None,
+    bcast_impl: Optional[str] = None, panel_impl: Optional[str] = None,
+    num_monitor: Optional[str] = None,
 ) -> Tuple[DistMatrix, jax.Array, jax.Array]:
     """Factor P A = L U with tournament pivoting across the mesh.
 
@@ -444,7 +469,12 @@ def getrf_tntpiv_dist(
     column) overlap it — the CALU form of the reference's lookahead.  The
     deferred update must land before the cross-shard row swaps (they move
     full rows), so the overlap window is the tournament, not the whole
-    panel.  Results are bitwise-identical at any depth.  ``num_monitor``
+    panel.  Results are bitwise-identical at any depth.  ``panel_impl``
+    (Option.PanelImpl) picks the POST-pivot panel lowering — the diag
+    factor + tile solves that run after the tournament has swapped the
+    winners in (``pallas`` routes them through the fused
+    ``lu_panel_tiles_pallas`` pair; the pivot search itself stays XLA:
+    argmax/tournament collectives have no MXU body).  ``num_monitor``
     (Option.NumMonitor): ``on`` carries the element-growth gauge through
     the k-loop (the tournament's pivot quality monitor — growth far
     above the partial-pivot bound flags a lost tournament); ``off`` is
@@ -460,13 +490,15 @@ def getrf_tntpiv_dist(
     if nm:
         lut, perm, info, gz = _tntpiv_jit(
             a.tiles, a.mesh, p, q, a.nt, a.m, la_depth(lookahead, a.nt),
-            resolve_bcast_impl(bcast_impl), True,
+            resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
+            True,
         )
         _num.record_lu_growth("getrf_tntpiv", gz[0], gz[1])
     else:
         lut, perm, info = _tntpiv_jit(
             a.tiles, a.mesh, p, q, a.nt, a.m, la_depth(lookahead, a.nt),
-            resolve_bcast_impl(bcast_impl), False,
+            resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
+            False,
         )
     return (
         DistMatrix(tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True),
@@ -475,8 +507,8 @@ def getrf_tntpiv_dist(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8))
-def _tntpiv_jit(at, mesh, p, q, nt, m_true, la, bi, nm=False):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9))
+def _tntpiv_jit(at, mesh, p, q, nt, m_true, la, bi, pi, nm=False):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
@@ -644,11 +676,12 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true, la, bi, nm=False):
     out_specs = (spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS))
     if nm:
         out_specs = out_specs + (P(ROW_AXIS, COL_AXIS),)
-    # pivoted kernels keep the XLA panel forms: their k-step cost is the
-    # pivot machinery (tournament / argmax collectives + row swaps), and
-    # pinning the scope keeps this jit's cache impl-independent — the
-    # nopiv kernel (and the ft variants) are the PanelImpl consumers
-    with bcast_impl_scope(bi), panel_impl_scope("xla"):
+    # the POST-pivot panel (diag factor + tile solves after the swaps)
+    # dispatches by PanelImpl like the nopiv kernel; the pivot search
+    # stays XLA by construction (no dispatch site).  The trailing gemm
+    # stays pinned xla: Option.UpdateImpl scopes summa/potrf/LU-nopiv
+    # only, and the pin keeps this jit's cache UpdateImpl-independent
+    with bcast_impl_scope(bi), panel_impl_scope(pi), update_impl_scope("xla"):
         out = shard_map_compat(
             kernel,
             mesh=mesh,
@@ -675,7 +708,8 @@ def _tntpiv_jit(at, mesh, p, q, nt, m_true, la, bi, nm=False):
 @instrument("getrf_pp_dist")
 def getrf_pp_dist(
     a: DistMatrix, lookahead: Optional[int] = None,
-    bcast_impl: Optional[str] = None, num_monitor: Optional[str] = None,
+    bcast_impl: Optional[str] = None, panel_impl: Optional[str] = None,
+    num_monitor: Optional[str] = None,
 ) -> Tuple[DistMatrix, jax.Array, jax.Array]:
     """Factor P A = L U with classic partial (per-column argmax) pivoting.
 
@@ -694,7 +728,10 @@ def getrf_pp_dist(
     contract as getrf_tntpiv_dist.  ``lookahead`` >= 1 overlaps the
     pivoted panel factor's collectives with the previous step's deferred
     trailing gemm (bitwise-identical reorder; see getrf_tntpiv_dist).
-    ``num_monitor`` (Option.NumMonitor): ``on`` carries the
+    ``panel_impl`` (Option.PanelImpl) picks the post-pivot panel-ROW
+    solve lowering (``pallas`` = ``lu_rowsolve_tiles_pallas``); the
+    panel-column factor is fused with the per-column pivot search and
+    stays XLA.  ``num_monitor`` (Option.NumMonitor): ``on`` carries the
     element-growth gauge (max 2^{n-1} under partial pivoting — the
     Wilkinson bound — so a tripped gauge is a certified pathological
     input); ``off`` is jaxpr-identical.
@@ -709,13 +746,15 @@ def getrf_pp_dist(
     if nm:
         lut, perm, info, gz = _pp_jit(
             a.tiles, a.mesh, p, q, a.nt, a.m, la_depth(lookahead, a.nt),
-            resolve_bcast_impl(bcast_impl), True,
+            resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
+            True,
         )
         _num.record_lu_growth("getrf_pp", gz[0], gz[1])
     else:
         lut, perm, info = _pp_jit(
             a.tiles, a.mesh, p, q, a.nt, a.m, la_depth(lookahead, a.nt),
-            resolve_bcast_impl(bcast_impl), False,
+            resolve_bcast_impl(bcast_impl), resolve_panel_impl(panel_impl),
+            False,
         )
     return (
         DistMatrix(tiles=lut, m=a.m, n=a.n, nb=a.nb, mesh=a.mesh, diag_pad=True),
@@ -892,8 +931,8 @@ def _pp_panel_and_swaps(t_loc, rowperm, k, p, q, r, c, nt, m_true,
     )
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8))
-def _pp_jit(at, mesh, p, q, nt, m_true, la, bi, nm=False):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9))
+def _pp_jit(at, mesh, p, q, nt, m_true, la, bi, pi, nm=False):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(t_loc):
@@ -986,7 +1025,9 @@ def _pp_jit(at, mesh, p, q, nt, m_true, la, bi, nm=False):
     out_specs = (spec, P(ROW_AXIS), P(ROW_AXIS, COL_AXIS))
     if nm:
         out_specs = out_specs + (P(ROW_AXIS, COL_AXIS),)
-    with bcast_impl_scope(bi), panel_impl_scope("xla"):  # see _tntpiv_jit
+    # post-pivot row solve dispatches by PanelImpl; update pinned xla —
+    # see _tntpiv_jit
+    with bcast_impl_scope(bi), panel_impl_scope(pi), update_impl_scope("xla"):
         out = shard_map_compat(
             kernel,
             mesh=mesh,
@@ -1125,7 +1166,10 @@ def _gb_pp_jit(at, mesh, p, q, nt, m_true, wd_l, wd_u, wd_usw, bi):
         info = _lu_info_dist(t_loc, i_log, j_log, nt, nb)
         return t_loc, rowperm[None], info[None, None]
 
-    with bcast_impl_scope(bi), panel_impl_scope("xla"):  # see _tntpiv_jit
+    # band kernel keeps the XLA forms end to end: its windowed solves and
+    # trailing einsum are inline (no dispatch sites), and the pins keep
+    # the trace independent of any ambient impl chain
+    with bcast_impl_scope(bi), panel_impl_scope("xla"), update_impl_scope("xla"):
         lut, perm, info = shard_map_compat(
             kernel,
             mesh=mesh,

@@ -62,6 +62,15 @@ def _pi(opts: Optional[Options]):
     return get_option(opts, Option.PanelImpl)
 
 
+def _ui(opts: Optional[Options]):
+    """Raw Option.UpdateImpl value from a driver ``opts`` mapping — the
+    trailing-update lowering the summa/potrf/LU-nopiv k-loops consume
+    (fused Pallas trailing-update kernels vs the XLA bulk einsums).  May
+    be None: ``ops.pallas_ops.resolve_update_impl`` inside each kernel
+    is the single authority for the context/env/auto default chain."""
+    return get_option(opts, Option.UpdateImpl)
+
+
 def _nm(opts: Optional[Options]):
     """Raw Option.NumMonitor value from a driver ``opts`` mapping — the
     in-carry numerics-gauge switch the factor kernels consume (growth /
@@ -129,7 +138,7 @@ def gemm_mesh(
     bd = from_dense(b, mesh, nb)
     cd = from_dense(c, mesh, nb) if c is not None else None
     return to_dense(gemm_summa(alpha, ad, bd, beta, cd, lookahead=_la(opts),
-                               bcast_impl=_bi(opts)))
+                               bcast_impl=_bi(opts), update_impl=_ui(opts)))
 
 
 @instrument("potrf_mesh")
@@ -154,7 +163,8 @@ def potrf_mesh(
         )
     return potrf_dist(
         from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts),
-        bcast_impl=_bi(opts), panel_impl=_pi(opts), num_monitor=_nm(opts),
+        bcast_impl=_bi(opts), panel_impl=_pi(opts), update_impl=_ui(opts),
+        num_monitor=_nm(opts),
     )
 
 
@@ -218,7 +228,8 @@ def getrf_nopiv_mesh(
         )
     return getrf_nopiv_dist(
         from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts),
-        bcast_impl=_bi(opts), panel_impl=_pi(opts), num_monitor=_nm(opts),
+        bcast_impl=_bi(opts), panel_impl=_pi(opts), update_impl=_ui(opts),
+        num_monitor=_nm(opts),
     )
 
 
@@ -262,7 +273,7 @@ def geqrf_mesh(
         return geqrf_ckpt(from_dense(a, mesh, nb), every=every,
                           bcast_impl=_bi(opts), num_monitor=_nm(opts))
     return geqrf_dist(from_dense(a, mesh, nb), bcast_impl=_bi(opts),
-                      num_monitor=_nm(opts))
+                      panel_impl=_pi(opts), num_monitor=_nm(opts))
 
 
 @instrument("gels_mesh")
@@ -429,7 +440,7 @@ def getrf_tntpiv_mesh(
     Returns (LU, perm over the padded row space, info)."""
     return getrf_tntpiv_dist(
         from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts),
-        bcast_impl=_bi(opts), num_monitor=_nm(opts),
+        bcast_impl=_bi(opts), panel_impl=_pi(opts), num_monitor=_nm(opts),
     )
 
 
@@ -628,7 +639,7 @@ def getrf_mesh(
         )
     return getrf_pp_dist(
         from_dense(a, mesh, nb, diag_pad_one=True), lookahead=_la(opts),
-        bcast_impl=_bi(opts), num_monitor=_nm(opts),
+        bcast_impl=_bi(opts), panel_impl=_pi(opts), num_monitor=_nm(opts),
     )
 
 

@@ -52,6 +52,7 @@ def gemm_summa(
     method: Optional[MethodGemm] = None,
     lookahead: Optional[int] = None,
     bcast_impl: Optional[str] = None,
+    update_impl: Optional[str] = None,
 ) -> DistMatrix:
     """C := alpha A B + beta C on block-cyclic tile stacks.
 
@@ -74,6 +75,13 @@ def gemm_summa(
     psum or the half-the-bytes ppermute ring/doubling engine — results
     are bitwise-identical either way.  GemmA's all_gather/psum-reduce
     schedule has no rooted broadcasts, so the choice is ignored there.
+
+    ``update_impl`` selects the trailing-update lowering
+    (Option.UpdateImpl; None = pallas_ops.resolve_update_impl's default
+    chain): ``xla`` is today's einsum consume (jaxpr-identical), ``pallas``
+    the one-dispatch fused grid kernel ``summa_update_pallas`` — bitwise
+    vs xla under interpret mode, comm bytes invariant by construction.
+    GemmA has no k-loop consume, so the choice is ignored there.
     """
     p, q = mesh_shape(a.mesh)
     if b.grid != (p, q) or b.nb != a.nb:
@@ -91,6 +99,7 @@ def gemm_summa(
         return _gemm_summa_a(alpha, a, b, beta, c)
     ctiles = None if c is None else c.tiles
     from ..obs import flight as _flight
+    from ..ops.pallas_ops import resolve_update_impl
     from .comm import la_depth, resolve_bcast_impl
 
     if _flight.step_dispatch_active():
@@ -100,11 +109,13 @@ def gemm_summa(
         out_t = _flight.summa_steps(
             a.tiles, b.tiles, ctiles, alpha, beta, a.mesh, p, q, kt,
             la_depth(lookahead, kt), resolve_bcast_impl(bcast_impl),
+            resolve_update_impl(update_impl),
         )
     else:
         out_t = _summa_jit(
             a.tiles, b.tiles, ctiles, alpha, beta, a.mesh, p, q, kt,
             la_depth(lookahead, kt), resolve_bcast_impl(bcast_impl),
+            resolve_update_impl(update_impl),
         )
     return DistMatrix(tiles=out_t, m=a.m, n=b.n, nb=a.nb, mesh=a.mesh)
 
@@ -431,8 +442,8 @@ def _summa_a_jit(at, bt, ct, alpha, beta, mesh, p, q):
     return (alpha * prod + beta * ct).astype(at.dtype)
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10))
-def _summa_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, la, bi):
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _summa_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, la, bi, ui):
     spec = P(ROW_AXIS, COL_AXIS)
 
     def kernel(a_loc, b_loc):
@@ -440,7 +451,12 @@ def _summa_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, la, bi):
         mtl, _, nb, _ = a_loc.shape
         ntl = b_loc.shape[1]
         dtype = a_loc.dtype
+        from ..ops.pallas_ops import summa_update_pallas, update_engaged
         from .comm import prefetch_bcast
+
+        fused = update_engaged(
+            dtype, (mtl + ntl) * nb * nb * dtype.itemsize
+        )
 
         def fetch(k):
             # panels are pure functions of the stationary tile stacks:
@@ -453,14 +469,18 @@ def _summa_jit(at, bt, ct, alpha, beta, mesh, p, q, kt, la, bi):
 
         def consume(k, panels, acc):
             acol, brow = panels
+            if fused:  # Option.UpdateImpl: one fused grid dispatch
+                return summa_update_pallas(acc, acol, brow)
             return acc + _local_outer(acol, brow, dtype)
 
         acc0 = jnp.zeros((mtl, ntl, nb, nb), dtype)
         return prefetch_bcast(kt, la, fetch, consume, acc0)
 
+    from ..ops.pallas_ops import update_impl_scope
     from .comm import bcast_impl_scope
 
-    with bcast_impl_scope(bi):  # kernel traces under the static lowering
+    with bcast_impl_scope(bi), update_impl_scope(ui):
+        # kernel traces under the static lowerings
         prod = shard_map_compat(
             kernel,
             mesh=mesh,

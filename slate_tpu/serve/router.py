@@ -647,6 +647,106 @@ class Router:
                 jax.block_until_ready(out)  # honest span/SLA end time
         return out
 
+    # -- QR (least-squares) tier -------------------------------------------
+
+    def gels(self, a: jax.Array, b: jax.Array,
+             tenant: Optional[str] = None) -> jax.Array:
+        """Serve one least-squares request min ||A x - b|| through the
+        mesh CAQR tier (requires a mesh; m >= n).  With
+        Option.NumMonitor armed the factor's recorded reflector/τ
+        consistency loss (the ``num.qr_orth_margin`` gauge — recorded
+        since ISSUE 15, acted on here) is policed against
+        ``obs.numerics.ORTH_THRESHOLD``: a factor past the bound is NOT
+        served raw — the router retries ONCE with a
+        re-orthogonalization pass ("twice is enough": a second CAQR
+        over the explicitly-formed Q, both triangular factors folded
+        into the solve), counted as one ``serve.retries`` with its own
+        degradation note (``orth_retry``).  Unmonitored requests keep
+        the single-pass factor — no degradation action without the
+        gauge that polices it (the growth-abort rule)."""
+        from ..obs import numerics as _num
+        from ..parallel.dist import from_dense, to_dense
+        from ..parallel.dist_qr import geqrf_dist, unmqr_dist
+        from ..types import Op
+
+        if self.mesh is None:
+            raise SlateError("serve: the gels tier requires a mesh")
+        serve_count("requests")
+        m, n = a.shape
+        tr = rtrace.new_trace("gels", m, self.nb, str(a.dtype),
+                              tenant=tenant)
+        try:
+            with rtrace.phase(tr, "admission"):
+                self.admit("gels", m)
+        except SlateError:
+            rtrace.finish(tr, "reject_admission")
+            raise
+        try:
+            _la, bi, pi, nm = self._resil_opts()
+            monitored = _num.resolve_num_monitor(nm) == "on"
+            if monitored:
+                _num.clear_last("geqrf")  # police THIS factor's gauge
+            bcol = b if b.ndim == 2 else b[:, None]
+            with rtrace.phase(tr, "factor", method="geqrf_dist"):
+                f1 = geqrf_dist(from_dense(a, self.mesh, self.nb),
+                                bcast_impl=bi, panel_impl=pi,
+                                num_monitor=nm)
+            if monitored and _num.orth_exceeded("geqrf"):
+                serve_count("retries")
+                rtrace.note(tr, "orth_retry")
+                with rtrace.phase(tr, "retry", cause="orth_loss"):
+                    # Q1 = Q2 R2 re-orthogonalizes the computed basis, so
+                    # A = Q2 (R2 R1): solve R2 z = Q2ᴴ b, then R1 x = z
+                    eye = jnp.eye(m, n, dtype=a.dtype)
+                    q1 = to_dense(unmqr_dist(
+                        f1, from_dense(eye, self.mesh, self.nb),
+                        Op.NoTrans, bcast_impl=bi))[:, :n]
+                    f2 = geqrf_dist(from_dense(q1, self.mesh, self.nb),
+                                    bcast_impl=bi, panel_impl=pi,
+                                    num_monitor=nm)
+                    qb = to_dense(unmqr_dist(
+                        f2, from_dense(bcol, self.mesh, self.nb),
+                        Op.ConjTrans, bcast_impl=bi))[:n]
+                    z, info2 = self._rsolve(f2, qb, n, bi)
+                    x, info1 = self._rsolve(f1, z, n, bi)
+                    info = jnp.where(info1 != 0, info1, info2)
+            else:
+                with rtrace.phase(tr, "solve"):
+                    qb = to_dense(unmqr_dist(
+                        f1, from_dense(bcol, self.mesh, self.nb),
+                        Op.ConjTrans, bcast_impl=bi))[:n]
+                    x, info = self._rsolve(f1, qb, n, bi)
+            if int(info) != 0:
+                rtrace.finish(tr, "failed_info")
+                raise SlateError(
+                    f"serve: gels factor reported info={int(info)} — "
+                    "R diagonal exactly zero (rank-deficient operand)")
+            jax.block_until_ready(x)  # honest span/SLA end time
+            rtrace.finish(tr)
+            return x[:, 0] if b.ndim == 1 else x
+        except Exception:
+            if tr is not None and tr.outcome is None:
+                tr.finish("failed_error")
+            raise
+
+    def _rsolve(self, f, y, n: int, bi):
+        """x = R^{-1} y from CAQR factors: the R top square goes through
+        one dense triu round trip (the gels_mesh composition) into an
+        upper trsm sweep.  info flags an exactly-zero R diagonal."""
+        from ..parallel.dist import from_dense, to_dense
+        from ..parallel.dist_trsm import trsm_dist
+        from ..types import Op, Uplo
+
+        r = jnp.triu(to_dense(f.fact)[:n, :n])
+        rd = from_dense(r, self.mesh, self.nb, diag_pad_one=True)
+        xd = trsm_dist(rd, from_dense(y, self.mesh, self.nb), Uplo.Upper,
+                       Op.NoTrans, bcast_impl=bi)
+        rdiag = jnp.diagonal(r)
+        info = jnp.where(
+            jnp.any(rdiag == 0), jnp.argmax(rdiag == 0) + 1, 0
+        ).astype(jnp.int32)
+        return to_dense(xd)[:n], info
+
 
 def _build_batched(op: str, variant: str):
     """The pure stacked solve body for one (op, accuracy-class) pair —

@@ -255,6 +255,22 @@ class Option(enum.Enum):
     # (QR panels are bitwise); parity is gated by
     # tests/test_pallas_panels.py under interpret mode.
     PanelImpl = "panel_impl"
+    # Trailing-update lowering for the mesh k-loops' bulk phase
+    # (ops/pallas_ops.py, ISSUE 20): "xla" (the reference semantics —
+    # today's einsum bulk chains, jaxpr-IDENTICAL by construction),
+    # "pallas" (one fused grid dispatch over the local trailing tile
+    # stack per k-step — summa_update_pallas / chol_trailing_update_pallas
+    # / lu_trailing_update_pallas, with the broadcast panels riding VMEM
+    # blocks; bitwise vs the xla bulk under interpret mode), or "auto"
+    # (the default: pallas on a real TPU backend for MXU dtypes, xla
+    # elsewhere).  Fusion changes compute scheduling, never comm — the
+    # broadcast schedule and comm-audit wire bytes are invariant across
+    # lowerings (asserted).  Resolution order: explicit option >
+    # pallas_ops.use_update_impl context > SLATE_TPU_UPDATE_IMPL
+    # environment > auto (the Option.PanelImpl pattern).  Scope: the
+    # summa / potrf / LU-nopiv bulk phases; the pivoted/band LU kernels
+    # pin xla (their trailing sweeps interleave with pivot application).
+    UpdateImpl = "update_impl"
     # Mixed-precision routing for the distributed f64 solves
     # (parallel/dist_refine.py): "off" (factor at the data dtype — trace-
     # identical to the direct gesv_mesh/posv_mesh path), "ir" (f32 mesh

@@ -1089,15 +1089,20 @@ def test_band_mesh_kernels_band_cost(rng):
             ca = ca[0]
         return ca["flops"]
 
-    # lowering pinned to psum + the xla panel forms: the flop-class gate
-    # is impl-independent (ppermute adds bytes bookkeeping, not flops;
-    # the fused panel kernels change dispatch count, not flop class) but
-    # the jits now take the bcast-impl / panel-impl static args
-    dense = _potrf_jit.lower(tiles, mesh, 2, 4, nt, 1, "psum", "xla").compile()
+    # lowering pinned to psum + the xla panel/update forms: the
+    # flop-class gate is impl-independent (ppermute adds bytes
+    # bookkeeping, not flops; the fused panel/update kernels change
+    # dispatch count, not flop class) but the jits now take the
+    # bcast-impl / panel-impl / update-impl static args
+    dense = _potrf_jit.lower(
+        tiles, mesh, 2, 4, nt, 1, "psum", "xla", "xla"
+    ).compile()
     band = _pbtrf_band_jit.lower(tiles, mesh, 2, 4, nt, wd, 1, "psum").compile()
     assert flops(band) < flops(dense) / 4, (flops(band), flops(dense))
 
-    dense_lu = _pp_jit.lower(tiles, mesh, 2, 4, nt, n, 1, "psum").compile()
+    dense_lu = _pp_jit.lower(
+        tiles, mesh, 2, 4, nt, n, 1, "psum", "xla"
+    ).compile()
     wd_u = ((nb - 1) + 2 * kd) // nb + 1
     wd_usw = ((nb - 1) + 3 * kd) // nb + 1
     band_lu = _gb_pp_jit.lower(

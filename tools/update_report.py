@@ -1,0 +1,162 @@
+"""Fused trailing-update RunReport evidence (PR 20 acceptance artifact).
+
+Runs every fused Pallas trailing-update kernel against its XLA einsum
+bulk form — the SUMMA stationary-C consume, the potrf trailing herk
+(masked), and the LU-nopiv trailing gemm (masked) — and writes one
+RunReport per Option.UpdateImpl lowering plus a diff summary:
+
+- each side's values are its residuals against an f64 numpy ground
+  truth (``*_resid_err``: lower-is-better names, so the ``python -m
+  slate_tpu.obs.report --check PALLAS XLA`` gate enforces the parity
+  contract), and ``update_*_bitwise`` = 1.0 — unlike the panel factor
+  kernels, the update kernels replicate the XLA op sequence exactly
+  (contraction at HIGHEST → astype → select → add/subtract), so under
+  the interpreter they must match the einsum forms BIT FOR BIT;
+- on this CPU harness the kernels run under the Pallas interpreter, so
+  the artifact certifies PARITY (the numerics shipped to the MXU), not
+  speed — the on-chip speed story is bench.py's ``update_*`` extras.
+
+Usage:
+  JAX_PLATFORMS=cpu python tools/update_report.py [--out artifacts/obs]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+MTL, NTL, NB = 3, 4, 32
+
+
+def _operands():
+    rng = np.random.default_rng(1)
+    acc = rng.standard_normal((MTL, NTL, NB, NB)).astype(np.float32)
+    pan = rng.standard_normal((MTL, NB, NB)).astype(np.float32)
+    pan_t = rng.standard_normal((NTL, NB, NB)).astype(np.float32)
+    urow = rng.standard_normal((NTL, NB, NB)).astype(np.float32)
+    lower = np.arange(MTL)[:, None] >= np.arange(NTL)[None, :]
+    return (jnp.asarray(acc), jnp.asarray(pan), jnp.asarray(pan_t),
+            jnp.asarray(urow), jnp.asarray(lower))
+
+
+def run(impl: str) -> dict:
+    """Residuals of one lowering's trailing updates vs f64 numpy truth,
+    plus bitwise-vs-XLA flags for the pallas side."""
+    from slate_tpu.ops import pallas_ops as po
+
+    acc, pan, pan_t, urow, lower = _operands()
+    hi = jax.lax.Precision.HIGHEST
+    a64 = np.asarray(acc, np.float64)
+    p64 = np.asarray(pan, np.float64)
+    pt64 = np.asarray(pan_t, np.float64)
+    u64 = np.asarray(urow, np.float64)
+    m64 = np.asarray(lower)
+    vals = {}
+
+    def xla_summa():
+        upd = jnp.einsum("iab,jbc->ijac", pan, urow, precision=hi)
+        return acc + upd.astype(acc.dtype)
+
+    def xla_potrf():
+        upd = jnp.einsum("iab,jcb->ijac", pan, pan_t,
+                         precision=hi).astype(acc.dtype)
+        return acc - jnp.where(lower[:, :, None, None], upd, 0)
+
+    def xla_getrf():
+        upd = jnp.einsum("iab,jbc->ijac", pan, urow, precision=hi)
+        return acc - jnp.where(lower[:, :, None, None],
+                               upd.astype(acc.dtype), 0)
+
+    cases = {
+        "summa": (
+            xla_summa,
+            lambda: po.summa_update_pallas(acc, pan, urow),
+            a64 + np.einsum("iab,jbc->ijac", p64, u64),
+        ),
+        "potrf": (
+            xla_potrf,
+            lambda: po.chol_trailing_update_pallas(acc, pan, pan_t, lower),
+            a64 - np.where(m64[:, :, None, None],
+                           np.einsum("iab,jcb->ijac", p64, pt64), 0),
+        ),
+        "getrf": (
+            xla_getrf,
+            lambda: po.lu_trailing_update_pallas(acc, pan, urow, lower),
+            a64 - np.where(m64[:, :, None, None],
+                           np.einsum("iab,jbc->ijac", p64, u64), 0),
+        ),
+    }
+    for name, (xla_fn, pallas_fn, truth) in cases.items():
+        ref = np.asarray(xla_fn())
+        out = np.asarray(pallas_fn()) if impl == "pallas" else ref
+        vals[f"update_{name}_resid_err"] = float(
+            np.abs(out.astype(np.float64) - truth).max() / np.abs(truth).max()
+        )
+        vals[f"update_{name}_bitwise"] = float(np.array_equal(out, ref))
+    vals["update_kernels_checked"] = float(len(cases))
+    return vals
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "..", "artifacts", "obs"))
+    ap.add_argument("--threshold", type=float, default=3.0)
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+
+    from slate_tpu.obs.report import check_regression, write_report
+    from slate_tpu.ops.pallas_ops import use_update_impl
+
+    reports = {}
+    for impl in ("xla", "pallas"):
+        with use_update_impl(impl):
+            jax.clear_caches()
+            vals = run(impl)
+        path = os.path.join(args.out, f"update_{impl}.report.json")
+        write_report(path, name=f"update_{impl}",
+                     config={"mtl": MTL, "ntl": NTL, "nb": NB, "impl": impl},
+                     values=vals)
+        reports[impl] = vals
+        print(f"update_report: wrote {path}")
+
+    not_bitwise = [k for k, v in reports["pallas"].items()
+                   if k.endswith("_bitwise") and v != 1.0]
+    if not_bitwise:
+        raise SystemExit(
+            f"update_report: kernels not bitwise vs XLA: {not_bitwise}")
+    failures, compared = check_regression(
+        reports["pallas"], reports["xla"], threshold=args.threshold
+    )
+    diff = {
+        "threshold": args.threshold,
+        "compared": compared,
+        "failures": failures,
+        "xla": reports["xla"],
+        "pallas": reports["pallas"],
+    }
+    dpath = os.path.join(args.out, "update_diff.json")
+    with open(dpath, "w") as f:
+        json.dump(diff, f, indent=1)
+    print(f"update_report: wrote {dpath} ({compared} metrics compared)")
+    if failures:
+        for msg in failures:
+            print(f"update_report: REGRESSION {msg}", file=sys.stderr)
+        raise SystemExit(1)
+    print("update_report: OK — fused updates bitwise + within parity "
+          "threshold")
+
+
+if __name__ == "__main__":
+    main()
